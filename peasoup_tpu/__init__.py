@@ -1,0 +1,27 @@
+"""peasoup_tpu — a TPU-native (JAX/XLA/Pallas) pulsar-search framework.
+
+A from-scratch re-design of the capabilities of the CUDA ``peasoup``
+pipeline (reference: pinsleepe/peasoup) for TPU hardware:
+
+* incoherent dedispersion over a DM-trial grid as a batched XLA
+  gather/reduce (reference: external ``dedisp`` library),
+* Fourier-domain acceleration search (resample -> rfft -> interbin
+  spectrum -> red-noise removal -> harmonic summing -> peak finding) as
+  one batched, jitted array program per DM trial
+  (reference: src/pipeline_multi.cu:100-252 per-trial scalar loop),
+* candidate distilling/scoring/folding on the host,
+* multi-chip scaling via ``jax.sharding.Mesh`` + ``shard_map`` over the
+  DM/beam trial grid (reference: one pthread per GPU).
+
+Layout:
+    core/      candidate model + array containers
+    io/        sigproc filterbank/timeseries I/O, zap/kill files, writers
+    plan/      DM-list / acceleration-list / FFT-size planning (host math)
+    ops/       device ops (pure jnp reference impls + Pallas kernels)
+    parallel/  mesh, shardings, collectives, multibeam coincidence
+    pipeline/  search driver, distillers, scorer, folder
+    cli/       command-line interfaces (peasoup, coincidencer)
+    native/    C++ host runtime (bit unpack, clustering, distill) via ctypes
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,12 @@
+from .sigproc import (
+    SigprocHeader,
+    read_sigproc_header,
+    write_sigproc_header,
+    Filterbank,
+    read_filterbank,
+    read_timeseries,
+    write_filterbank,
+    unpack_bits,
+    pack_bits,
+)
+from .masks import read_killfile, read_zapfile

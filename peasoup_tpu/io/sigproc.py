@@ -1,0 +1,290 @@
+"""SIGPROC filterbank / time-series I/O.
+
+Implements the keyword-tagged binary header format used by sigproc and
+the reference pipeline (reference: include/data_types/header.hpp:339-403
+for reading, header.hpp:222-308 for writing) plus bit unpacking of
+1/2/4/8-bit filterbank data (done inside libdedisp in the reference).
+
+All file I/O is host-side numpy; arrays are handed to JAX later.
+"""
+
+from __future__ import annotations
+
+import io as _io
+import os
+import struct
+from dataclasses import dataclass, field, asdict
+from typing import BinaryIO, Optional
+
+import numpy as np
+
+# Header keys -> struct format. Mirrors the keyword set understood by the
+# reference reader (header.hpp:351-391).
+_INT_KEYS = {
+    "nchans", "telescope_id", "machine_id", "data_type", "ibeam",
+    "nbeams", "nbits", "barycentric", "pulsarcentric", "nbins",
+    "nsamples", "nifs", "npuls",
+}
+_DOUBLE_KEYS = {
+    "az_start", "za_start", "src_raj", "src_dej", "tstart", "tsamp",
+    "period", "fch1", "foff", "refdm",
+}
+_STRING_KEYS = {"source_name", "rawdatafile"}
+_CHAR_KEYS = {"signed"}
+
+
+@dataclass
+class SigprocHeader:
+    """Sigproc header values (reference: header.hpp:171-212)."""
+
+    source_name: str = ""
+    rawdatafile: str = ""
+    az_start: float = 0.0
+    za_start: float = 0.0
+    src_raj: float = 0.0
+    src_dej: float = 0.0
+    tstart: float = 0.0
+    tsamp: float = 0.0
+    period: float = 0.0
+    fch1: float = 0.0
+    foff: float = 0.0
+    nchans: int = 0
+    telescope_id: int = 0
+    machine_id: int = 0
+    data_type: int = 0
+    ibeam: int = 0
+    nbeams: int = 0
+    nbits: int = 0
+    barycentric: int = 0
+    pulsarcentric: int = 0
+    nbins: int = 0
+    nsamples: int = 0
+    nifs: int = 0
+    npuls: int = 0
+    refdm: float = 0.0
+    signed_data: int = 0
+    size: int = 0  # header size in bytes (set on read)
+
+    @property
+    def cfreq(self) -> float:
+        """Centre frequency (reference: filterbank.hpp:189-195).
+
+        The reference treats fch1 as the band edge and always moves
+        nchans/2 channels toward the band centre (the foff>0 branch
+        subtracts, keeping the result below fch1 for ascending bands —
+        preserved verbatim for trial-grid parity).
+        """
+        if self.foff < 0:
+            return self.fch1 + self.foff * self.nchans / 2
+        return self.fch1 - self.foff * self.nchans / 2
+
+    @property
+    def bandwidth(self) -> float:
+        """Total (absolute) bandwidth in MHz."""
+        return abs(self.foff) * self.nchans
+
+    @property
+    def tobs(self) -> float:
+        return self.nsamples * self.tsamp
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+def _read_string(stream: BinaryIO) -> Optional[str]:
+    raw = stream.read(4)
+    if len(raw) < 4:
+        return None
+    (length,) = struct.unpack("<i", raw)
+    if length <= 0 or length >= 80:
+        return None
+    return stream.read(length).decode("latin-1")
+
+
+def read_sigproc_header(stream: BinaryIO) -> SigprocHeader:
+    """Read a sigproc header from an open binary stream.
+
+    Computes ``nsamples`` from the file size when the keyword is absent,
+    like the reference (header.hpp:394-401).
+    """
+    hdr = SigprocHeader()
+    start = _read_string(stream)
+    if start != "HEADER_START":
+        raise ValueError("not a sigproc file: missing HEADER_START")
+    while True:
+        key = _read_string(stream)
+        if key is None:
+            raise ValueError("unterminated sigproc header")
+        if key == "HEADER_END":
+            break
+        if key in _STRING_KEYS:
+            value = _read_string(stream)
+            setattr(hdr, key, value or "")
+        elif key in _INT_KEYS:
+            (value,) = struct.unpack("<i", stream.read(4))
+            setattr(hdr, key, value)
+        elif key in _DOUBLE_KEYS:
+            (value,) = struct.unpack("<d", stream.read(8))
+            setattr(hdr, key, value)
+        elif key in _CHAR_KEYS:
+            (value,) = struct.unpack("<B", stream.read(1))
+            hdr.signed_data = value
+        else:
+            # Unknown keyword: warn and continue, like the reference
+            # (header.hpp:390-391). We cannot skip its value (length is
+            # keyword-dependent), so the next string read resynchronises
+            # or fails; warn either way.
+            import warnings
+
+            warnings.warn(f"read_sigproc_header: unknown parameter {key!r}")
+    hdr.size = stream.tell()
+    if hdr.nsamples == 0:
+        pos = stream.tell()
+        stream.seek(0, _io.SEEK_END)
+        total = stream.tell()
+        hdr.nsamples = (total - hdr.size) // hdr.nchans * 8 // hdr.nbits
+        stream.seek(pos, _io.SEEK_SET)
+    return hdr
+
+
+def _write_string(stream: BinaryIO, s: str) -> None:
+    b = s.encode("latin-1")
+    stream.write(struct.pack("<i", len(b)))
+    stream.write(b)
+
+
+def write_sigproc_header(stream: BinaryIO, hdr: SigprocHeader) -> None:
+    """Write a sigproc header (reference: header.hpp:222-308)."""
+    _write_string(stream, "HEADER_START")
+    if hdr.source_name:
+        _write_string(stream, "source_name")
+        _write_string(stream, hdr.source_name)
+    if hdr.rawdatafile:
+        _write_string(stream, "rawdatafile")
+        _write_string(stream, hdr.rawdatafile)
+    for key in sorted(_DOUBLE_KEYS):
+        _write_string(stream, key)
+        stream.write(struct.pack("<d", getattr(hdr, key)))
+    for key in sorted(_INT_KEYS):
+        if key == "nsamples":
+            continue  # recomputed from file size on read, like sigproc
+        _write_string(stream, key)
+        stream.write(struct.pack("<i", getattr(hdr, key)))
+    _write_string(stream, "signed")
+    stream.write(struct.pack("<B", hdr.signed_data))
+    _write_string(stream, "HEADER_END")
+
+
+# ---------------------------------------------------------------------------
+# Bit packing/unpacking.
+#
+# Sigproc packs sub-byte samples LSB-first within each byte, channel index
+# running fastest. The reference delegates unpacking to libdedisp's
+# sub-word extraction; we unpack to u8 on the host once and keep the
+# (nsamps, nchans) array.
+# ---------------------------------------------------------------------------
+
+def unpack_bits(raw: np.ndarray, nbits: int) -> np.ndarray:
+    """Unpack a u8 byte array into individual samples (LSB-first)."""
+    raw = np.ascontiguousarray(raw, dtype=np.uint8)
+    if nbits == 8:
+        return raw
+    if nbits == 4:
+        out = np.empty(raw.size * 2, dtype=np.uint8)
+        out[0::2] = raw & 0x0F
+        out[1::2] = raw >> 4
+        return out
+    if nbits == 2:
+        out = np.empty(raw.size * 4, dtype=np.uint8)
+        for k in range(4):
+            out[k::4] = (raw >> (2 * k)) & 0x03
+        return out
+    if nbits == 1:
+        out = np.empty(raw.size * 8, dtype=np.uint8)
+        for k in range(8):
+            out[k::8] = (raw >> k) & 0x01
+        return out
+    raise ValueError(f"unsupported nbits: {nbits}")
+
+
+def pack_bits(samples: np.ndarray, nbits: int) -> np.ndarray:
+    """Inverse of :func:`unpack_bits` (used for writing test fixtures)."""
+    samples = np.ascontiguousarray(samples, dtype=np.uint8).ravel()
+    if nbits == 8:
+        return samples
+    per_byte = 8 // nbits
+    if samples.size % per_byte:
+        raise ValueError("sample count not a multiple of samples-per-byte")
+    out = np.zeros(samples.size // per_byte, dtype=np.uint8)
+    mask = (1 << nbits) - 1
+    for k in range(per_byte):
+        out |= (samples[k::per_byte] & mask) << (nbits * k)
+    return out
+
+
+@dataclass
+class Filterbank:
+    """A filterbank in host RAM: (nsamps, nchans) u8 samples + header.
+
+    Reference keeps the packed bytes and defers unpacking to dedisp
+    (filterbank.hpp:207-250); we unpack once on read.
+    """
+
+    header: SigprocHeader
+    data: np.ndarray  # (nsamps, nchans) uint8
+
+    @property
+    def nsamps(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def nchans(self) -> int:
+        return self.data.shape[1]
+
+    @property
+    def tsamp(self) -> float:
+        return self.header.tsamp
+
+    @property
+    def cfreq(self) -> float:
+        return self.header.cfreq
+
+    @property
+    def foff(self) -> float:
+        return self.header.foff
+
+    @property
+    def fch1(self) -> float:
+        return self.header.fch1
+
+    @property
+    def nbits(self) -> int:
+        return self.header.nbits
+
+
+def read_filterbank(path: str | os.PathLike) -> Filterbank:
+    """Read a sigproc filterbank file fully into host RAM."""
+    with open(path, "rb") as f:
+        hdr = read_sigproc_header(f)
+        nbytes = hdr.nsamples * hdr.nbits * hdr.nchans // 8
+        f.seek(hdr.size, _io.SEEK_SET)
+        raw = np.frombuffer(f.read(nbytes), dtype=np.uint8)
+    samples = unpack_bits(raw, hdr.nbits)
+    data = samples.reshape(hdr.nsamples, hdr.nchans)
+    return Filterbank(header=hdr, data=data)
+
+
+def write_filterbank(path: str | os.PathLike, fil: Filterbank) -> None:
+    with open(path, "wb") as f:
+        write_sigproc_header(f, fil.header)
+        f.write(pack_bits(fil.data.ravel(), fil.header.nbits).tobytes())
+
+
+def read_timeseries(path: str | os.PathLike) -> tuple[SigprocHeader, np.ndarray]:
+    """Read a sigproc .tim file: header + float32 samples
+    (reference: timeseries.hpp:137-160)."""
+    with open(path, "rb") as f:
+        hdr = read_sigproc_header(f)
+        f.seek(hdr.size, _io.SEEK_SET)
+        data = np.frombuffer(f.read(), dtype=np.float32)
+    return hdr, data

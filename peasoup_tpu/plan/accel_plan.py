@@ -1,0 +1,80 @@
+"""Acceleration-trial planning (reference: include/utils/utils.hpp:140-193).
+
+The trial step is set so that the quadratic drift mismatch between
+neighbouring trials smears a pulse of effective width w by no more than
+the tolerance factor: alt_a = 2 * w * 24c / tobs^2 * sqrt(tol^2 - 1),
+with w^2 = tdm^2 + tpulse^2 + tsamp^2 (tdm the intra-channel DM smear).
+
+Quirks preserved for parity:
+  * 0.0 is explicitly prepended when both range ends are non-zero
+    (utils.hpp:183-184), so the list is NOT sorted;
+  * the walk appends acc_hi after the loop, so the last interval can be
+    shorter than alt_a (utils.hpp:186-190);
+  * acc_hi == acc_lo yields the single trial [0.0] (utils.hpp:169-173).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+SPEED_OF_LIGHT = 299792458.0
+
+
+@dataclass
+class AccelerationPlan:
+    acc_lo: float
+    acc_hi: float
+    tol: float
+    pulse_width: float  # microseconds (--acc_pulse_width)
+    nsamps: int  # FFT size used for the search
+    tsamp: float  # seconds
+    cfreq: float  # MHz
+    bw: float  # MHz (absolute total bandwidth)
+
+    def __post_init__(self):
+        self.bw = abs(self.bw)
+        self.tobs = self.nsamps * self.tsamp
+
+    def step(self, dm: float) -> float:
+        """Trial spacing alt_a at the given DM (m/s^2).
+
+        Width terms mix units like the reference (pulse_width becomes ms,
+        tsamp stays in s) and every intermediate is truncated to f32 the
+        way the reference's float locals are (utils.hpp:162-180).
+        """
+        # C semantics: float locals, double expression evaluation, one
+        # truncation per assignment.
+        f32 = np.float32
+        bw = float(f32(self.bw))
+        cfreq = float(f32(self.cfreq))
+        tol = float(f32(self.tol))
+        pulse_width = float(f32(self.pulse_width / 1.0e3))
+        tsamp = float(f32(self.tsamp))
+        tobs = float(f32(f32(self.nsamps) * f32(self.tsamp)))
+        tdm = float(f32((8.3 * bw / cfreq**3 * dm) ** 2))
+        tpulse = float(f32(pulse_width * pulse_width))
+        ttsamp = float(f32(tsamp * tsamp))
+        w_us = float(f32(np.sqrt(tdm + tpulse + ttsamp)))
+        return float(
+            f32(
+                2.0 * w_us * 1.0e-6 * 24.0 * SPEED_OF_LIGHT / tobs / tobs
+                * np.sqrt(tol * tol - 1.0)
+            )
+        )
+
+    def generate_accel_list(self, dm: float) -> np.ndarray:
+        if self.acc_hi == self.acc_lo:
+            return np.zeros(1, dtype=np.float32)
+        alt_a = self.step(dm)
+        accs: list[float] = []
+        if self.acc_hi != 0 and self.acc_lo != 0:
+            accs.append(0.0)
+        acc = np.float32(self.acc_lo)
+        alt_a32 = np.float32(alt_a)
+        while acc < self.acc_hi:
+            accs.append(float(acc))
+            acc = np.float32(acc + alt_a32)
+        accs.append(float(self.acc_hi))
+        return np.asarray(accs, dtype=np.float32)

@@ -1,0 +1,105 @@
+"""Plan math tests: DM list vs golden, accel-list quirks, FFT sizing."""
+
+import numpy as np
+import pytest
+
+from peasoup_tpu.plan import (
+    generate_dm_list,
+    delay_table,
+    max_delay_samples,
+    DMPlan,
+    AccelerationPlan,
+    prev_power_of_two,
+    choose_fft_size,
+)
+
+TUTORIAL = dict(tsamp=0.00032, fch1=1510.0, foff=-1.09, nchans=64)
+
+
+def test_dm_list_matches_golden(golden_dm_list):
+    dms = generate_dm_list(
+        0.0, 250.0, TUTORIAL["tsamp"], 64.0, TUTORIAL["fch1"], TUTORIAL["foff"],
+        TUTORIAL["nchans"], 1.10000002384186,
+    )
+    assert len(dms) == 59
+    np.testing.assert_allclose(dms, golden_dm_list, rtol=5e-7)
+
+
+def test_dm_list_monotonic_and_bounded():
+    dms = generate_dm_list(0.0, 100.0, 6.4e-5, 40.0, 1400.0, -0.39, 1024, 1.1)
+    assert np.all(np.diff(dms) > 0)
+    assert dms[0] == 0.0
+    assert dms[-1] >= 100.0
+
+
+def test_delay_table_signs():
+    d = delay_table(TUTORIAL["fch1"], TUTORIAL["foff"], TUTORIAL["nchans"],
+                    TUTORIAL["tsamp"])
+    assert d[0] == 0.0
+    assert np.all(np.diff(d) > 0)  # lower freq -> larger delay
+
+
+def test_max_delay_tutorial():
+    d = delay_table(TUTORIAL["fch1"], TUTORIAL["foff"], TUTORIAL["nchans"],
+                    TUTORIAL["tsamp"])
+    md = max_delay_samples(252.98102, d)  # last golden trial DM
+    # ~0.045 s of dispersive delay across the band at DM~253
+    assert 130 < md < 150
+
+
+def test_dmplan_create():
+    plan = DMPlan.create(
+        nsamps=187520, nchans=64, tsamp=0.00032, fch1=1510.0, foff=-1.09,
+        dm_start=0.0, dm_end=250.0,
+    )
+    assert plan.ndm == 59
+    assert plan.out_nsamps == 187520 - plan.max_delay
+    ds = plan.delay_samples()
+    assert ds.shape == (59, 64)
+    assert ds[0].max() == 0  # DM=0: no delays
+    assert ds[-1].max() == plan.max_delay
+
+
+class TestAccelPlan:
+    def make(self, lo=-5.0, hi=5.0):
+        return AccelerationPlan(
+            acc_lo=lo, acc_hi=hi, tol=1.10000002384186, pulse_width=64.0,
+            nsamps=131072, tsamp=0.00032, cfreq=1475.12, bw=69.76,
+        )
+
+    def test_zero_range_single_trial(self):
+        plan = self.make(lo=0.0, hi=0.0)
+        np.testing.assert_array_equal(plan.generate_accel_list(0.0), [0.0])
+
+    def test_explicit_zero_first(self):
+        plan = self.make()
+        accs = plan.generate_accel_list(0.0)
+        assert accs[0] == 0.0  # explicitly forced zero (utils.hpp:183-184)
+        assert accs[1] == pytest.approx(-5.0)
+        assert accs[-1] == pytest.approx(5.0)
+
+    def test_step_grows_with_dm(self):
+        plan = self.make()
+        assert plan.step(100.0) > plan.step(0.0)
+        n0 = len(plan.generate_accel_list(0.0))
+        n100 = len(plan.generate_accel_list(100.0))
+        assert n100 <= n0
+
+    def test_walk_covers_range(self):
+        plan = self.make()
+        accs = plan.generate_accel_list(30.0)
+        body = accs[1:]  # drop the prepended 0.0
+        assert np.all(np.diff(body) > 0)
+        step = plan.step(30.0)
+        assert np.all(np.diff(body) <= step * 1.01)
+
+
+def test_prev_power_of_two_quirks():
+    # reference semantics: largest n with 2n < val... i.e. for exact
+    # powers of two the answer halves (utils.hpp:12-18)
+    assert prev_power_of_two(187520) == 131072
+    assert prev_power_of_two(8) == 4
+    assert prev_power_of_two(9) == 8
+    assert prev_power_of_two(3) == 2
+    assert choose_fft_size(187520) == 131072
+    assert choose_fft_size(187520, 65536) == 65536
