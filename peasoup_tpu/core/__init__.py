@@ -1,0 +1,1 @@
+from .candidates import Candidate, CandidateCollection, CANDIDATE_POD_DTYPE

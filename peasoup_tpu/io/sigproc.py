@@ -138,7 +138,7 @@ def read_sigproc_header(stream: BinaryIO) -> SigprocHeader:
 
             warnings.warn(f"read_sigproc_header: unknown parameter {key!r}")
     hdr.size = stream.tell()
-    if hdr.nsamples == 0:
+    if hdr.nsamples == 0 and hdr.nchans > 0 and hdr.nbits > 0:
         pos = stream.tell()
         stream.seek(0, _io.SEEK_END)
         total = stream.tell()

@@ -1,0 +1,10 @@
+from .dedisperse import dedisperse, dedisperse_block
+from .spectrum import form_power, form_interpolated, spectrum_stats, normalise
+from .rednoise import median_scrunch5, linear_stretch, running_median, deredden
+from .zap import birdie_mask, zap_birdies
+from .resample import resample_accel, resample_accel_quadratic, accel_factor
+from .harmonics import harmonic_sums
+from .peaks import find_peaks_device, cluster_peaks
+from .fold import fold_time_series, fold_time_series_np
+from .fold_optimise import FoldOptimiser
+from .coincidence import coincidence_mask

@@ -1,0 +1,21 @@
+"""Multibeam coincidence matching.
+
+Reference: coincidence_kernel counts, per sample, how many beams exceed
+a threshold; the output mask is 1 where fewer than ``beam_thresh``
+beams fired (src/kernels.cu:1073-1100). TPU design: beams live on a
+(possibly sharded) leading axis; the count is a sum over that axis —
+``jax.lax.psum`` over the mesh's beam axis when sharded (see
+peasoup_tpu.parallel.coincidence).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def coincidence_mask(
+    beams: jnp.ndarray, thresh: float, beam_thresh: int
+) -> jnp.ndarray:
+    """beams: (B, N) -> (N,) float mask, 1.0 = keep (not multibeam RFI)."""
+    count = jnp.sum(beams > thresh, axis=0)
+    return (count < beam_thresh).astype(jnp.float32)

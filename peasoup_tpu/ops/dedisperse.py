@@ -1,0 +1,103 @@
+"""Incoherent dedispersion as a batched XLA gather/reduce.
+
+The reference delegates this to the external `dedisp` CUDA library
+(reference: include/transforms/dedisperser.hpp:98-113). TPU-native
+design: the (DM trial, channel) delay table becomes a per-channel
+dynamic-slice of the (time, channel) filterbank, summed over channels —
+one jitted program batched over a DM-trial block, which XLA lowers to
+large fused gathers feeding the VPU. No scalar loops, static shapes.
+
+Output matches the reference's u8 trials when ``quantize=True``
+(dedisp is called with 8-bit output; for <=6-bit inputs with <=64
+channels raw channel sums fit u8 exactly).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("out_nsamps", "quantize", "scale"))
+def dedisperse_block(
+    fil_tc: jax.Array,  # (T, C) uint8/float32 filterbank samples
+    delays: jax.Array,  # (D, C) int32 per-trial per-channel delay in samples
+    killmask: jax.Array,  # (C,) int32/float32, 1 = keep
+    *,
+    out_nsamps: int,
+    quantize: bool = True,
+    scale: float = 1.0,
+) -> jax.Array:
+    """Dedisperse one block of DM trials: out[d, t] = sum_c x[t + delay[d,c], c].
+
+    ``scale`` rescales channel sums into the u8 output range like dedisp's
+    8-bit output mode; use :func:`output_scale` for a data-independent
+    factor (1.0 for the 2-bit golden data, keeping raw-sum parity).
+    Returns (D, out_nsamps) u8 (quantize=True) or f32.
+    """
+    x_ct = fil_tc.astype(jnp.float32).T * killmask.astype(jnp.float32)[:, None]
+
+    def one_channel(row: jax.Array, delay: jax.Array) -> jax.Array:
+        return jax.lax.dynamic_slice_in_dim(row, delay, out_nsamps)
+
+    def one_trial(trial_delays: jax.Array) -> jax.Array:
+        shifted = jax.vmap(one_channel)(x_ct, trial_delays)  # (C, T_out)
+        return shifted.sum(axis=0)
+
+    out = jax.vmap(one_trial)(delays)  # (D, T_out)
+    if scale != 1.0:
+        out = out * jnp.float32(scale)
+    if quantize:
+        out = jnp.clip(jnp.rint(out), 0, 255).astype(jnp.uint8)
+    return out
+
+
+def output_scale(nbits: int, nchans_kept: int) -> float:
+    """Data-independent factor keeping worst-case channel sums inside u8.
+
+    1.0 whenever raw sums already fit (e.g. 2-bit x 64 channels = 192),
+    else shrink so the maximum possible sum maps to 255.
+    """
+    max_sum = (2**nbits - 1) * max(1, nchans_kept)
+    return 1.0 if max_sum <= 255 else 255.0 / max_sum
+
+
+def dedisperse(
+    fil_tc: np.ndarray,
+    delays: np.ndarray,
+    killmask: np.ndarray,
+    out_nsamps: int,
+    *,
+    quantize: bool = True,
+    scale: float = 1.0,
+    block: int = 16,
+) -> np.ndarray:
+    """Host-driving wrapper: dedisperse all DM trials in device-sized blocks.
+
+    Blocks bound peak HBM ((block+1) * T * 4 bytes of working set); the
+    filterbank itself is transferred once.
+    """
+    ndm = delays.shape[0]
+    fil_dev = jnp.asarray(fil_tc)
+    kill_dev = jnp.asarray(killmask)
+    outs = []
+    for start in range(0, ndm, block):
+        d = np.asarray(delays[start : start + block], dtype=np.int32)
+        pad = 0
+        if len(d) < block:  # pad to a fixed block shape to avoid recompiles
+            pad = block - len(d)
+            d = np.pad(d, ((0, pad), (0, 0)))
+        res = dedisperse_block(
+            fil_dev,
+            jnp.asarray(d),
+            kill_dev,
+            out_nsamps=out_nsamps,
+            quantize=quantize,
+            scale=scale,
+        )
+        res = np.asarray(res)
+        outs.append(res[: block - pad] if pad else res)
+    return np.concatenate(outs, axis=0)

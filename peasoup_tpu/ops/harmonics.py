@@ -1,0 +1,48 @@
+"""Incoherent harmonic summing of power spectra.
+
+Reference: harmonic_sum_kernel (src/kernels.cu:33-208) produces, for
+fold level h in 1..5, sum_{k=1..2^h} p[(int)(i*k/2^h + 0.5)] scaled by
+rsqrt(2^h), accumulating across levels (level h reuses level h-1's sum
+and adds only the odd-k/2^h gathers).
+
+TPU design: the reference's float index expression (int)(i*k/2^h + 0.5)
+is EXACT integer math: (i*k + 2^(h-1)) >> h (the double value is exactly
+representable, truncation == floor). We therefore compute gather indices
+with integer ops on-device — bit-identical to the CUDA index map, with
+no f64. Gathers are batched over the accel-trial axis; XLA fuses the
+adds between gathers.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("nharms",))
+def harmonic_sums(p: jnp.ndarray, *, nharms: int = 4) -> list[jnp.ndarray]:
+    """Cumulative fractional-harmonic sums of a spectrum.
+
+    Args:
+      p: (..., nbins) float32 spectrum (normalised).
+      nharms: number of fold levels (<= 5, like the unrolled kernel).
+
+    Returns a list of ``nharms`` arrays shaped like ``p``; entry h-1 is
+    the 2^h-harmonic sum scaled by rsqrt(2^h).
+    """
+    if not 0 < nharms <= 5:
+        raise ValueError("nharms must be in 1..5")
+    nbins = p.shape[-1]
+    i = jnp.arange(nbins, dtype=jnp.int32)
+    out = []
+    val = p
+    for h in range(1, nharms + 1):
+        denom_log2 = h
+        half = 1 << (h - 1)
+        for k in range(1, 1 << h, 2):  # odd numerators only: new this level
+            src = (i * k + half) >> denom_log2
+            val = val + jnp.take(p, src, axis=-1)
+        out.append(val * jnp.float32(2.0 ** (-h / 2.0)))
+    return out

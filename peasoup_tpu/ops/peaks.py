@@ -1,0 +1,94 @@
+"""Candidate peak extraction: device thresholding + host clustering.
+
+Reference splits the same way: device_find_peaks compacts (index, snr)
+pairs above threshold (Thrust copy_if, src/kernels.cu:384-416); the
+host then clusters neighbours within ``min_gap`` bins
+(PeakFinder::identify_unique_peaks, include/transforms/peakfinder.hpp:27-56).
+
+TPU design: copy_if's dynamic output shape is hostile to XLA, so the
+compaction uses jnp.nonzero with a static ``max_peaks`` size (the
+reference hard-codes max_cands=100000 for the same reason,
+peakfinder.hpp:61). Indices come out ascending, which the host
+clustering pass requires. The search-range window [start_idx, limit)
+is applied as part of the mask, mirroring the (min_freq, max_freq)
+windowing in find_candidates (peakfinder.hpp:82-84).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("max_peaks",))
+def find_peaks_device(
+    spec: jnp.ndarray,  # (..., nbins) normalised spectrum or harmonic sum
+    threshold: jnp.ndarray,
+    start_idx: jnp.ndarray,  # scalar or (...,) first bin to consider
+    limit: jnp.ndarray,  # scalar or (...,) one-past-last bin
+    *,
+    max_peaks: int = 4096,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Compact threshold crossings to fixed-size (idx, snr) arrays.
+
+    Returns (indices (..., max_peaks) i32 ascending and padded with
+    nbins, snrs (..., max_peaks) f32, count (...,) i32). ``count`` may
+    exceed ``max_peaks``; callers should treat that as overflow.
+    """
+    nbins = spec.shape[-1]
+    i = jnp.arange(nbins, dtype=jnp.int32)
+
+    def one(s, thr, lo, hi):
+        mask = (i >= lo) & (i < hi) & (s > thr)
+        idxs = jnp.nonzero(mask, size=max_peaks, fill_value=nbins)[0].astype(
+            jnp.int32
+        )
+        snrs = jnp.where(idxs < nbins, s[jnp.clip(idxs, 0, nbins - 1)], 0.0)
+        return idxs, snrs, mask.sum().astype(jnp.int32)
+
+    batch = spec.shape[:-1]
+    if batch:
+        flat = spec.reshape(-1, nbins)
+        thr = jnp.broadcast_to(jnp.asarray(threshold), flat.shape[:1])
+        lo = jnp.broadcast_to(jnp.asarray(start_idx), flat.shape[:1])
+        hi = jnp.broadcast_to(jnp.asarray(limit), flat.shape[:1])
+        idxs, snrs, count = jax.vmap(one)(flat, thr, lo, hi)
+        return (
+            idxs.reshape(*batch, max_peaks),
+            snrs.reshape(*batch, max_peaks),
+            count.reshape(batch),
+        )
+    return one(spec, threshold, start_idx, limit)
+
+
+def cluster_peaks(
+    idxs: np.ndarray, snrs: np.ndarray, count: int, min_gap: int = 30
+) -> tuple[np.ndarray, np.ndarray]:
+    """Exact port of identify_unique_peaks (peakfinder.hpp:27-56).
+
+    Walks ascending indices; within a run where consecutive gaps stay
+    below ``min_gap`` keeps the highest snr. Quirk preserved: ``lastidx``
+    only advances when a higher snr is found, so a slow ramp of weak
+    peaks can terminate a cluster early.
+    """
+    peak_idx = []
+    peak_snr = []
+    ii = 0
+    count = int(min(count, len(idxs)))
+    while ii < count:
+        cpeak = snrs[ii]
+        cpeakidx = idxs[ii]
+        lastidx = idxs[ii]
+        ii += 1
+        while ii < count and (idxs[ii] - lastidx) < min_gap:
+            if snrs[ii] > cpeak:
+                cpeak = snrs[ii]
+                cpeakidx = idxs[ii]
+                lastidx = idxs[ii]
+            ii += 1
+        peak_idx.append(cpeakidx)
+        peak_snr.append(cpeak)
+    return np.asarray(peak_idx, dtype=np.int64), np.asarray(peak_snr, dtype=np.float64)

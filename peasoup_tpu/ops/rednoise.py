@@ -1,0 +1,86 @@
+"""Running-median red-noise estimation and dereddening.
+
+Reference: Heimdall-derived median_scrunch5 / linear_stretch kernels
+(src/kernels.cu:867-1011) composed into a three-scale piecewise median
+spline by Dereddener::calculate_median
+(include/transforms/dereddener.hpp:41-62); the complex spectrum is then
+divided by the median with the first five bins zeroed
+(kernels.cu:1013-1034).
+
+TPU design: median-of-5 is a reshape + small sort along a unit axis
+(vectorises on the VPU); the linear stretch is a gather + lerp. All
+batched over leading axes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def median_scrunch5(x: jnp.ndarray) -> jnp.ndarray:
+    """Median of non-overlapping blocks of 5 along the last axis.
+
+    Truncates the tail like the reference (kernels.cu:972-979). For
+    inputs shorter than 5 the reference degenerates to mean/median of
+    what is there (kernels.cu:954-970).
+    """
+    n = x.shape[-1]
+    if n == 1:
+        return x
+    if n == 2:
+        return jnp.mean(x, axis=-1, keepdims=True)
+    if n in (3, 4):
+        # median4 averages the two central values; jnp.median does too.
+        return jnp.median(x[..., :n], axis=-1, keepdims=True)
+    m = n // 5
+    blocks = x[..., : m * 5].reshape(*x.shape[:-1], m, 5)
+    return jnp.median(blocks, axis=-1)
+
+
+def linear_stretch(x: jnp.ndarray, out_count: int) -> jnp.ndarray:
+    """Linear interpolation of the last axis up to ``out_count`` points.
+
+    Matches linear_stretch_functor (kernels.cu:983-996): step is
+    (in_count-1)/(out_count-1); fractional parts below 1e-5 snap to the
+    left sample.
+    """
+    in_count = x.shape[-1]
+    step = jnp.float32(in_count - 1) / jnp.float32(out_count - 1)
+    pos = jnp.arange(out_count, dtype=jnp.float32) * step
+    j = pos.astype(jnp.int32)  # floor for non-negative
+    frac = pos - j.astype(jnp.float32)
+    j1 = jnp.minimum(j + 1, in_count - 1)
+    left = jnp.take(x, j, axis=-1)
+    right = jnp.take(x, j1, axis=-1)
+    return jnp.where(frac > 1e-5, left + frac * (right - left), left)
+
+
+@partial(jax.jit, static_argnames=("pos5", "pos25"))
+def running_median(powers: jnp.ndarray, *, pos5: int, pos25: int) -> jnp.ndarray:
+    """Three-scale running median of an amplitude spectrum.
+
+    Splices stretched medians of block size 5/25/125: bins [0,pos5) from
+    the x5 median, [pos5,pos25) from x25, [pos25,end) from x125
+    (dereddener.hpp:41-62). ``pos5``/``pos25`` are the bin positions of
+    the boundary frequencies (0.05 Hz and 0.5 Hz by default).
+    """
+    size = powers.shape[-1]
+    med5 = median_scrunch5(powers)
+    med25 = median_scrunch5(med5)
+    med125 = median_scrunch5(med25)
+    s5 = linear_stretch(med5, size)
+    s25 = linear_stretch(med25, size)
+    s125 = linear_stretch(med125, size)
+    idx = jnp.arange(size)
+    return jnp.where(idx < pos5, s5, jnp.where(idx < pos25, s25, s125))
+
+
+def deredden(fseries: jnp.ndarray, median: jnp.ndarray) -> jnp.ndarray:
+    """Divide the complex spectrum by the running median; zero bins 0-4
+    (kernels.cu:1013-1023)."""
+    out = fseries / median.astype(fseries.real.dtype)
+    idx = jnp.arange(fseries.shape[-1])
+    return jnp.where(idx < 5, 0.0 + 0.0j, out)

@@ -1,0 +1,67 @@
+"""Time-domain acceleration resampling as an index-map gather.
+
+Reference kernels: resample_kernelII (the search pipeline's version,
+out[i] = in[rn(i + i*af*(i-N))], src/kernels.cu:314-346) and the
+quadratic resample_kernel (used by the candidate folder,
+out[i] = in[rn(i + af*((i-N/2)^2-(N/2)^2))], kernels.cu:308-332), with
+af = a*tsamp/(2c) computed in f64 (kernels.cu:354).
+
+TPU design: the reference does the index math per element in f64; TPU
+f64 is emulated and slow, so we exploit that the output index is
+integer + small shift: rn(i + s) == i + rn(s) for integer i away from
+half-sample ties, and the shift s = af*i*(i-N) is computed accurately
+in f32 because i and (i-N) are exactly representable (|i| < 2^24) and
+af is tiny. Worst-case f32 error in s is ~1e-5 samples — tie-breaking
+differences only. Batched over a leading axis of accelerations: one
+gather per (accel, sample) tile, MXU-free but VPU/HBM friendly.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+SPEED_OF_LIGHT = 299792458.0
+
+
+def accel_factor(accs: np.ndarray, tsamp: float) -> np.ndarray:
+    """af = a * tsamp / (2c) in f64 on the host (kernels.cu:354)."""
+    return np.asarray(accs, dtype=np.float64) * tsamp / (2.0 * SPEED_OF_LIGHT)
+
+
+@jax.jit
+def resample_accel(x: jnp.ndarray, afs: jnp.ndarray) -> jnp.ndarray:
+    """Resample a time series for each acceleration factor.
+
+    Args:
+      x: (N,) float32 time series.
+      afs: (A,) float32 acceleration factors (a*tsamp/2c).
+
+    Returns (A, N): out[a, i] = x[i + rint(afs[a]*i*(i-N))].
+    """
+    n = x.shape[-1]
+    idx = jnp.arange(n, dtype=jnp.float32)
+    quad = idx * (idx - jnp.float32(n))  # exact inputs, one f32 rounding
+
+    def one(af: jnp.ndarray) -> jnp.ndarray:
+        shift = jnp.rint(af * quad).astype(jnp.int32)
+        src = jnp.clip(jnp.arange(n, dtype=jnp.int32) + shift, 0, n - 1)
+        return jnp.take(x, src)
+
+    return jax.vmap(one)(afs)
+
+
+@jax.jit
+def resample_accel_quadratic(x: jnp.ndarray, af: jnp.ndarray) -> jnp.ndarray:
+    """The folder's variant: out[i] = x[i + rint(af*((i-N/2)^2-(N/2)^2))]
+    (kernels.cu:308-332)."""
+    n = x.shape[-1]
+    half = jnp.float32(n) / 2.0
+    idx = jnp.arange(n, dtype=jnp.float32)
+    quad = (idx - half) ** 2 - half * half
+    shift = jnp.rint(af * quad).astype(jnp.int32)
+    src = jnp.clip(jnp.arange(n, dtype=jnp.int32) + shift, 0, n - 1)
+    return jnp.take(x, src)

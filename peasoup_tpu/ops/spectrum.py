@@ -1,0 +1,54 @@
+"""Power-spectrum forming, statistics and normalisation.
+
+Reference kernels: power_series_kernel (amplitude via z*rsqrt(z)) and
+bin_interbin_series_kernel (Fourier interpolation by nearest-bin
+difference), src/kernels.cu:215-304; stats/normalise kernels
+src/kernels.cu:420-494 and include/utils/stats.hpp.
+
+All functions are pure jnp, batched over leading axes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def form_power(fseries: jnp.ndarray) -> jnp.ndarray:
+    """Amplitude spectrum |X_k| (the reference's "power series").
+
+    The reference computes z*rsqrt(z) = sqrt(z) with z = re^2+im^2
+    (kernels.cu:223-224); jnp.abs is the same quantity without the
+    z=0 -> NaN hazard of rsqrt.
+    """
+    return jnp.abs(fseries).astype(jnp.float32)
+
+
+def form_interpolated(fseries: jnp.ndarray) -> jnp.ndarray:
+    """Interbinned amplitude: sqrt(max(|X_k|^2, 0.5|X_k - X_{k-1}|^2)).
+
+    Recovers power for signals midway between Fourier bins
+    (kernels.cu:231-252). X_{-1} is taken as 0 like the kernel's idx==0
+    branch. Operates along the last axis.
+    """
+    re = jnp.real(fseries).astype(jnp.float32)
+    im = jnp.imag(fseries).astype(jnp.float32)
+    re_l = jnp.concatenate([jnp.zeros_like(re[..., :1]), re[..., :-1]], axis=-1)
+    im_l = jnp.concatenate([jnp.zeros_like(im[..., :1]), im[..., :-1]], axis=-1)
+    ampsq = re * re + im * im
+    ampsq_diff = 0.5 * ((re - re_l) ** 2 + (im - im_l) ** 2)
+    return jnp.sqrt(jnp.maximum(ampsq, ampsq_diff))
+
+
+def spectrum_stats(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(mean, rms, std) over the last axis; std = sqrt(rms^2 - mean^2)
+    (stats.hpp:20-23)."""
+    n = x.shape[-1]
+    mean = jnp.sum(x, axis=-1) / n
+    rms = jnp.sqrt(jnp.sum(x * x, axis=-1) / n)
+    std = jnp.sqrt(rms * rms - mean * mean)
+    return mean, rms, std
+
+
+def normalise(x: jnp.ndarray, mean: jnp.ndarray, std: jnp.ndarray) -> jnp.ndarray:
+    """(x - mean) / std with broadcasting (kernels.cu:469-494)."""
+    return (x - mean[..., None]) / std[..., None]

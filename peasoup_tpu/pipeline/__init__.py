@@ -1,0 +1,4 @@
+from .distill import HarmonicDistiller, AccelerationDistiller, DMDistiller
+from .score import CandidateScorer
+from .search import SearchConfig, PeasoupSearch
+from .folder import MultiFolder

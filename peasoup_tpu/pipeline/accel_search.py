@@ -1,0 +1,111 @@
+"""The per-DM-trial acceleration-search device program.
+
+This is the TPU replacement for the reference's hot loop
+(Worker::start, src/pipeline_multi.cu:144-243): where the CUDA code
+runs one FFT/spectrum/harmonic/peak pass per acceleration trial, here
+the WHOLE acceleration batch for a DM trial is one jitted array
+program — resampling is a (A, N) gather, the FFT is one batched rfft,
+and peak extraction is a masked static-size compaction per harmonic
+level. Python never touches per-trial spectra.
+
+Stages (reference line refs in parentheses):
+  pad/truncate (pipeline_multi.cu:112-114,160-163) -> rfft (174) ->
+  |.| (178) -> running median (182) -> deredden (186) -> zap (188-192)
+  -> interbin + stats (196-200) -> irfft (204) -> per-accel: resample
+  (212), rfft (216), interbin (220), normalise (224), harmonic sums
+  (228), peak extraction (233-234).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.harmonics import harmonic_sums
+from ..ops.peaks import find_peaks_device
+from ..ops.rednoise import deredden, running_median
+from ..ops.resample import resample_accel
+from ..ops.spectrum import form_interpolated, form_power, normalise, spectrum_stats
+from ..ops.zap import zap_birdies
+
+
+class AccelSearchPeaks(NamedTuple):
+    """Static-size peak sets for one DM trial.
+
+    idxs/snrs: (nharms+1, A, max_peaks) — level 0 is the fundamental
+    spectrum, level h the 2^h-harmonic sum. counts: (nharms+1, A).
+    """
+
+    idxs: jax.Array
+    snrs: jax.Array
+    counts: jax.Array
+
+
+def make_search_fn(threshold: float):
+    """Build the jitted per-DM-trial program with the S/N threshold
+    bound statically (it never changes within a run)."""
+
+    @partial(
+        jax.jit,
+        static_argnames=("size", "nsamps_valid", "nharms", "max_peaks", "pos5",
+                         "pos25"),
+    )
+    def search_dm_trial(
+        tim: jax.Array,  # (>=size,) u8/f32 dedispersed time series
+        afs: jax.Array,  # (A,) f32 acceleration factors a*tsamp/2c (padded)
+        zapmask: jax.Array,  # (size//2+1,) bool birdie mask
+        windows: jax.Array,  # (nharms+1, 2) i32 [start_idx, limit) per level
+        *,
+        size: int,
+        nsamps_valid: int,
+        nharms: int,
+        max_peaks: int,
+        pos5: int,
+        pos25: int,
+    ) -> AccelSearchPeaks:
+        # --- once per DM trial --------------------------------------------
+        x = tim[:size].astype(jnp.float32)
+        if nsamps_valid < size:
+            # mean-pad the tail like the reference (pipeline_multi.cu:160-163);
+            # the input trial may be shorter than size, so pad to shape first
+            x = jnp.pad(x, (0, size - x.shape[0]))
+            mean_head = jnp.mean(x[:nsamps_valid])
+            idx = jnp.arange(size)
+            x = jnp.where(idx < nsamps_valid, x, mean_head)
+        fser = jnp.fft.rfft(x)
+        p0 = form_power(fser)
+        med = running_median(p0, pos5=pos5, pos25=pos25)
+        fser = deredden(fser, med)
+        fser = zap_birdies(fser, zapmask)
+        s0 = form_interpolated(fser)
+        mean, _, std = spectrum_stats(s0)
+        xd = jnp.fft.irfft(fser, n=size)
+
+        # --- batched over acceleration trials -----------------------------
+        xr = resample_accel(xd, afs)  # (A, size)
+        fr = jnp.fft.rfft(xr, axis=-1)  # (A, size//2+1)
+        s = form_interpolated(fr)
+        s = normalise(s, mean[None], std[None])
+        sums = harmonic_sums(s, nharms=nharms)
+        levels = [s] + sums
+
+        idxs, snrs, counts = [], [], []
+        for lvl, spec in enumerate(levels):
+            i_, s_, c_ = find_peaks_device(
+                spec,
+                jnp.float32(threshold),
+                windows[lvl, 0],
+                windows[lvl, 1],
+                max_peaks=max_peaks,
+            )
+            idxs.append(i_)
+            snrs.append(s_)
+            counts.append(c_)
+        return AccelSearchPeaks(
+            idxs=jnp.stack(idxs), snrs=jnp.stack(snrs), counts=jnp.stack(counts)
+        )
+
+    return search_dm_trial

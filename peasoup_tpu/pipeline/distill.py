@@ -1,0 +1,140 @@
+"""Candidate distillers: collapse harmonically/accelerationally/DM-related
+detections onto their strongest member.
+
+Reference: include/transforms/distiller.hpp. The algorithm sorts by S/N
+descending (!IMPORTANT, distiller.hpp:31), then walks survivors in
+order; each survivor's ``condition`` marks weaker related candidates
+non-unique and (optionally) absorbs them into its ``assoc`` list.
+
+Host-side by design: candidate counts are tiny relative to device work,
+and the O(n^2) inner loops vectorise over numpy arrays here (the native
+C++ path in peasoup_tpu.native accelerates the worst case).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ..core.candidates import Candidate
+
+SPEED_OF_LIGHT = 299792458.0
+
+
+class BaseDistiller:
+    def __init__(self, keep_related: bool):
+        self.keep_related = keep_related
+
+    def condition(self, cands, idx, unique) -> None:
+        raise NotImplementedError
+
+    def distill(self, cands: List[Candidate]) -> List[Candidate]:
+        size = len(cands)
+        cands = sorted(cands, key=lambda c: -c.snr)  # S/N desc, stable
+        unique = np.ones(size, dtype=bool)
+        idx = 0
+        while idx < size:
+            if unique[idx]:
+                self.condition(cands, idx, unique)
+            idx += 1
+        return [c for c, u in zip(cands, unique) if u]
+
+
+class HarmonicDistiller(BaseDistiller):
+    """Absorb candidates whose freq is a (fractional) harmonic of a
+    stronger one (distiller.hpp:63-108)."""
+
+    def __init__(self, tol: float, max_harm: int, keep_related: bool,
+                 fractional_harms: bool = True):
+        super().__init__(keep_related)
+        self.tolerance = tol
+        self.max_harm = int(max_harm)
+        self.fractional_harms = fractional_harms
+
+    def condition(self, cands, idx, unique) -> None:
+        size = len(cands)
+        if idx + 1 >= size:
+            return
+        fundi = cands[idx].freq
+        freqs = np.array([c.freq for c in cands[idx + 1 :]])
+        nhs = np.array([c.nh for c in cands[idx + 1 :]])
+        # hits counts matching (jj, kk) harmonic pairs per candidate: the
+        # reference appends to assoc once PER MATCHING PAIR
+        # (distiller.hpp:92-101), which feeds nassoc and the ddm ratios.
+        hits = np.zeros(len(freqs), dtype=np.int64)
+        if self.fractional_harms:
+            max_denoms = (2.0 ** nhs).astype(int)
+        else:
+            max_denoms = np.ones(len(freqs), dtype=int)
+        for jj in range(1, self.max_harm + 1):
+            for kk in range(1, int(max_denoms.max()) + 1):
+                valid = kk <= max_denoms
+                ratio = kk * freqs / (jj * fundi)
+                hits += (
+                    valid & (ratio > 1 - self.tolerance) & (ratio < 1 + self.tolerance)
+                )
+        for off in np.nonzero(hits)[0]:
+            target = idx + 1 + off
+            if self.keep_related:
+                for _ in range(int(hits[off])):
+                    cands[idx].append(cands[target])
+            unique[target] = False
+
+
+class AccelerationDistiller(BaseDistiller):
+    """Absorb candidates within the frequency window swept by the
+    acceleration difference (distiller.hpp:115-164).
+    Note: +ve acceleration is away from the observer."""
+
+    def __init__(self, tobs: float, tol: float, keep_related: bool):
+        super().__init__(keep_related)
+        self.tobs = tobs
+        self.tobs_over_c = tobs / SPEED_OF_LIGHT
+        self.tolerance = tol
+
+    def condition(self, cands, idx, unique) -> None:
+        size = len(cands)
+        if idx + 1 >= size:
+            return
+        fundi_freq = cands[idx].freq
+        fundi_acc = cands[idx].acc
+        edge = fundi_freq * self.tolerance
+        freqs = np.array([c.freq for c in cands[idx + 1 :]])
+        accs = np.array([c.acc for c in cands[idx + 1 :]])
+        delta_acc = fundi_acc - accs
+        acc_freq = fundi_freq + delta_acc * fundi_freq * self.tobs_over_c
+        upper_case = acc_freq > fundi_freq
+        hit = np.where(
+            upper_case,
+            (freqs > fundi_freq - edge) & (freqs < acc_freq + edge),
+            (freqs < fundi_freq + edge) & (freqs > acc_freq - edge),
+        )
+        for off in np.nonzero(hit)[0]:
+            target = idx + 1 + off
+            if self.keep_related:
+                cands[idx].append(cands[target])
+            unique[target] = False
+
+
+class DMDistiller(BaseDistiller):
+    """Plain frequency-ratio matching across DM trials
+    (distiller.hpp:168-197)."""
+
+    def __init__(self, tol: float, keep_related: bool):
+        super().__init__(keep_related)
+        self.tolerance = tol
+
+    def condition(self, cands, idx, unique) -> None:
+        size = len(cands)
+        if idx + 1 >= size:
+            return
+        fundi = cands[idx].freq
+        freqs = np.array([c.freq for c in cands[idx + 1 :]])
+        ratio = freqs / fundi
+        hit = (ratio > 1 - self.tolerance) & (ratio < 1 + self.tolerance)
+        for off in np.nonzero(hit)[0]:
+            target = idx + 1 + off
+            if self.keep_related:
+                cands[idx].append(cands[target])
+            unique[target] = False

@@ -12,7 +12,16 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU for tests even if the ambient env selects a TPU platform:
+# numeric op tests must be deterministic and mesh tests need 8 devices.
+# The axon sitecustomize overrides jax_platforms via jax.config at
+# interpreter start, so the env var alone is not enough — override the
+# config again before any backend initialises.
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

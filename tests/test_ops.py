@@ -1,0 +1,412 @@
+"""Per-op numeric tests against tiny NumPy oracles (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from peasoup_tpu.ops import (
+    dedisperse,
+    dedisperse_block,
+    form_power,
+    form_interpolated,
+    spectrum_stats,
+    normalise,
+    median_scrunch5,
+    linear_stretch,
+    running_median,
+    deredden,
+    birdie_mask,
+    zap_birdies,
+    resample_accel,
+    resample_accel_quadratic,
+    accel_factor,
+    harmonic_sums,
+    find_peaks_device,
+    cluster_peaks,
+    fold_time_series,
+    fold_time_series_np,
+    coincidence_mask,
+)
+from peasoup_tpu.ops.fold import fold_bins_np
+from peasoup_tpu.ops.fold_optimise import FoldOptimiser, calculate_sn
+
+
+class TestSpectrum:
+    def test_form_power(self, rng):
+        z = (rng.normal(size=64) + 1j * rng.normal(size=64)).astype(np.complex64)
+        out = np.asarray(form_power(jnp.asarray(z)))
+        np.testing.assert_allclose(out, np.abs(z), rtol=1e-6)
+
+    def test_form_interpolated_oracle(self, rng):
+        z = (rng.normal(size=64) + 1j * rng.normal(size=64)).astype(np.complex64)
+        out = np.asarray(form_interpolated(jnp.asarray(z)))
+        zl = np.concatenate([[0.0 + 0j], z[:-1]])
+        oracle = np.sqrt(np.maximum(np.abs(z) ** 2, 0.5 * np.abs(z - zl) ** 2))
+        np.testing.assert_allclose(out, oracle, rtol=1e-5)
+
+    def test_form_interpolated_batched(self, rng):
+        z = (rng.normal(size=(3, 32)) + 1j * rng.normal(size=(3, 32))).astype(
+            np.complex64
+        )
+        out = np.asarray(form_interpolated(jnp.asarray(z)))
+        assert out.shape == (3, 32)
+
+    def test_stats_and_normalise(self, rng):
+        x = rng.normal(loc=5.0, scale=2.0, size=4096).astype(np.float32)
+        mean, rms, std = spectrum_stats(jnp.asarray(x))
+        assert float(mean) == pytest.approx(x.mean(), rel=1e-5)
+        assert float(rms) == pytest.approx(np.sqrt((x.astype(np.float64)**2).mean()), rel=1e-5)
+        assert float(std) == pytest.approx(x.std(), rel=1e-3)
+        out = np.asarray(normalise(jnp.asarray(x), mean, std))
+        assert abs(out.mean()) < 1e-3
+        assert out.std() == pytest.approx(1.0, rel=1e-3)
+
+
+class TestRednoise:
+    def test_median_scrunch5_oracle(self, rng):
+        x = rng.normal(size=100).astype(np.float32)
+        out = np.asarray(median_scrunch5(jnp.asarray(x)))
+        oracle = np.median(x.reshape(20, 5), axis=-1)
+        np.testing.assert_allclose(out, oracle, rtol=1e-6)
+
+    def test_median_scrunch5_truncates(self, rng):
+        x = rng.normal(size=103).astype(np.float32)
+        out = np.asarray(median_scrunch5(jnp.asarray(x)))
+        assert out.shape == (20,)  # tail of 3 ignored (kernels.cu:972-973)
+
+    def test_linear_stretch_oracle(self):
+        x = np.array([0.0, 1.0, 4.0, 9.0], dtype=np.float32)
+        out = np.asarray(linear_stretch(jnp.asarray(x), 7))
+        step = 3.0 / 6.0
+        oracle = []
+        for i in range(7):
+            pos = i * step
+            j = int(pos)
+            frac = pos - j
+            if frac > 1e-5:
+                oracle.append(x[j] + frac * (x[j + 1] - x[j]))
+            else:
+                oracle.append(x[j])
+        np.testing.assert_allclose(out, oracle, rtol=1e-6)
+
+    def test_running_median_flat_spectrum(self, rng):
+        # a flat(ish) spectrum should produce a median near its level
+        x = rng.normal(loc=10.0, scale=0.1, size=5**4).astype(np.float32)
+        med = np.asarray(running_median(jnp.asarray(x), pos5=20, pos25=100))
+        assert med.shape == x.shape
+        np.testing.assert_allclose(med, 10.0, atol=0.5)
+
+    def test_deredden_zeroes_first_bins(self, rng):
+        z = (rng.normal(size=32) + 1j * rng.normal(size=32)).astype(np.complex64)
+        med = np.full(32, 2.0, dtype=np.float32)
+        out = np.asarray(deredden(jnp.asarray(z), jnp.asarray(med)))
+        np.testing.assert_array_equal(out[:5], 0.0)
+        np.testing.assert_allclose(out[5:], z[5:] / 2.0, rtol=1e-6)
+
+    def test_running_median_tracks_red_noise(self, rng):
+        # red-noise-like 1/f ramp: median should follow the ramp closely
+        n = 5**5
+        ramp = (1.0 + 100.0 / (np.arange(n) + 10)).astype(np.float32)
+        noise = rng.normal(loc=1.0, scale=0.02, size=n).astype(np.float32)
+        x = ramp * noise
+        med = np.asarray(running_median(jnp.asarray(x), pos5=50, pos25=500))
+        sel = slice(10, n - 200)  # away from edges
+        np.testing.assert_allclose(med[sel] / ramp[sel], 1.0, atol=0.15)
+
+
+class TestZap:
+    def test_birdie_mask_ranges(self):
+        mask = birdie_mask(np.array([10.0]), np.array([1.0]), 1.0, 64)
+        # bins [floor(9), ceil(11)) = [9, 11)
+        assert mask[9] and mask[10] and not mask[11] and not mask[8]
+
+    def test_birdie_mask_clip_top_quirk(self):
+        # clipped at the top: high becomes nbins-1, half-open range stops
+        # at nbins-2 (kernels.cu:1054-1056)
+        mask = birdie_mask(np.array([63.5]), np.array([5.0]), 1.0, 64)
+        assert mask[62] and not mask[63]
+
+    def test_zap_birdies(self, rng):
+        z = (rng.normal(size=16) + 1j * rng.normal(size=16)).astype(np.complex64)
+        mask = np.zeros(16, dtype=bool)
+        mask[3:6] = True
+        out = np.asarray(zap_birdies(jnp.asarray(z), jnp.asarray(mask)))
+        np.testing.assert_array_equal(out[3:6], 1.0 + 0.0j)
+        np.testing.assert_array_equal(out[~mask], z[~mask])
+
+
+class TestResample:
+    def test_zero_accel_identity(self, rng):
+        x = rng.normal(size=1024).astype(np.float32)
+        out = np.asarray(resample_accel(jnp.asarray(x), jnp.zeros(1, np.float32)))
+        np.testing.assert_array_equal(out[0], x)
+
+    def test_matches_f64_oracle(self, rng):
+        n = 4096
+        x = (np.arange(n) % 451).astype(np.float32)  # reference test pattern
+        for a in (125.5, -125.5, 10.0):
+            af = accel_factor(np.array([a]), tsamp=0.000064)
+            out = np.asarray(
+                resample_accel(jnp.asarray(x), jnp.asarray(af, dtype=jnp.float32))
+            )[0]
+            idx = np.arange(n, dtype=np.float64)
+            src = np.rint(idx + af[0] * idx * (idx - n)).astype(np.int64)
+            src = np.clip(src, 0, n - 1)
+            oracle = x[src]
+            # f32 index math may differ from f64 at round-to-half ties only
+            mismatches = np.mean(out != oracle)
+            assert mismatches < 1e-3
+
+    def test_large_accel_visible_shift(self):
+        n = 1 << 16
+        x = np.zeros(n, dtype=np.float32)
+        x[n // 2] = 1.0
+        af = np.array([2e-9])  # shift at midpoint = af*n^2/4 ~ 2.1 samples
+        out = np.asarray(
+            resample_accel(jnp.asarray(x), jnp.asarray(af, dtype=jnp.float32))
+        )[0]
+        idx = np.arange(n, dtype=np.float64)
+        src = np.clip(np.rint(idx + af[0] * idx * (idx - n)), 0, n - 1).astype(int)
+        oracle = x[src]
+        np.testing.assert_array_equal(out, oracle)
+        assert out[n // 2] == 0.0  # midpoint now reads ~2 samples ahead
+        assert out.sum() >= 1.0
+
+    def test_quadratic_variant_zero_at_midpoint_shift(self, rng):
+        n = 1024
+        x = rng.normal(size=n).astype(np.float32)
+        out = np.asarray(
+            resample_accel_quadratic(jnp.asarray(x), jnp.float32(0.0))
+        )
+        np.testing.assert_array_equal(out, x)
+
+
+class TestHarmonics:
+    @staticmethod
+    def oracle(p, nharms):
+        n = len(p)
+        outs = []
+        val = p.astype(np.float64).copy()
+        for h in range(1, nharms + 1):
+            for k in range(1, 2 ** h, 2):
+                idx = (np.arange(n) * k + 2 ** (h - 1)) >> h
+                val = val + p[idx]
+            outs.append(val * 2.0 ** (-h / 2.0))
+        return outs
+
+    def test_matches_float_index_oracle(self, rng):
+        p = rng.normal(size=1000).astype(np.float32)
+        outs = harmonic_sums(jnp.asarray(p), nharms=5)
+        # cross-check integer index map == float index map of the kernel
+        n = len(p)
+        for h in range(1, 6):
+            for k in range(1, 2 ** h, 2):
+                int_idx = (np.arange(n) * k + 2 ** (h - 1)) >> h
+                float_idx = (np.arange(n) * (k / 2 ** h) + 0.5).astype(np.int64)
+                np.testing.assert_array_equal(int_idx, float_idx)
+        oracles = self.oracle(p, 5)
+        for out, oracle in zip(outs, oracles):
+            # f32 accumulation vs f64 oracle
+            np.testing.assert_allclose(np.asarray(out), oracle, rtol=1e-4, atol=1e-5)
+
+    def test_impulse_train_gains(self):
+        # fundamental at bin 512 with harmonics at 256, 128, ...: the
+        # harmonic sum at the fundamental grows as expected
+        p = np.zeros(1024, dtype=np.float32)
+        for b in (512, 256, 128, 64, 32):
+            p[b] = 1.0
+        outs = harmonic_sums(jnp.asarray(p), nharms=4)
+        assert float(outs[0][512]) == pytest.approx(2 / np.sqrt(2))
+        assert float(outs[3][512]) == pytest.approx(5 / 4.0)
+
+    def test_batched(self, rng):
+        p = rng.normal(size=(3, 256)).astype(np.float32)
+        outs = harmonic_sums(jnp.asarray(p), nharms=2)
+        assert outs[0].shape == (3, 256)
+        single = harmonic_sums(jnp.asarray(p[1]), nharms=2)
+        np.testing.assert_allclose(np.asarray(outs[0][1]), np.asarray(single[0]))
+
+
+class TestPeaks:
+    def test_device_compaction(self):
+        spec = np.zeros(256, dtype=np.float32)
+        spec[[10, 50, 51, 200]] = [5.0, 7.0, 6.0, 9.0]
+        idxs, snrs, count = find_peaks_device(
+            jnp.asarray(spec), 4.0, 0, 256, max_peaks=16
+        )
+        idxs, snrs = np.asarray(idxs), np.asarray(snrs)
+        assert int(count) == 4
+        np.testing.assert_array_equal(idxs[:4], [10, 50, 51, 200])
+        np.testing.assert_allclose(snrs[:4], [5.0, 7.0, 6.0, 9.0])
+        assert np.all(idxs[4:] == 256)
+
+    def test_window_applied(self):
+        spec = np.full(128, 10.0, dtype=np.float32)
+        idxs, snrs, count = find_peaks_device(
+            jnp.asarray(spec), 4.0, 30, 40, max_peaks=32
+        )
+        assert int(count) == 10
+        np.testing.assert_array_equal(np.asarray(idxs)[:10], np.arange(30, 40))
+
+    def test_cluster_semantics(self):
+        # two clusters: [100 (snr 5), 110 (snr 8), 120 (snr 6)], [200]
+        idxs = np.array([100, 110, 120, 200])
+        snrs = np.array([5.0, 8.0, 6.0, 7.0])
+        pi, ps = cluster_peaks(idxs, snrs, 4, min_gap=30)
+        np.testing.assert_array_equal(pi, [110, 200])
+        np.testing.assert_allclose(ps, [8.0, 7.0])
+
+    def test_cluster_lastidx_quirk(self):
+        # lastidx only advances on a new max: 0(5), 20(4), 40(3) ->
+        # 40-0 >= 30 breaks the cluster even though 40-20 < 30
+        idxs = np.array([0, 20, 40])
+        snrs = np.array([5.0, 4.0, 3.0])
+        pi, ps = cluster_peaks(idxs, snrs, 3, min_gap=30)
+        np.testing.assert_array_equal(pi, [0, 40])
+
+    def test_batched_shapes(self, rng):
+        spec = rng.normal(size=(4, 5, 128)).astype(np.float32)
+        idxs, snrs, count = find_peaks_device(
+            jnp.asarray(spec), 2.0, 0, 128, max_peaks=64
+        )
+        assert idxs.shape == (4, 5, 64)
+        assert count.shape == (4, 5)
+
+
+class TestDedisperse:
+    def test_realigns_dispersed_impulse(self):
+        t, c, true_delay = 256, 8, 4
+        fil = np.zeros((t, c), dtype=np.uint8)
+        t0 = 100
+        for ch in range(c):
+            fil[t0 + ch * true_delay // 2, ch] = 3  # linear-ish sweep
+        delays = np.array(
+            [[ch * true_delay // 2 for ch in range(c)]], dtype=np.int32
+        )
+        out = np.asarray(
+            dedisperse_block(
+                jnp.asarray(fil),
+                jnp.asarray(delays),
+                jnp.ones(c, jnp.int32),
+                out_nsamps=t - int(delays.max()),
+            )
+        )
+        assert out.shape == (1, t - delays.max())
+        assert out[0, t0] == 3 * c  # all channels realigned
+        assert (out[0] > 0).sum() <= c  # everything else near-empty
+
+    def test_killmask(self):
+        t, c = 64, 4
+        fil = np.ones((t, c), dtype=np.uint8)
+        kill = np.array([1, 0, 1, 0], dtype=np.int32)
+        out = np.asarray(
+            dedisperse_block(
+                jnp.asarray(fil),
+                jnp.zeros((1, c), jnp.int32),
+                jnp.asarray(kill),
+                out_nsamps=t,
+            )
+        )
+        np.testing.assert_array_equal(out[0], 2)
+
+    def test_blocked_host_wrapper_matches(self, rng):
+        t, c, d = 128, 8, 7
+        fil = rng.integers(0, 4, size=(t, c)).astype(np.uint8)
+        delays = rng.integers(0, 16, size=(d, c)).astype(np.int32)
+        out_nsamps = t - int(delays.max())
+        got = dedisperse(fil, delays, np.ones(c, np.int32), out_nsamps, block=3)
+        oracle = np.zeros((d, out_nsamps))
+        for di in range(d):
+            for ch in range(c):
+                oracle[di] += fil[delays[di, ch] : delays[di, ch] + out_nsamps, ch]
+        np.testing.assert_array_equal(got, np.clip(np.rint(oracle), 0, 255))
+
+
+class TestFold:
+    def test_matches_np_oracle(self, rng):
+        n, nbins, nints = 4096, 32, 8
+        x = rng.normal(size=n).astype(np.float32)
+        period, tsamp = 0.025, 0.000064
+        oracle = fold_time_series_np(x, n, tsamp, period, nbins, nints)
+        flat = fold_bins_np(n, tsamp, period, nbins, nints)
+        out = np.asarray(
+            fold_time_series(
+                jnp.asarray(x[: len(flat)]), jnp.asarray(flat), nbins=nbins, nints=nints
+            )
+        )
+        np.testing.assert_allclose(out, oracle, rtol=1e-4)
+
+    def test_count_bias(self):
+        # constant input: output = sum/(hits+1) = hits/(hits+1) != 1
+        n, nbins, nints = 1024, 16, 4
+        x = np.ones(n, dtype=np.float32)
+        out = fold_time_series_np(x, n, 0.000064, 0.001024, nbins, nints)
+        assert np.all(out < 1.0)
+        assert np.all(out > 0.5)
+
+    def test_recovers_pulse_phase(self):
+        n, nbins, nints = 1 << 15, 64, 8
+        tsamp, period = 0.000064, 0.004096
+        t = np.arange(n) * tsamp
+        phase = (t / period) % 1.0
+        x = (np.abs(phase - 0.25) < 0.02).astype(np.float32) * 10.0
+        prof = fold_time_series_np(x, n, tsamp, period, nbins, nints).mean(axis=0)
+        assert abs(int(np.argmax(prof)) - 16) <= 1  # 0.25 phase -> bin 16
+
+
+class TestFoldOptimise:
+    def make_fold(self, nbins=64, nints=16, drift_bins=6.0, width=4):
+        """Pulse at drifting phase across subints (a slightly-wrong period)."""
+        rng = np.random.default_rng(0)
+        folds = rng.normal(0.0, 0.1, size=(nints, nbins)).astype(np.float32)
+        for i in range(nints):
+            centre = int(20 + drift_bins * i / nints) % nbins
+            for b in range(centre - width // 2, centre + width // 2 + 1):
+                folds[i, b % nbins] += 5.0
+        return folds
+
+    def test_recovers_drift(self):
+        opt = FoldOptimiser(64, 16)
+        folds = self.make_fold(drift_bins=6.0)
+        res = opt.optimise(folds[None], np.array([0.25]), tobs=41.94)[0]
+        # drift of +6 bins over the fold -> optimal shift magnitude ~6 from
+        # centre (32); period correction must move away from p
+        assert res["opt_sn"] > 10
+        assert abs((32 - res["opt_shift"])) in range(4, 9)
+        assert res["opt_period"] != pytest.approx(0.25, abs=1e-9)
+
+    def test_zero_drift_keeps_period(self):
+        opt = FoldOptimiser(64, 16)
+        folds = self.make_fold(drift_bins=0.0)
+        res = opt.optimise(folds[None], np.array([0.25]), tobs=41.94)[0]
+        assert res["opt_shift"] == 32  # no shift -> (32-32)=0 correction
+        assert res["opt_period"] == pytest.approx(0.25, rel=1e-12)
+        assert res["opt_sn"] > 10
+
+    def test_batched_equals_single(self):
+        opt = FoldOptimiser(64, 16)
+        f1 = self.make_fold(drift_bins=3.0)
+        f2 = self.make_fold(drift_bins=-5.0)
+        batch = opt.optimise(
+            np.stack([f1, f2]), np.array([0.25, 0.1]), tobs=41.94
+        )
+        single = opt.optimise(f2[None], np.array([0.1]), tobs=41.94)[0]
+        assert batch[1]["opt_shift"] == single["opt_shift"]
+        assert batch[1]["opt_sn"] == pytest.approx(single["opt_sn"], rel=1e-5)
+
+    def test_calculate_sn_width_zero(self):
+        prof = np.random.default_rng(1).normal(size=64)
+        sn1, sn2 = calculate_sn(prof, 10, 0, 64)
+        assert sn1 == 0.0  # sqrt(0) kills sn1; sn2 -> inf -> squashed
+
+
+class TestCoincidence:
+    def test_mask(self):
+        beams = np.zeros((4, 8), dtype=np.float32)
+        beams[:, 3] = 10.0  # all beams fire at sample 3
+        beams[0, 5] = 10.0  # one beam fires at sample 5
+        out = np.asarray(coincidence_mask(jnp.asarray(beams), 4.0, 3))
+        assert out[3] == 0.0  # multibeam -> masked
+        assert out[5] == 1.0  # single beam -> kept

@@ -1,0 +1,168 @@
+"""Pipeline-level tests on a small synthetic pulsar filterbank (CPU)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from peasoup_tpu.core import Candidate
+from peasoup_tpu.io import Filterbank, SigprocHeader, write_filterbank, read_filterbank
+from peasoup_tpu.pipeline import (
+    SearchConfig,
+    PeasoupSearch,
+    HarmonicDistiller,
+    AccelerationDistiller,
+    DMDistiller,
+    CandidateScorer,
+)
+
+
+def make_synthetic_fil(
+    tmp_path,
+    nsamps=1 << 15,
+    nchans=16,
+    tsamp=0.000256,
+    period=0.064,
+    dm=20.0,
+    fch1=1400.0,
+    foff=-8.0,  # wide band -> real DM discrimination across trials
+    amp=1.2,
+    seed=7,
+):
+    """8-bit filterbank with a dispersed pulsar of the given period/DM."""
+    rng = np.random.default_rng(seed)
+    data = rng.normal(32.0, 4.0, size=(nsamps, nchans))
+    freqs = fch1 + np.arange(nchans) * foff
+    delays = 4.148808e3 * dm * (freqs**-2 - fch1**-2) / tsamp  # samples
+    t = np.arange(nsamps)
+    for c in range(nchans):
+        phase = ((t - delays[c]) * tsamp / period) % 1.0
+        pulse = (phase < 0.03).astype(float)  # ~8-sample pulse
+        data[:, c] += amp * 8.0 * pulse
+    data = np.clip(np.rint(data), 0, 255).astype(np.uint8)
+    hdr = SigprocHeader(
+        source_name="FAKE", tsamp=tsamp, tstart=55000.0, fch1=fch1, foff=foff,
+        nchans=nchans, nbits=8, nifs=1, data_type=1,
+    )
+    path = tmp_path / "fake.fil"
+    write_filterbank(path, Filterbank(header=hdr, data=data))
+    return path, period, dm
+
+
+@pytest.fixture(scope="module")
+def synthetic(tmp_path_factory):
+    return make_synthetic_fil(tmp_path_factory.mktemp("fil"))
+
+
+class TestEndToEnd:
+    def test_recovers_pulsar(self, synthetic):
+        path, period, dm = synthetic
+        fil = read_filterbank(path)
+        cfg = SearchConfig(dm_end=60.0, nharmonics=3, npdmp=4, limit=50)
+        res = PeasoupSearch(cfg).run(fil)
+        assert len(res.candidates) > 0
+        top = res.candidates[0]
+        # the pulsar (or a harmonic) must be the top candidate at ~the right DM
+        ratio = (1.0 / top.freq) / period
+        harmonic = min(
+            abs(ratio - r) for r in (0.25, 0.5, 1.0, 2.0, 3.0, 4.0)
+        )
+        assert harmonic < 0.01
+        assert abs(top.dm - dm) < 15.0
+        assert top.snr > 10
+        assert top.folded_snr > 5  # npdmp folded it
+
+    def test_timers_and_lists(self, synthetic):
+        path, _, _ = synthetic
+        fil = read_filterbank(path)
+        cfg = SearchConfig(dm_end=5.0, nharmonics=1, limit=10)
+        res = PeasoupSearch(cfg).run(fil)
+        for key in ("dedispersion", "searching", "folding", "total"):
+            assert key in res.timers
+        assert res.size == 1 << 14  # prev_power_of_two(nsamps)
+        assert len(res.dm_list) >= 1
+        assert len(res.candidates) <= 10
+
+
+class TestDistillers:
+    def test_harmonic_distiller_absorbs(self):
+        c1 = Candidate(freq=10.0, snr=50.0, nh=4)
+        c2 = Candidate(freq=20.00001, snr=20.0, nh=4)  # 2nd harmonic
+        c3 = Candidate(freq=13.7, snr=15.0, nh=4)  # unrelated
+        out = HarmonicDistiller(1e-4, 16, keep_related=True).distill([c1, c2, c3])
+        freqs = sorted(c.freq for c in out)
+        assert freqs == [10.0, 13.7]
+        kept = [c for c in out if c.freq == 10.0][0]
+        assert kept.count_assoc() >= 1
+
+    def test_harmonic_distiller_multiplicity(self):
+        # freq ratio 1:1 matches (jj,kk)=(1,1),(2,2)... -> multiple appends
+        c1 = Candidate(freq=10.0, snr=50.0, nh=2)
+        c2 = Candidate(freq=10.0000001, snr=20.0, nh=2)
+        out = HarmonicDistiller(1e-4, 16, keep_related=True).distill([c1, c2])
+        assert len(out) == 1
+        # (1,1),(2,2),(3,3),(4,4) within kk<=2^nh=4 -> 4 appends
+        assert out[0].count_assoc() == 4
+
+    def test_acceleration_distiller(self):
+        tobs = 40.0
+        c1 = Candidate(freq=10.0, snr=50.0, acc=0.0)
+        c2 = Candidate(freq=10.0001, snr=20.0, acc=1.0)
+        out = AccelerationDistiller(tobs, 1e-4, keep_related=True).distill([c1, c2])
+        assert len(out) == 1
+        assert out[0].snr == 50.0
+
+    def test_dm_distiller(self):
+        c1 = Candidate(freq=10.0, snr=50.0, dm_idx=3)
+        c2 = Candidate(freq=10.0005, snr=20.0, dm_idx=4)
+        c3 = Candidate(freq=11.0, snr=30.0, dm_idx=4)
+        out = DMDistiller(1e-4, keep_related=True).distill([c1, c2, c3])
+        assert sorted(c.freq for c in out) == [10.0, 11.0]
+
+    def test_sort_by_snr_desc(self):
+        cands = [Candidate(freq=1.0 + i, snr=float(i)) for i in range(5)]
+        out = DMDistiller(1e-9, keep_related=False).distill(cands)
+        snrs = [c.snr for c in out]
+        assert snrs == sorted(snrs, reverse=True)
+
+
+class TestScorer:
+    def make(self):
+        return CandidateScorer(tsamp=0.000064, cfreq=1400.0, foff=-0.39, bw=400.0)
+
+    def test_adjacent_unique(self):
+        s = self.make()
+        c = Candidate(freq=10.0, snr=20.0, dm=10.0, dm_idx=5)
+        s.score(c)
+        assert c.is_adjacent  # no assoc -> "unique" -> adjacent true
+
+    def test_adjacent_neighbour(self):
+        s = self.make()
+        c = Candidate(freq=10.0, snr=20.0, dm=10.0, dm_idx=5)
+        c.append(Candidate(freq=10.0, snr=5.0, dm=11.0, dm_idx=6))
+        c.append(Candidate(freq=10.0, snr=5.0, dm=30.0, dm_idx=20))
+        s.score(c)
+        assert c.is_adjacent
+
+    def test_not_adjacent(self):
+        s = self.make()
+        c = Candidate(freq=10.0, snr=20.0, dm=10.0, dm_idx=5)
+        c.append(Candidate(freq=10.0, snr=5.0, dm=60.0, dm_idx=30))
+        s.score(c)
+        assert not c.is_adjacent
+
+    def test_ddm_ratios(self):
+        s = self.make()
+        c = Candidate(freq=10.0, snr=20.0, dm=10.0, dm_idx=5)
+        c.append(Candidate(freq=10.0, snr=10.0, dm=10.1, dm_idx=6))  # inside
+        c.append(Candidate(freq=10.0, snr=10.0, dm=90.0, dm_idx=40))  # outside
+        s.score(c)
+        assert c.ddm_count_ratio == pytest.approx(2 / 3)
+        assert c.ddm_snr_ratio == pytest.approx(30 / 40)
+
+    def test_is_physical_foff_sign_quirk(self):
+        # foff < 0 makes the smear threshold negative -> always physical
+        s = self.make()
+        c = Candidate(freq=1000.0, snr=20.0, dm=10000.0, dm_idx=5)
+        s.score(c)
+        assert c.is_physical
