@@ -1,0 +1,148 @@
+"""`coincidencer` CLI: build multibeam RFI masks/birdie lists by
+coincidence-matching zero-DM time series and spectra across beams.
+
+Reference: src/coincidencer.cpp. Per beam: dedisperse at DM=0,
+deredden + normalise the spectrum AND the time series; then count, per
+sample/bin, how many beams exceed a threshold — samples firing in >=
+beam_thresh beams are multibeam RFI. Outputs a 0/1 sample mask and a
+(freq, width) birdie list derived from zero-runs of the spectral mask
+(include/transforms/coincidencer.hpp:42-78).
+
+TPU design: beams stack on a leading axis; per-beam baselining is one
+vmapped jitted program, and the coincidence count is a beam-axis
+reduction (psum over a mesh axis when beams are sharded across chips —
+see peasoup_tpu.parallel.coincidence).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="coincidencer",
+        description="Peasoup-TPU multibeam coincidence RFI detector",
+    )
+    p.add_argument("filterbanks", nargs="+", help="File names")
+    p.add_argument("--o", dest="samp_outfilename", default="rfi.eb_mask",
+                   help="Sample mask output filename")
+    p.add_argument("--o2", dest="spec_outfilename", default="birdies.txt",
+                   help="Birdie list output filename")
+    p.add_argument("-l", "--boundary_5_freq", type=float, default=0.05)
+    p.add_argument("-a", "--boundary_25_freq", type=float, default=0.5)
+    p.add_argument("-n", "--nharmonics", type=int, default=4)
+    p.add_argument("--thresh", type=float, default=4.0,
+                   help="S/N threshold for coincidence matching")
+    p.add_argument("--beam_thresh", type=int, default=4,
+                   help="Beams a candidate must appear in to be multibeam")
+    p.add_argument("-L", "--min_freq", type=float, default=0.1)
+    p.add_argument("-H", "--max_freq", type=float, default=1100.0)
+    p.add_argument("-b", "--max_harm", type=int, default=16)
+    p.add_argument("-f", "--freq_tol", type=float, default=0.0001)
+    p.add_argument("-v", "--verbose", action="store_true")
+    return p
+
+
+def write_samp_mask(mask: np.ndarray, filename: str) -> None:
+    with open(filename, "w") as fo:
+        fo.write("#0 1\n")
+        for v in mask:
+            fo.write(f"{int(v)}\n")
+
+
+def birdies_from_mask(mask: np.ndarray, bin_width: float) -> list[tuple[float, float]]:
+    """Zero-runs of the spectral mask -> (freq, width) rows
+    (coincidencer.hpp:53-72)."""
+    birdies = []
+    ii = 0
+    size = len(mask)
+    while ii < size:
+        if mask[ii] == 0:
+            count = 0
+            while ii < size and mask[ii] == 0:
+                count += 1
+                ii += 1
+            birdies.append((((ii - 1) - count / 2.0) * bin_width, count * bin_width))
+        else:
+            ii += 1
+    return birdies
+
+
+def write_birdie_list(
+    mask: np.ndarray, bin_width: float, filename: str
+) -> None:
+    with open(filename, "w") as fo:
+        for freq, width in birdies_from_mask(mask, bin_width):
+            fo.write(f"{freq:.9f}\t{width:.6f}\n")
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    import jax.numpy as jnp
+
+    from ..io.sigproc import read_filterbank
+    from ..ops.coincidence import coincidence_mask
+    from ..parallel.coincidence import baseline_beam
+    from ..plan.dm_plan import DMPlan
+
+    tims = []
+    tsamp = None
+    for path in args.filterbanks:
+        if args.verbose:
+            print(f"Reading and dedispersing {path}")
+        fil = read_filterbank(path)
+        plan = DMPlan.create(
+            nsamps=fil.nsamps, nchans=fil.nchans, tsamp=fil.tsamp,
+            fch1=fil.fch1, foff=fil.foff, dm_start=0.0, dm_end=0.0,
+            pulse_width=0.4, tol=1.1,
+        )
+        from ..ops.dedisperse import dedisperse, output_scale
+
+        trial = dedisperse(
+            fil.data, plan.delay_samples(), plan.killmask, plan.out_nsamps,
+            scale=output_scale(fil.nbits, fil.nchans),
+        )[0]
+        tims.append(trial)
+        tsamp = fil.tsamp
+    sizes = {len(t) for t in tims}
+    if len(sizes) != 1:
+        raise SystemExit("Not all filterbanks the same length")
+    # the reference uses the FULL dedispersed length, not a power of two
+    # (coincidencer.cpp:136); jnp.fft handles arbitrary sizes
+    size = sizes.pop()
+    tobs = size * tsamp
+    bin_width = 1.0 / tobs
+    pos5 = int(args.boundary_5_freq / bin_width)
+    pos25 = int(args.boundary_25_freq / bin_width)
+
+    specs, series = [], []
+    for t in tims:
+        if args.verbose:
+            print("Baselining beam")
+        spec, tim = baseline_beam(jnp.asarray(t[:size]), size=size, pos5=pos5,
+                                  pos25=pos25)
+        specs.append(np.asarray(spec))
+        series.append(np.asarray(tim))
+
+    if args.verbose:
+        print("Performing cross beam coincidence matching")
+    samp_mask = np.asarray(
+        coincidence_mask(jnp.asarray(np.stack(series)), args.thresh, args.beam_thresh)
+    )
+    spec_mask = np.asarray(
+        coincidence_mask(jnp.asarray(np.stack(specs)), args.thresh, args.beam_thresh)
+    )
+    write_samp_mask(samp_mask, args.samp_outfilename)
+    write_birdie_list(spec_mask, bin_width, args.spec_outfilename)
+    if args.verbose:
+        print(f"Wrote {args.samp_outfilename} and {args.spec_outfilename}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,12 +10,20 @@ peasoup_tpu.parallel.coincidence).
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 
 def coincidence_mask(
-    beams: jnp.ndarray, thresh: float, beam_thresh: int
+    beams: jnp.ndarray, thresh: float, beam_thresh: int,
+    axis_name: str | None = None,
 ) -> jnp.ndarray:
-    """beams: (B, N) -> (N,) float mask, 1.0 = keep (not multibeam RFI)."""
+    """beams: (B, N) -> (N,) float mask, 1.0 = keep (not multibeam RFI).
+
+    Inside shard_map, pass ``axis_name`` to reduce exceed-counts across
+    the sharded beam axis with a psum.
+    """
     count = jnp.sum(beams > thresh, axis=0)
+    if axis_name is not None:
+        count = jax.lax.psum(count, axis_name=axis_name)
     return (count < beam_thresh).astype(jnp.float32)

@@ -84,3 +84,17 @@ def deredden(fseries: jnp.ndarray, median: jnp.ndarray) -> jnp.ndarray:
     out = fseries / median.astype(fseries.real.dtype)
     idx = jnp.arange(fseries.shape[-1])
     return jnp.where(idx < 5, 0.0 + 0.0j, out)
+
+
+def whiten_fseries(x: jnp.ndarray, *, pos5: int, pos25: int) -> jnp.ndarray:
+    """rfft -> amplitude -> running median -> dereddened Fourier series.
+
+    The shared stanza of the search worker (pipeline_multi.cu:174-186),
+    the candidate folder (folder.hpp:385-388) and the coincidencer
+    (coincidencer.cpp:167-171).
+    """
+    from .spectrum import form_power  # local import avoids a cycle
+
+    fser = jnp.fft.rfft(x.astype(jnp.float32))
+    med = running_median(form_power(fser), pos5=pos5, pos25=pos25)
+    return deredden(fser, med)

@@ -1,0 +1,3 @@
+from .mesh import make_mesh, device_count
+from .sharded_search import make_sharded_search_fn
+from .coincidence import baseline_beam, sharded_coincidence

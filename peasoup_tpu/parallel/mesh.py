@@ -1,0 +1,42 @@
+"""Device-mesh construction.
+
+The reference's parallelism is one pthread per GPU pulling DM-trial
+indices from a mutex-protected dispenser (src/pipeline_multi.cu:33-81).
+TPU-native equivalent: a `jax.sharding.Mesh` whose axes shard the trial
+grid — 'dm' for DM trials within a pod (ICI), 'beam' for multibeam
+ensembles (DCN across pods). Work assignment is static round-robin
+(deterministic) instead of the reference's dynamic mutex dealing.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+def make_mesh(axes: dict[str, int] | None = None, devices=None) -> Mesh:
+    """Build a mesh; default is all devices on one 'dm' axis.
+
+    ``axes`` maps axis name -> size, e.g. {'beam': 2, 'dm': 4}. Sizes
+    must multiply to the device count (-1 means "the rest").
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if axes is None:
+        axes = {"dm": len(devices)}
+    names = list(axes)
+    sizes = [axes[n] for n in names]
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = len(devices) // known
+    if int(np.prod(sizes)) != len(devices):
+        raise ValueError(
+            f"mesh axes {dict(zip(names, sizes))} do not cover {len(devices)} devices"
+        )
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, tuple(names))

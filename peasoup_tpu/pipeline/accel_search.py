@@ -26,9 +26,9 @@ import jax.numpy as jnp
 
 from ..ops.harmonics import harmonic_sums
 from ..ops.peaks import find_peaks_device
-from ..ops.rednoise import deredden, running_median
+from ..ops.rednoise import whiten_fseries
 from ..ops.resample import resample_accel
-from ..ops.spectrum import form_interpolated, form_power, normalise, spectrum_stats
+from ..ops.spectrum import form_interpolated, normalise, spectrum_stats
 from ..ops.zap import zap_birdies
 
 
@@ -44,6 +44,61 @@ class AccelSearchPeaks(NamedTuple):
     counts: jax.Array
 
 
+def search_trial_core(
+    tim: jax.Array,  # (>=size,) u8/f32 dedispersed time series
+    afs: jax.Array,  # (A,) f32 acceleration factors a*tsamp/2c (padded)
+    zapmask: jax.Array,  # (size//2+1,) bool birdie mask
+    windows: jax.Array,  # (nharms+1, 2) i32 [start_idx, limit) per level
+    *,
+    threshold: float,
+    size: int,
+    nsamps_valid: int,
+    nharms: int,
+    max_peaks: int,
+    pos5: int,
+    pos25: int,
+) -> AccelSearchPeaks:
+    """Pure search body for one DM trial; vmap/shard_map-compatible."""
+    # --- once per DM trial ------------------------------------------------
+    x = tim[:size].astype(jnp.float32)
+    if nsamps_valid < size:
+        # mean-pad the tail like the reference (pipeline_multi.cu:160-163);
+        # the input trial may be shorter than size, so pad to shape first
+        x = jnp.pad(x, (0, size - x.shape[0]))
+        mean_head = jnp.mean(x[:nsamps_valid])
+        idx = jnp.arange(size)
+        x = jnp.where(idx < nsamps_valid, x, mean_head)
+    fser = whiten_fseries(x, pos5=pos5, pos25=pos25)
+    fser = zap_birdies(fser, zapmask)
+    s0 = form_interpolated(fser)
+    mean, _, std = spectrum_stats(s0)
+    xd = jnp.fft.irfft(fser, n=size)
+
+    # --- batched over acceleration trials ---------------------------------
+    xr = resample_accel(xd, afs)  # (A, size)
+    fr = jnp.fft.rfft(xr, axis=-1)  # (A, size//2+1)
+    s = form_interpolated(fr)
+    s = normalise(s, mean[None], std[None])
+    sums = harmonic_sums(s, nharms=nharms)
+    levels = [s] + sums
+
+    idxs, snrs, counts = [], [], []
+    for lvl, spec in enumerate(levels):
+        i_, s_, c_ = find_peaks_device(
+            spec,
+            jnp.float32(threshold),
+            windows[lvl, 0],
+            windows[lvl, 1],
+            max_peaks=max_peaks,
+        )
+        idxs.append(i_)
+        snrs.append(s_)
+        counts.append(c_)
+    return AccelSearchPeaks(
+        idxs=jnp.stack(idxs), snrs=jnp.stack(snrs), counts=jnp.stack(counts)
+    )
+
+
 def make_search_fn(threshold: float):
     """Build the jitted per-DM-trial program with the S/N threshold
     bound statically (it never changes within a run)."""
@@ -53,59 +108,12 @@ def make_search_fn(threshold: float):
         static_argnames=("size", "nsamps_valid", "nharms", "max_peaks", "pos5",
                          "pos25"),
     )
-    def search_dm_trial(
-        tim: jax.Array,  # (>=size,) u8/f32 dedispersed time series
-        afs: jax.Array,  # (A,) f32 acceleration factors a*tsamp/2c (padded)
-        zapmask: jax.Array,  # (size//2+1,) bool birdie mask
-        windows: jax.Array,  # (nharms+1, 2) i32 [start_idx, limit) per level
-        *,
-        size: int,
-        nsamps_valid: int,
-        nharms: int,
-        max_peaks: int,
-        pos5: int,
-        pos25: int,
-    ) -> AccelSearchPeaks:
-        # --- once per DM trial --------------------------------------------
-        x = tim[:size].astype(jnp.float32)
-        if nsamps_valid < size:
-            # mean-pad the tail like the reference (pipeline_multi.cu:160-163);
-            # the input trial may be shorter than size, so pad to shape first
-            x = jnp.pad(x, (0, size - x.shape[0]))
-            mean_head = jnp.mean(x[:nsamps_valid])
-            idx = jnp.arange(size)
-            x = jnp.where(idx < nsamps_valid, x, mean_head)
-        fser = jnp.fft.rfft(x)
-        p0 = form_power(fser)
-        med = running_median(p0, pos5=pos5, pos25=pos25)
-        fser = deredden(fser, med)
-        fser = zap_birdies(fser, zapmask)
-        s0 = form_interpolated(fser)
-        mean, _, std = spectrum_stats(s0)
-        xd = jnp.fft.irfft(fser, n=size)
-
-        # --- batched over acceleration trials -----------------------------
-        xr = resample_accel(xd, afs)  # (A, size)
-        fr = jnp.fft.rfft(xr, axis=-1)  # (A, size//2+1)
-        s = form_interpolated(fr)
-        s = normalise(s, mean[None], std[None])
-        sums = harmonic_sums(s, nharms=nharms)
-        levels = [s] + sums
-
-        idxs, snrs, counts = [], [], []
-        for lvl, spec in enumerate(levels):
-            i_, s_, c_ = find_peaks_device(
-                spec,
-                jnp.float32(threshold),
-                windows[lvl, 0],
-                windows[lvl, 1],
-                max_peaks=max_peaks,
-            )
-            idxs.append(i_)
-            snrs.append(s_)
-            counts.append(c_)
-        return AccelSearchPeaks(
-            idxs=jnp.stack(idxs), snrs=jnp.stack(snrs), counts=jnp.stack(counts)
+    def search_dm_trial(tim, afs, zapmask, windows, *, size, nsamps_valid,
+                        nharms, max_peaks, pos5, pos25) -> AccelSearchPeaks:
+        return search_trial_core(
+            tim, afs, zapmask, windows,
+            threshold=threshold, size=size, nsamps_valid=nsamps_valid,
+            nharms=nharms, max_peaks=max_peaks, pos5=pos5, pos25=pos25,
         )
 
     return search_dm_trial

@@ -20,9 +20,8 @@ import numpy as np
 from ..core.candidates import Candidate
 from ..ops.fold import fold_bins_np, fold_time_series
 from ..ops.fold_optimise import FoldOptimiser
-from ..ops.rednoise import deredden, running_median
+from ..ops.rednoise import whiten_fseries
 from ..ops.resample import SPEED_OF_LIGHT, resample_accel_quadratic
-from ..ops.spectrum import form_power
 from ..plan.fft_plan import prev_power_of_two
 
 
@@ -31,10 +30,7 @@ def _deredden_tim(tim: jax.Array, *, size: int, pos5: int, pos25: int) -> jax.Ar
     """u8 trial -> dereddened f32 time series, scaled like the
     reference's unnormalised inverse FFT (x size) so fold amplitudes
     match the CUDA output files (folder.hpp:382-389)."""
-    x = tim[:size].astype(jnp.float32)
-    fser = jnp.fft.rfft(x)
-    med = running_median(form_power(fser), pos5=pos5, pos25=pos25)
-    fser = deredden(fser, med)
+    fser = whiten_fseries(tim[:size], pos5=pos5, pos25=pos25)
     return jnp.fft.irfft(fser, n=size) * size
 
 

@@ -1,0 +1,131 @@
+"""Post-processing parsers for peasoup output files.
+
+Reference: tools/peasoup_tools.py — OverviewFile parses overview.xml
+into a candidate recarray (with a workaround for invalid bytes in
+<username>, peasoup_tools.py:110-118); CandidateFileParser seeks a
+candidate's byte_offset in candidates.peasoup and reads the optional
+FOLD block plus the detection (hit) list.
+"""
+
+from __future__ import annotations
+
+import re
+import struct
+import xml.etree.ElementTree as ET
+
+import numpy as np
+
+from ..core.candidates import CANDIDATE_POD_DTYPE
+
+CAND_FIELDS = [
+    ("period", "f8"),
+    ("opt_period", "f8"),
+    ("dm", "f4"),
+    ("acc", "f4"),
+    ("nh", "i4"),
+    ("snr", "f4"),
+    ("folded_snr", "f4"),
+    ("is_adjacent", "i4"),
+    ("is_physical", "i4"),
+    ("ddm_count_ratio", "f4"),
+    ("ddm_snr_ratio", "f4"),
+    ("nassoc", "i4"),
+    ("byte_offset", "i8"),
+]
+
+
+class OverviewFile:
+    """Parse overview.xml into header/search dicts + candidate recarray."""
+
+    def __init__(self, path: str):
+        with open(path, "rb") as f:
+            raw = f.read()
+        # strip invalid bytes that the reference writer can emit in
+        # <username> (peasoup_tools.py:110-118)
+        raw = re.sub(rb"<username>.*?</username>", b"<username></username>", raw,
+                     flags=re.S)
+        self.root = ET.fromstring(raw.decode("latin-1"))
+        self.header = self._section_dict("header_parameters")
+        self.search_parameters = self._section_dict("search_parameters")
+        self.execution_times = {
+            k: float(v) for k, v in self._section_dict("execution_times").items()
+        }
+        self.dm_list = np.array(
+            [float(t.text) for t in self.root.findall("dedispersion_trials/trial")]
+        )
+        self.acc_list = np.array(
+            [float(t.text) for t in self.root.findall("acceleration_trials/trial")]
+        )
+        self.candidates = self._parse_candidates()
+
+    def _section_dict(self, name: str) -> dict:
+        node = self.root.find(name)
+        if node is None:
+            return {}
+        return {child.tag: (child.text or "") for child in node}
+
+    def _parse_candidates(self) -> np.ndarray:
+        rows = []
+        for cand in self.root.findall("candidates/candidate"):
+            vals = {c.tag: c.text for c in cand}
+            rows.append(
+                tuple(
+                    np.dtype(ftype).type(vals.get(fname, 0) or 0)
+                    for fname, ftype in CAND_FIELDS
+                )
+            )
+        return np.array(rows, dtype=CAND_FIELDS)
+
+    def make_predictor(self, idx: int) -> str:
+        """TEMPO-style predictor text for one candidate
+        (peasoup_tools.py:153-164)."""
+        c = self.candidates[idx]
+        period = c["opt_period"] if c["opt_period"] else c["period"]
+        mjd = float(self.header.get("tstart", 0))
+        return (
+            "SOURCE: {src}\nPERIOD: {p:.15f}\nDM: {dm:.3f}\nACC: {acc:.3f}\n"
+            "PEPOCH: {mjd:.10f}\n".format(
+                src=self.header.get("source_name", "unknown"),
+                p=float(period),
+                dm=float(c["dm"]),
+                acc=float(c["acc"]),
+                mjd=mjd,
+            )
+        )
+
+
+class CandidateFileParser:
+    """Read candidates.peasoup records by byte offset
+    (tools/peasoup_tools.py:46-80)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.f = open(path, "rb")
+
+    def close(self):
+        self.f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def read_candidate(self, byte_offset: int) -> dict:
+        self.f.seek(byte_offset)
+        magic = self.f.read(4)
+        fold = None
+        nbins = nints = 0
+        if magic == b"FOLD":
+            nbins, nints = struct.unpack("<ii", self.f.read(8))
+            fold = np.frombuffer(
+                self.f.read(4 * nbins * nints), dtype="<f4"
+            ).reshape(nints, nbins)
+        else:
+            self.f.seek(byte_offset)
+        (ndets,) = struct.unpack("<i", self.f.read(4))
+        hits = np.frombuffer(
+            self.f.read(CANDIDATE_POD_DTYPE.itemsize * ndets),
+            dtype=CANDIDATE_POD_DTYPE,
+        )
+        return {"fold": fold, "nbins": nbins, "nints": nints, "hits": hits}

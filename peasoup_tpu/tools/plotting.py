@@ -1,0 +1,73 @@
+"""Candidate diagnostic plotting (reference: tools/peasoup_tools.py:167-383
+CandidatePlotter). Requires matplotlib; import-guarded so headless
+installs work without it."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class CandidatePlotter:
+    """Plot profile / subints / DM-acc scatter for one candidate."""
+
+    def __init__(self, overview, cand_file_parser):
+        self.overview = overview
+        self.parser = cand_file_parser
+
+    def plot(self, idx: int, outfile: str | None = None):
+        import matplotlib
+
+        if outfile:
+            matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        cand = self.overview.candidates[idx]
+        rec = self.parser.read_candidate(int(cand["byte_offset"]))
+        fig, axes = plt.subplots(2, 2, figsize=(10, 8))
+        fig.suptitle(
+            f"cand {idx}: P={cand['period']:.6f}s DM={cand['dm']:.2f} "
+            f"acc={cand['acc']:.2f} snr={cand['snr']:.1f}"
+        )
+        if rec["fold"] is not None:
+            prof = rec["fold"].mean(axis=0)
+            axes[0, 0].plot(np.r_[prof, prof])
+            axes[0, 0].set_title("profile (x2 phase)")
+            axes[0, 1].imshow(rec["fold"], aspect="auto", origin="lower")
+            axes[0, 1].set_title("subints")
+        hits = rec["hits"]
+        if len(hits):
+            axes[1, 0].scatter(hits["dm"], hits["snr"], s=8)
+            axes[1, 0].set_xlabel("DM")
+            axes[1, 0].set_ylabel("S/N")
+            axes[1, 1].scatter(hits["acc"], hits["snr"], s=8)
+            axes[1, 1].set_xlabel("acc")
+            axes[1, 1].set_ylabel("S/N")
+        if outfile:
+            fig.savefig(outfile, dpi=100, bbox_inches="tight")
+            plt.close(fig)
+            return outfile
+        return fig
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="peasoup-plot-cand")
+    p.add_argument("overview")
+    p.add_argument("candfile")
+    p.add_argument("idx", type=int)
+    p.add_argument("-o", "--outfile", default="cand.png")
+    args = p.parse_args(argv)
+    from .parsers import CandidateFileParser, OverviewFile
+
+    ov = OverviewFile(args.overview)
+    with CandidateFileParser(args.candfile) as cp:
+        CandidatePlotter(ov, cp).plot(args.idx, args.outfile)
+    print(args.outfile)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

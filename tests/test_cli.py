@@ -1,0 +1,80 @@
+"""CLI tests: run the peasoup + coincidencer mains on synthetic data."""
+
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from peasoup_tpu.cli.coincidencer import birdies_from_mask, main as coin_main
+from peasoup_tpu.cli.peasoup import build_parser, main as peasoup_main
+from peasoup_tpu.tools import CandidateFileParser, OverviewFile
+from test_pipeline import make_synthetic_fil
+
+
+def test_parser_defaults_match_reference():
+    args = build_parser().parse_args(["-i", "x.fil"])
+    assert args.dm_end == 100.0
+    assert args.dm_tol == 1.10
+    assert args.num_threads == 14
+    assert args.limit == 1000
+    assert args.min_snr == 9.0
+    assert args.max_harm == 16
+    assert args.nharmonics == 4
+    assert args.freq_tol == 0.0001
+
+
+def test_peasoup_cli_end_to_end(tmp_path):
+    path, period, dm = make_synthetic_fil(tmp_path)
+    outdir = tmp_path / "out"
+    rc = peasoup_main(
+        [
+            "-i", str(path), "-o", str(outdir), "--dm_end", "40",
+            "-n", "2", "--npdmp", "2", "--limit", "20",
+        ]
+    )
+    assert rc == 0
+    ov = OverviewFile(str(outdir / "overview.xml"))
+    assert len(ov.candidates) > 0
+    assert "reading" in ov.execution_times
+    top = ov.candidates[0]
+    ratio = top["period"] / period
+    assert min(abs(ratio - r) for r in (0.25, 0.5, 1.0, 2.0, 4.0)) < 0.01
+    with CandidateFileParser(str(outdir / "candidates.peasoup")) as p:
+        rec = p.read_candidate(int(top["byte_offset"]))
+        assert len(rec["hits"]) == top["nassoc"] + 1
+
+
+def test_coincidencer_cli(tmp_path):
+    # 4 beams: same noise stats; one has a per-beam signal
+    paths = []
+    for b in range(4):
+        beam_dir = tmp_path / f"b{b}"
+        beam_dir.mkdir()
+        p, _, _ = make_synthetic_fil(
+            beam_dir, nsamps=1 << 13, amp=0.0, seed=100 + b
+        )
+        paths.append(str(p))
+    samp_out = tmp_path / "mask.txt"
+    spec_out = tmp_path / "birdies.txt"
+    rc = coin_main(
+        [*paths, "--o", str(samp_out), "--o2", str(spec_out), "--thresh", "4",
+         "--beam_thresh", "3"]
+    )
+    assert rc == 0
+    lines = samp_out.read_text().strip().splitlines()
+    assert lines[0] == "#0 1"
+    mask = np.array([int(x) for x in lines[1:]])
+    # full dedispersed length, NOT truncated to a power of two
+    # (coincidencer.cpp:136); DM=0 -> max_delay 0 -> all 8192 samples
+    assert mask.size == 1 << 13
+    assert mask.mean() > 0.9  # pure noise: almost everything kept
+
+
+def test_birdies_from_mask():
+    mask = np.array([1, 1, 0, 0, 0, 1, 0, 1])
+    b = birdies_from_mask(mask, bin_width=2.0)
+    # run of 3 zeros ending at index 4: freq=(4-1.5)*2=5.0 width=6.0
+    assert b[0] == (5.0, 6.0)
+    assert b[1] == ((6 - 0.5) * 2.0, 2.0)

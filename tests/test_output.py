@@ -1,0 +1,120 @@
+"""Output writer + post-processing parser round-trip tests."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from peasoup_tpu.core import Candidate, CANDIDATE_POD_DTYPE
+from peasoup_tpu.io.output import CandidateFileWriter, OutputFileWriter
+from peasoup_tpu.io.sigproc import SigprocHeader
+from peasoup_tpu.io.xml_writer import Element, fmt
+from peasoup_tpu.pipeline import SearchConfig
+from peasoup_tpu.tools import OverviewFile, CandidateFileParser
+
+
+def make_cands():
+    c0 = Candidate(dm=19.76, dm_idx=6, acc=0.0, nh=4, snr=86.9, freq=4.000962)
+    c0.assoc.append(Candidate(dm=23.0, dm_idx=7, acc=0.0, nh=3, snr=73.9, freq=3.999))
+    c0.fold = np.arange(64 * 16, dtype=np.float32).reshape(16, 64)
+    c0.opt_period = 0.249986
+    c1 = Candidate(dm=9.9, dm_idx=3, acc=-5.0, nh=4, snr=52.6, freq=2.0012)
+    return [c0, c1]
+
+
+class TestXmlWriter:
+    def test_fmt_matches_cpp_setprecision15(self):
+        # float32(1.1) printed as double with 15 significant digits
+        assert fmt(float(np.float32(1.1))) == "1.10000002384186"
+        assert fmt(float(np.float32(0.05))) == "0.0500000007450581"
+        assert fmt(True) == "1"
+        assert fmt(0) == "0"
+        assert fmt(3.3133590221405) == "3.3133590221405"
+
+    def test_structure(self):
+        root = Element("peasoup_search")
+        trials = root.append(Element("dedispersion_trials"))
+        trials.add_attribute("count", 2)
+        for i, v in enumerate([0.0, 3.3133590221405]):
+            t = Element("trial", v)
+            t.add_attribute("id", i)
+            trials.append(t)
+        s = root.to_string(header=True)
+        assert s.startswith("<?xml version='1.0' encoding='ISO-8859-1'?>\n")
+        assert "<dedispersion_trials count='2'>" in s
+        assert "<trial id='1'>3.3133590221405</trial>" in s
+
+
+class TestBinaryWriter:
+    def test_roundtrip(self, tmp_path):
+        cands = make_cands()
+        w = CandidateFileWriter(str(tmp_path))
+        path = w.write_binary(cands)
+        assert w.byte_mapping[0] == 0
+        with open(path, "rb") as f:
+            assert f.read(4) == b"FOLD"
+            nbins, nints = struct.unpack("<ii", f.read(8))
+            assert (nbins, nints) == (64, 16)
+        with CandidateFileParser(path) as p:
+            rec0 = p.read_candidate(w.byte_mapping[0])
+            assert rec0["fold"].shape == (16, 64)
+            np.testing.assert_allclose(rec0["fold"], cands[0].fold)
+            assert len(rec0["hits"]) == 2  # self + 1 assoc
+            assert rec0["hits"][0]["snr"] == pytest.approx(86.9)
+            assert rec0["hits"][1]["dm"] == pytest.approx(23.0)
+            rec1 = p.read_candidate(w.byte_mapping[1])
+            assert rec1["fold"] is None
+            assert len(rec1["hits"]) == 1
+            assert rec1["hits"][0]["acc"] == pytest.approx(-5.0)
+
+    def test_pod_layout_is_24_bytes(self):
+        assert CANDIDATE_POD_DTYPE.itemsize == 24
+
+    def test_write_binaries_per_cand(self, tmp_path):
+        w = CandidateFileWriter(str(tmp_path))
+        names = w.write_binaries(make_cands())
+        assert len(names) == 2
+        assert "cand_0000" in names[0]
+
+
+class TestOverviewRoundtrip:
+    def test_full_overview(self, tmp_path):
+        cands = make_cands()
+        w = CandidateFileWriter(str(tmp_path))
+        w.write_binary(cands)
+        hdr = SigprocHeader(
+            source_name="FAKE", tsamp=0.00032, fch1=1510.0, foff=-1.09,
+            nchans=64, nbits=2, nsamples=187520,
+        )
+        cfg = SearchConfig(dm_end=250.0, acc_start=-5.0, acc_end=5.0, npdmp=10)
+        out = OutputFileWriter()
+        out.add_misc_info()
+        out.add_header(hdr)
+        out.add_search_parameters(cfg, "tutorial.fil")
+        out.add_dm_list([0.0, 3.3133590221405])
+        out.add_acc_list([0.0, -5.0, 5.0])
+        out.add_device_info()
+        out.add_candidates(cands, w.byte_mapping)
+        out.add_timing_info({"total": 1.5, "searching": 1.0})
+        path = tmp_path / "overview.xml"
+        out.to_file(str(path))
+
+        ov = OverviewFile(str(path))
+        assert ov.header["nchans"] == "64"
+        assert ov.search_parameters["dm_tol"] == "1.10000002384186"
+        np.testing.assert_allclose(ov.dm_list, [0.0, 3.3133590221405])
+        np.testing.assert_allclose(ov.acc_list, [0.0, -5.0, 5.0])
+        assert len(ov.candidates) == 2
+        assert ov.candidates[0]["snr"] == pytest.approx(86.9, rel=1e-5)
+        assert ov.candidates[0]["nassoc"] == 1
+        assert ov.execution_times["total"] == 1.5
+        assert "PERIOD" in ov.make_predictor(0)
+
+    def test_parses_golden_overview(self, golden_xml, tmp_path):
+        path = tmp_path / "golden.xml"
+        path.write_text(golden_xml)
+        ov = OverviewFile(str(path))
+        assert len(ov.dm_list) == 59
+        assert len(ov.candidates) == 10
+        assert ov.candidates[0]["snr"] == pytest.approx(86.9626, rel=1e-5)
+        assert ov.candidates[0]["period"] == pytest.approx(0.2499399, rel=1e-6)

@@ -1,0 +1,122 @@
+"""Multi-chip sharding tests on the virtual 8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from peasoup_tpu.parallel import (
+    make_mesh,
+    device_count,
+    make_sharded_search_fn,
+    baseline_beam,
+    sharded_coincidence,
+)
+from peasoup_tpu.parallel.sharded_search import place_trials
+from peasoup_tpu.pipeline.accel_search import make_search_fn
+from peasoup_tpu.pipeline.search import _level_windows
+
+
+def test_virtual_mesh_has_8_devices():
+    assert device_count() == 8
+
+
+def test_make_mesh_shapes():
+    mesh = make_mesh()
+    assert mesh.shape == {"dm": 8}
+    mesh2 = make_mesh({"beam": 2, "dm": -1})
+    assert mesh2.shape == {"beam": 2, "dm": 4}
+    with pytest.raises(ValueError):
+        make_mesh({"dm": 3})
+
+
+class TestShardedSearch:
+    def make_inputs(self, ndm=8, size=4096, n_accs=4):
+        rng = np.random.default_rng(3)
+        t = np.arange(size)
+        tims = []
+        for d in range(ndm):
+            x = rng.normal(30, 3, size=size)
+            x += 10.0 * (((t * 0.000256) / 0.016) % 1.0 < 0.1)  # P=16ms pulsar
+            tims.append(np.clip(np.rint(x), 0, 255))
+        tims = np.asarray(tims, dtype=np.uint8)
+        afs = np.zeros((ndm, n_accs), dtype=np.float32)
+        windows = _level_windows(size, 2, 0.1, 1100.0, 0.000256)
+        zap = np.zeros(size // 2 + 1, dtype=bool)
+        return tims, afs, zap, windows
+
+    def test_matches_single_device(self):
+        tims, afs, zap, windows = self.make_inputs()
+        size = tims.shape[1]
+        kw = dict(size=size, nsamps_valid=size, nharms=2, max_peaks=64,
+                  pos5=10, pos25=100)
+        mesh = make_mesh()
+        sharded = make_sharded_search_fn(mesh, threshold=6.0)
+        peaks = sharded(
+            place_trials(mesh, tims), jnp.asarray(afs), jnp.asarray(zap),
+            jnp.asarray(windows), **kw,
+        )
+        single = make_search_fn(6.0)
+        for d in range(tims.shape[0]):
+            ref = single(jnp.asarray(tims[d]), jnp.asarray(afs[d]),
+                         jnp.asarray(zap), jnp.asarray(windows), **kw)
+            np.testing.assert_array_equal(np.asarray(peaks.idxs)[d],
+                                          np.asarray(ref.idxs))
+            np.testing.assert_allclose(np.asarray(peaks.snrs)[d],
+                                       np.asarray(ref.snrs), rtol=2e-5, atol=1e-4)
+            np.testing.assert_array_equal(np.asarray(peaks.counts)[d],
+                                          np.asarray(ref.counts))
+
+    def test_finds_the_pulsar_on_every_shard(self):
+        tims, afs, zap, windows = self.make_inputs()
+        mesh = make_mesh()
+        sharded = make_sharded_search_fn(mesh, threshold=6.0)
+        peaks = sharded(
+            place_trials(mesh, tims), jnp.asarray(afs), jnp.asarray(zap),
+            jnp.asarray(windows), size=tims.shape[1],
+            nsamps_valid=tims.shape[1], nharms=2, max_peaks=64, pos5=10,
+            pos25=100,
+        )
+        counts = np.asarray(peaks.counts)
+        assert (counts.sum(axis=(1, 2)) > 0).all()  # every DM shard fired
+
+
+class TestShardedCoincidence:
+    def test_matches_unsharded(self):
+        rng = np.random.default_rng(0)
+        beams = rng.normal(size=(8, 512)).astype(np.float32)
+        beams[:, 100] = 10.0  # all beams -> RFI
+        beams[0, 200] = 10.0  # one beam -> keep
+        mesh = make_mesh({"beam": 8})
+        out = np.asarray(
+            sharded_coincidence(mesh, jnp.asarray(beams), 4.0, 4)
+        )
+        from peasoup_tpu.ops import coincidence_mask
+
+        ref = np.asarray(coincidence_mask(jnp.asarray(beams), 4.0, 4))
+        np.testing.assert_array_equal(out, ref)
+        assert out[100] == 0.0 and out[200] == 1.0
+
+    def test_beam_axis_smaller_than_mesh_padding(self):
+        # 6 real beams padded to 8 with -inf so they never fire
+        rng = np.random.default_rng(1)
+        beams = rng.normal(size=(6, 256)).astype(np.float32)
+        beams[:, 50] = 99.0
+        pad = np.full((2, 256), -np.inf, dtype=np.float32)
+        stacked = np.concatenate([beams, pad])
+        mesh = make_mesh({"beam": 8})
+        out = np.asarray(sharded_coincidence(mesh, jnp.asarray(stacked), 4.0, 4))
+        assert out[50] == 0.0
+
+
+class TestBaselineBeam:
+    def test_outputs_normalised(self):
+        rng = np.random.default_rng(2)
+        x = np.clip(rng.normal(50, 5, size=4096), 0, 255).astype(np.uint8)
+        spec, tim = baseline_beam(jnp.asarray(x), size=4096, pos5=10, pos25=100)
+        spec, tim = np.asarray(spec), np.asarray(tim)
+        assert spec.shape == (2049,)
+        assert tim.shape == (4096,)
+        assert abs(np.mean(tim)) < 0.1  # normalised
+        assert np.std(tim) == pytest.approx(1.0, rel=0.1)
