@@ -18,7 +18,7 @@ Stages (reference line refs in parentheses):
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import NamedTuple
 
 import jax
@@ -99,9 +99,11 @@ def search_trial_core(
     )
 
 
+@lru_cache(maxsize=None)
 def make_search_fn(threshold: float):
     """Build the jitted per-DM-trial program with the S/N threshold
-    bound statically (it never changes within a run)."""
+    bound statically (it never changes within a run). Cached so repeat
+    runs with the same threshold reuse the compiled executable."""
 
     @partial(
         jax.jit,
@@ -117,3 +119,31 @@ def make_search_fn(threshold: float):
         )
 
     return search_dm_trial
+
+
+@lru_cache(maxsize=None)
+def make_batched_search_fn(threshold: float):
+    """Jitted (D, ...) -> (D, ...) search over a block of DM trials.
+
+    A fixed (dm_block, accel_bucket) tile shape is the unit of device
+    work (SURVEY.md §7): one compile covers the whole run, and the vmap
+    amortises dispatch — the reference instead launches ~10 kernels per
+    (DM, accel) pair (src/pipeline_multi.cu:209-239).
+    """
+
+    @partial(
+        jax.jit,
+        static_argnames=("size", "nsamps_valid", "nharms", "max_peaks", "pos5",
+                         "pos25"),
+    )
+    def search_dm_block(tims, afs, zapmask, windows, *, size, nsamps_valid,
+                        nharms, max_peaks, pos5, pos25) -> AccelSearchPeaks:
+        return jax.vmap(
+            lambda t, a: search_trial_core(
+                t, a, zapmask, windows,
+                threshold=threshold, size=size, nsamps_valid=nsamps_valid,
+                nharms=nharms, max_peaks=max_peaks, pos5=pos5, pos25=pos25,
+            )
+        )(tims, afs)
+
+    return search_dm_block

@@ -23,8 +23,16 @@ SPEED_OF_LIGHT = 299792458.0
 
 
 class BaseDistiller:
+    """condition() implementations read the precomputed column arrays
+    (self.freqs/accs/nhs) instead of walking the Candidate objects —
+    the arrays are built once per distill() call, keeping the O(n^2)
+    survivor loop in vectorised numpy."""
+
     def __init__(self, keep_related: bool):
         self.keep_related = keep_related
+        self.freqs: np.ndarray | None = None
+        self.accs: np.ndarray | None = None
+        self.nhs: np.ndarray | None = None
 
     def condition(self, cands, idx, unique) -> None:
         raise NotImplementedError
@@ -32,6 +40,9 @@ class BaseDistiller:
     def distill(self, cands: List[Candidate]) -> List[Candidate]:
         size = len(cands)
         cands = sorted(cands, key=lambda c: -c.snr)  # S/N desc, stable
+        self.freqs = np.array([c.freq for c in cands], dtype=np.float64)
+        self.accs = np.array([c.acc for c in cands], dtype=np.float64)
+        self.nhs = np.array([c.nh for c in cands], dtype=np.int64)
         unique = np.ones(size, dtype=bool)
         idx = 0
         while idx < size:
@@ -56,24 +67,29 @@ class HarmonicDistiller(BaseDistiller):
         size = len(cands)
         if idx + 1 >= size:
             return
-        fundi = cands[idx].freq
-        freqs = np.array([c.freq for c in cands[idx + 1 :]])
-        nhs = np.array([c.nh for c in cands[idx + 1 :]])
+        fundi = self.freqs[idx]
+        freqs = self.freqs[idx + 1 :]
+        nhs = self.nhs[idx + 1 :]
         # hits counts matching (jj, kk) harmonic pairs per candidate: the
         # reference appends to assoc once PER MATCHING PAIR
         # (distiller.hpp:92-101), which feeds nassoc and the ddm ratios.
-        hits = np.zeros(len(freqs), dtype=np.int64)
         if self.fractional_harms:
-            max_denoms = (2.0 ** nhs).astype(int)
+            max_denoms = np.exp2(nhs).astype(np.int64)
         else:
-            max_denoms = np.ones(len(freqs), dtype=int)
+            max_denoms = np.ones(len(freqs), dtype=np.int64)
+        max_kk = int(max_denoms.max()) if len(max_denoms) else 1
+        # all kk at once per jj: ratio[k, i] = kk_k*freqs_i/(jj*fundi);
+        # chunking over jj keeps the transient matrix at (max_kk, n)
+        kk = np.arange(1, max_kk + 1)
+        kk_valid = kk[:, None] <= max_denoms[None, :]
+        hits = np.zeros(len(freqs), dtype=np.int64)
         for jj in range(1, self.max_harm + 1):
-            for kk in range(1, int(max_denoms.max()) + 1):
-                valid = kk <= max_denoms
-                ratio = kk * freqs / (jj * fundi)
-                hits += (
-                    valid & (ratio > 1 - self.tolerance) & (ratio < 1 + self.tolerance)
-                )
+            ratio = (kk[:, None] * freqs[None, :]) / (jj * fundi)
+            hits += (
+                kk_valid
+                & (ratio > 1 - self.tolerance)
+                & (ratio < 1 + self.tolerance)
+            ).sum(axis=0)
         for off in np.nonzero(hits)[0]:
             target = idx + 1 + off
             if self.keep_related:
@@ -97,11 +113,11 @@ class AccelerationDistiller(BaseDistiller):
         size = len(cands)
         if idx + 1 >= size:
             return
-        fundi_freq = cands[idx].freq
-        fundi_acc = cands[idx].acc
+        fundi_freq = self.freqs[idx]
+        fundi_acc = self.accs[idx]
         edge = fundi_freq * self.tolerance
-        freqs = np.array([c.freq for c in cands[idx + 1 :]])
-        accs = np.array([c.acc for c in cands[idx + 1 :]])
+        freqs = self.freqs[idx + 1 :]
+        accs = self.accs[idx + 1 :]
         delta_acc = fundi_acc - accs
         acc_freq = fundi_freq + delta_acc * fundi_freq * self.tobs_over_c
         upper_case = acc_freq > fundi_freq
@@ -129,9 +145,8 @@ class DMDistiller(BaseDistiller):
         size = len(cands)
         if idx + 1 >= size:
             return
-        fundi = cands[idx].freq
-        freqs = np.array([c.freq for c in cands[idx + 1 :]])
-        ratio = freqs / fundi
+        fundi = self.freqs[idx]
+        ratio = self.freqs[idx + 1 :] / fundi
         hit = (ratio > 1 - self.tolerance) & (ratio < 1 + self.tolerance)
         for off in np.nonzero(hit)[0]:
             target = idx + 1 + off

@@ -37,6 +37,7 @@ def _deredden_tim(tim: jax.Array, *, size: int, pos5: int, pos25: int) -> jax.Ar
 class MultiFolder:
     min_period = 1e-3
     max_period = 10.0
+    fold_bucket = 8  # candidate batches padded to a multiple of this
 
     def __init__(
         self,
@@ -75,19 +76,24 @@ class MultiFolder:
                 pos5=self.pos5,
                 pos25=self.pos25,
             )
+            # pad the candidate batch to a fixed width so every DM group
+            # reuses one compiled (K_pad, N) resample+fold program
+            k = len(cand_ids)
+            k_pad = int(np.ceil(k / self.fold_bucket) * self.fold_bucket)
+            ids_pad = cand_ids + [cand_ids[0]] * (k_pad - k)
             # batched resample (the folder uses the quadratic v1 kernel,
             # folder.hpp:396 -> kernels.cu:308-332)
             afs = np.array(
                 [
                     cands[ci].acc * self.tsamp / (2.0 * SPEED_OF_LIGHT)
-                    for ci in cand_ids
+                    for ci in ids_pad
                 ],
                 dtype=np.float32,
             )
             xr = jax.vmap(lambda af: resample_accel_quadratic(xd, af))(
                 jnp.asarray(afs)
-            )  # (K, N)
-            periods = np.array([1.0 / cands[ci].freq for ci in cand_ids])
+            )  # (K_pad, N)
+            periods = np.array([1.0 / cands[ci].freq for ci in ids_pad])
             used = self.nints * (self.nsamps // self.nints)
             flat_bins = np.stack(
                 [
@@ -101,15 +107,21 @@ class MultiFolder:
                 nbins=self.nbins,
                 nints=self.nints,
             )
-            all_folds.append(np.asarray(folds))
-            all_periods.extend(periods)
+            all_folds.append(np.asarray(folds)[:k])
+            all_periods.extend(periods[:k])
             all_cand_idx.extend(cand_ids)
 
         if all_cand_idx:
             folds = np.concatenate(all_folds, axis=0)
+            k = folds.shape[0]
+            k_pad = int(np.ceil(k / self.fold_bucket) * self.fold_bucket)
+            if k_pad > k:  # fixed batch width -> one compiled optimiser
+                reps = int(np.ceil(k_pad / k))
+                folds = np.concatenate([folds] * reps, axis=0)[:k_pad]
+                all_periods = (list(all_periods) * reps)[:k_pad]
             results = self.optimiser.optimise(
                 folds, np.asarray(all_periods), self.tobs
-            )
+            )[:k]
             for ci, res in zip(all_cand_idx, results):
                 cands[ci].folded_snr = res["opt_sn"]
                 cands[ci].opt_period = res["opt_period"]

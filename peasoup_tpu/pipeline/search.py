@@ -28,7 +28,7 @@ from ..ops.zap import birdie_mask
 from ..plan.accel_plan import AccelerationPlan
 from ..plan.dm_plan import DMPlan
 from ..plan.fft_plan import choose_fft_size
-from .accel_search import make_search_fn
+from .accel_search import make_batched_search_fn
 from .distill import AccelerationDistiller, DMDistiller, HarmonicDistiller
 from .folder import MultiFolder
 from .score import CandidateScorer
@@ -65,9 +65,10 @@ class SearchConfig:
     verbose: bool = False
     progress_bar: bool = False
     # TPU-specific knobs (no reference equivalent)
-    max_peaks: int = 4096  # static peak-compaction size per spectrum
+    max_peaks: int = 512  # static peak-compaction size per spectrum
     dedisp_block: int = 16  # DM trials per dedispersion launch
-    accel_bucket: int = 8  # accel batch padded to a multiple of this
+    accel_bucket: int = 16  # accel batch padded to a multiple of this
+    dm_block: int = 8  # DM trials searched per device call
 
 
 @dataclass
@@ -174,49 +175,88 @@ class PeasoupSearch:
         factors = [
             _freq_factor(size, nh, fil.tsamp) for nh in range(cfg.nharmonics + 1)
         ]
-        search_fn = make_search_fn(cfg.min_snr)
         pos5 = int(cfg.boundary_5_freq / bin_width)
         pos25 = int(cfg.boundary_25_freq / bin_width)
 
         harm_finder = HarmonicDistiller(cfg.freq_tol, cfg.max_harm, keep_related=False)
         acc_still = AccelerationDistiller(tobs, cfg.freq_tol, keep_related=True)
 
-        # --- per-DM-trial loop ---------------------------------------------
+        # --- batched DM-trial search ----------------------------------------
+        # DM trials are grouped by padded accel-list size and processed in
+        # fixed (dm_block, accel_bucket) tiles: one compile per distinct
+        # tile shape, vmapped over the block (vs the reference's per-trial
+        # kernel launches). The search itself is device work; candidate
+        # clustering/distilling below is tiny host work per trial.
         t0 = time.time()
+        accel_lists = [
+            acc_plan.generate_accel_list(float(dm)) for dm in dm_plan.dm_list
+        ]
+        bucket = cfg.accel_bucket
+        by_bucket: dict[int, list[int]] = {}
+        for dm_idx, accs in enumerate(accel_lists):
+            padded = int(math.ceil(len(accs) / bucket) * bucket)
+            by_bucket.setdefault(padded, []).append(dm_idx)
+
+        search_block = make_batched_search_fn(cfg.min_snr)
+        tim_len = min(size, trials.shape[1])
+        per_dm_results: dict[int, tuple] = {}
+        for padded, dm_indices in sorted(by_bucket.items()):
+            for start in range(0, len(dm_indices), cfg.dm_block):
+                chunk = dm_indices[start : start + cfg.dm_block]
+                real = len(chunk)
+                # pad the block by repeating the first trial (discarded)
+                block_idx = chunk + [chunk[0]] * (cfg.dm_block - real)
+                afs = np.zeros((cfg.dm_block, padded), dtype=np.float32)
+                for row, dm_idx in enumerate(block_idx):
+                    accs = accel_lists[dm_idx]
+                    afs[row, : len(accs)] = accel_factor(
+                        accs, fil.tsamp
+                    ).astype(np.float32)
+                tims_dev = jnp.asarray(trials[block_idx, :tim_len])
+                afs_dev = jnp.asarray(afs)
+                max_peaks = cfg.max_peaks
+                while True:
+                    peaks = search_block(
+                        tims_dev,
+                        afs_dev,
+                        zapmask_dev,
+                        windows,
+                        size=size,
+                        nsamps_valid=nsamps_valid,
+                        nharms=cfg.nharmonics,
+                        max_peaks=max_peaks,
+                        pos5=pos5,
+                        pos25=pos25,
+                    )
+                    counts = np.asarray(peaks.counts)
+                    if counts.max() <= max_peaks:
+                        break
+                    # overflow: escalate the static compaction size so no
+                    # threshold crossing is lost (the reference sizes for
+                    # 100000, peakfinder.hpp:61); costs one extra compile
+                    # only on pathological blocks
+                    max_peaks = 1 << int(np.ceil(np.log2(counts.max())))
+                idxs = np.asarray(peaks.idxs)  # (B, L, A, maxp)
+                snrs = np.asarray(peaks.snrs)
+                for row in range(real):
+                    # trim to this trial's own maximum count: bounds host
+                    # memory and detaches the padded block buffers
+                    mx = max(int(counts[row].max()), 1)
+                    per_dm_results[chunk[row]] = (
+                        idxs[row][:, :, :mx].copy(),
+                        snrs[row][:, :, :mx].copy(),
+                        counts[row].copy(),
+                    )
+        timers["search_device"] = time.time() - t0
+
+        # --- host candidate bookkeeping (ascending DM order) ----------------
+        t_host = time.time()
         dm_trial_cands = CandidateCollection()
         for dm_idx, dm in enumerate(dm_plan.dm_list):
-            accs = acc_plan.generate_accel_list(float(dm))
-            n_accs = len(accs)
-            bucket = cfg.accel_bucket
-            padded = int(math.ceil(n_accs / bucket) * bucket)
-            afs = np.zeros(padded, dtype=np.float32)
-            afs[:n_accs] = accel_factor(accs, fil.tsamp).astype(np.float32)
-            peaks = search_fn(
-                jnp.asarray(trials[dm_idx]),
-                jnp.asarray(afs),
-                zapmask_dev,
-                windows,
-                size=size,
-                nsamps_valid=nsamps_valid,
-                nharms=cfg.nharmonics,
-                max_peaks=cfg.max_peaks,
-                pos5=pos5,
-                pos25=pos25,
-            )
-            idxs = np.asarray(peaks.idxs)  # (L, A, maxp)
-            snrs = np.asarray(peaks.snrs)
-            counts = np.asarray(peaks.counts)
-
-            if counts.max() > cfg.max_peaks:
-                import warnings
-
-                warnings.warn(
-                    f"peak compaction overflow at DM {dm}: {int(counts.max())} "
-                    f"threshold crossings > max_peaks={cfg.max_peaks}; raising "
-                    "max_peaks (or min_snr) is required to keep all candidates"
-                )
+            idxs, snrs, counts = per_dm_results.pop(dm_idx)
+            accs = accel_lists[dm_idx]
             accel_trial_cands = CandidateCollection()
-            for a_idx in range(n_accs):
+            for a_idx in range(len(accs)):
                 acc = float(accs[a_idx])
                 trial_cands: list[Candidate] = []
                 for lvl in range(cfg.nharmonics + 1):
@@ -240,8 +280,9 @@ class PeasoupSearch:
             if cfg.verbose:
                 print(
                     f"DM {dm:.3f} ({dm_idx+1}/{dm_plan.ndm}): "
-                    f"{n_accs} accel trials, {len(dm_trial_cands)} cands so far"
+                    f"{len(accs)} accel trials, {len(dm_trial_cands)} cands so far"
                 )
+        timers["search_host"] = time.time() - t_host
         timers["searching"] = time.time() - t0
 
         # --- global distilling / scoring / folding --------------------------
