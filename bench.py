@@ -38,19 +38,9 @@ def main() -> int:
     # Warm-up: compile everything once (cached afterwards).
     warm = search.run(fil)
 
-    # Steady-state timing.
+    # Steady-state timing; trial count comes from the search itself.
     res = search.run(fil)
-    # trial count from the same plan code path as the search driver
-    from peasoup_tpu.plan import AccelerationPlan, choose_fft_size
-
-    size = choose_fft_size(fil.nsamps, cfg.size)
-    ap = AccelerationPlan(
-        cfg.acc_start, cfg.acc_end, cfg.acc_tol, cfg.acc_pulse_width,
-        size, fil.tsamp, fil.cfreq, fil.foff,
-    )
-    n_trials = sum(
-        len(ap.generate_accel_list(float(dm))) for dm in res.dm_list
-    )
+    n_trials = res.n_accel_trials
 
     searching = res.timers["searching"]
     value = n_trials / searching

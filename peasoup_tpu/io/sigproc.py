@@ -185,8 +185,18 @@ def write_sigproc_header(stream: BinaryIO, hdr: SigprocHeader) -> None:
 # ---------------------------------------------------------------------------
 
 def unpack_bits(raw: np.ndarray, nbits: int) -> np.ndarray:
-    """Unpack a u8 byte array into individual samples (LSB-first)."""
+    """Unpack a u8 byte array into individual samples (LSB-first).
+
+    Uses the native C++ runtime when available (peasoup_tpu.native);
+    numpy fallback below is the behavioural oracle.
+    """
     raw = np.ascontiguousarray(raw, dtype=np.uint8)
+    if nbits in (1, 2, 4):
+        from .. import native
+
+        out = native.unpack_bits(raw, nbits)
+        if out is not None:
+            return out
     if nbits == 8:
         return raw
     if nbits == 4:

@@ -1,0 +1,159 @@
+"""ctypes bindings for the native host runtime (libpeasoup_host.so).
+
+Every entry point has a pure-Python/numpy fallback elsewhere in the
+package; callers use :func:`available` / the None-returning loaders to
+decide. The library builds on demand with the system g++.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+_i8p = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
+_i32p = np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS")
+_i64p = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
+_f32p = np.ctypeslib.ndpointer(dtype=np.float32, flags="C_CONTIGUOUS")
+_f64p = np.ctypeslib.ndpointer(dtype=np.float64, flags="C_CONTIGUOUS")
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_attempted
+    if _lib is not None or _load_attempted:
+        return _lib
+    _load_attempted = True
+    if os.environ.get("PEASOUP_NO_NATIVE"):
+        return None
+    from .build import build
+
+    path = build()
+    if path is None:
+        return None
+    lib = ctypes.CDLL(path)
+
+    lib.ps_unpack_bits.argtypes = [_i8p, ctypes.c_int64, ctypes.c_int, _i8p]
+    lib.ps_unpack_bits.restype = None
+
+    lib.ps_cluster_peaks.argtypes = [
+        _i32p, _f32p, ctypes.c_int64, ctypes.c_int32, _i64p, _f64p,
+    ]
+    lib.ps_cluster_peaks.restype = ctypes.c_int64
+
+    lib.ps_harmonic_distill.argtypes = [
+        _f64p, _i32p, ctypes.c_int64, ctypes.c_double, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32, _i8p, _i32p, _i32p, ctypes.c_int64,
+    ]
+    lib.ps_harmonic_distill.restype = ctypes.c_int64
+
+    lib.ps_accel_distill.argtypes = [
+        _f64p, _f64p, ctypes.c_int64, ctypes.c_double, ctypes.c_double,
+        ctypes.c_int32, _i8p, _i32p, _i32p, ctypes.c_int64,
+    ]
+    lib.ps_accel_distill.restype = ctypes.c_int64
+
+    lib.ps_dm_distill.argtypes = [
+        _f64p, ctypes.c_int64, ctypes.c_double, ctypes.c_int32, _i8p, _i32p,
+        _i32p, ctypes.c_int64,
+    ]
+    lib.ps_dm_distill.restype = ctypes.c_int64
+
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def unpack_bits(raw: np.ndarray, nbits: int) -> Optional[np.ndarray]:
+    lib = _load()
+    if lib is None or nbits not in (1, 2, 4, 8):
+        return None
+    raw = np.ascontiguousarray(raw, dtype=np.uint8)
+    out = np.empty(raw.size * 8 // nbits, dtype=np.uint8)
+    lib.ps_unpack_bits(raw, raw.size, nbits, out)
+    return out
+
+
+def cluster_peaks(
+    idxs: np.ndarray, snrs: np.ndarray, count: int, min_gap: int
+) -> Optional[tuple[np.ndarray, np.ndarray]]:
+    lib = _load()
+    if lib is None:
+        return None
+    count = int(min(count, len(idxs)))
+    idxs = np.ascontiguousarray(idxs[:count], dtype=np.int32)
+    snrs = np.ascontiguousarray(snrs[:count], dtype=np.float32)
+    out_idx = np.empty(max(count, 1), dtype=np.int64)
+    out_snr = np.empty(max(count, 1), dtype=np.float64)
+    n = lib.ps_cluster_peaks(idxs, snrs, count, min_gap, out_idx, out_snr)
+    return out_idx[:n].copy(), out_snr[:n].copy()
+
+
+def _edge_buffers(n_hint: int) -> tuple[np.ndarray, np.ndarray]:
+    cap = max(n_hint, 1024)
+    return np.empty(cap, np.int32), np.empty(cap, np.int32)
+
+
+def _run_distill(call, n: int):
+    """Run a distill entry point, growing the edge buffer on overflow."""
+    cap = max(4 * n, 1024)
+    while True:
+        src = np.empty(cap, np.int32)
+        dst = np.empty(cap, np.int32)
+        unique = np.empty(n, np.uint8)
+        n_edges = call(unique, src, dst, cap)
+        if n_edges <= cap:
+            return unique.astype(bool), src[:n_edges], dst[:n_edges]
+        cap = int(n_edges)
+
+
+def harmonic_distill(freqs, nhs, tol, max_harm, fractional, keep_related):
+    lib = _load()
+    if lib is None:
+        return None
+    freqs = np.ascontiguousarray(freqs, dtype=np.float64)
+    nhs = np.ascontiguousarray(nhs, dtype=np.int32)
+    n = len(freqs)
+    return _run_distill(
+        lambda u, s, d, cap: lib.ps_harmonic_distill(
+            freqs, nhs, n, tol, max_harm, int(fractional), int(keep_related),
+            u, s, d, cap,
+        ),
+        n,
+    )
+
+
+def accel_distill(freqs, accs, tobs_over_c, tol, keep_related):
+    lib = _load()
+    if lib is None:
+        return None
+    freqs = np.ascontiguousarray(freqs, dtype=np.float64)
+    accs = np.ascontiguousarray(accs, dtype=np.float64)
+    n = len(freqs)
+    return _run_distill(
+        lambda u, s, d, cap: lib.ps_accel_distill(
+            freqs, accs, n, tobs_over_c, tol, int(keep_related), u, s, d, cap,
+        ),
+        n,
+    )
+
+
+def dm_distill(freqs, tol, keep_related):
+    lib = _load()
+    if lib is None:
+        return None
+    freqs = np.ascontiguousarray(freqs, dtype=np.float64)
+    n = len(freqs)
+    return _run_distill(
+        lambda u, s, d, cap: lib.ps_dm_distill(
+            freqs, n, tol, int(keep_related), u, s, d, cap,
+        ),
+        n,
+    )

@@ -1,0 +1,49 @@
+"""Build libpeasoup_host.so with the system C++ toolchain.
+
+Invoked lazily on first use (or explicitly: python -m
+peasoup_tpu.native.build). No pybind11 — plain C ABI via ctypes.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(_DIR, "src", "host_kernels.cpp")
+LIB = os.path.join(_DIR, "libpeasoup_host.so")
+
+
+def build(force: bool = False) -> str | None:
+    """Compile the shared library; returns its path or None on failure."""
+    if not force and os.path.exists(LIB) and os.path.getmtime(
+        LIB
+    ) >= os.path.getmtime(SRC):
+        return LIB
+    cmd = [
+        os.environ.get("CXX", "g++"),
+        "-O3",
+        "-march=native",
+        "-shared",
+        "-fPIC",
+        "-std=c++17",
+        SRC,
+        "-o",
+        LIB,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except (subprocess.CalledProcessError, FileNotFoundError) as exc:
+        import warnings
+
+        detail = getattr(exc, "stderr", "") or str(exc)
+        warnings.warn(f"native build failed, using Python fallback: {detail}")
+        return None
+    return LIB
+
+
+if __name__ == "__main__":
+    path = build(force="--force" in sys.argv)
+    print(path or "BUILD FAILED")
+    sys.exit(0 if path else 1)

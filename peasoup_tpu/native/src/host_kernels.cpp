@@ -1,0 +1,188 @@
+// Native host runtime for peasoup_tpu.
+//
+// The reference keeps its host-side hot loops in C++ (candidate
+// distilling include/transforms/distiller.hpp, peak clustering
+// peakfinder.hpp:27-56, bit handling inside libdedisp); this library is
+// the TPU build's equivalent. Exposed as a plain C ABI consumed via
+// ctypes — no pybind11 dependency.
+//
+// Semantics mirror the Python implementations exactly (which in turn
+// mirror the reference); the Python versions remain as fallback and as
+// the parity oracle in tests.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <numeric>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Bit unpacking (LSB-first within each byte, like sigproc/dedisp sub-words)
+// ---------------------------------------------------------------------------
+void ps_unpack_bits(const uint8_t* in, int64_t nbytes, int nbits, uint8_t* out) {
+  switch (nbits) {
+    case 8:
+      std::memcpy(out, in, static_cast<size_t>(nbytes));
+      break;
+    case 4:
+      for (int64_t i = 0; i < nbytes; ++i) {
+        out[2 * i] = in[i] & 0x0F;
+        out[2 * i + 1] = in[i] >> 4;
+      }
+      break;
+    case 2:
+      for (int64_t i = 0; i < nbytes; ++i) {
+        const uint8_t b = in[i];
+        out[4 * i] = b & 0x03;
+        out[4 * i + 1] = (b >> 2) & 0x03;
+        out[4 * i + 2] = (b >> 4) & 0x03;
+        out[4 * i + 3] = (b >> 6) & 0x03;
+      }
+      break;
+    case 1:
+      for (int64_t i = 0; i < nbytes; ++i) {
+        const uint8_t b = in[i];
+        for (int k = 0; k < 8; ++k) out[8 * i + k] = (b >> k) & 1;
+      }
+      break;
+    default:
+      break;  // unsupported widths are rejected on the Python side
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Peak clustering (exact port of identify_unique_peaks,
+// peakfinder.hpp:27-56 — including the lastidx-advances-only-on-new-max
+// quirk)
+// ---------------------------------------------------------------------------
+int64_t ps_cluster_peaks(const int32_t* idxs, const float* snrs, int64_t count,
+                         int32_t min_gap, int64_t* out_idx, double* out_snr) {
+  int64_t npeaks = 0;
+  int64_t ii = 0;
+  while (ii < count) {
+    float cpeak = snrs[ii];
+    int32_t cpeakidx = idxs[ii];
+    int32_t lastidx = idxs[ii];
+    ++ii;
+    while (ii < count && (idxs[ii] - lastidx) < min_gap) {
+      if (snrs[ii] > cpeak) {
+        cpeak = snrs[ii];
+        cpeakidx = idxs[ii];
+        lastidx = idxs[ii];
+      }
+      ++ii;
+    }
+    out_idx[npeaks] = cpeakidx;
+    out_snr[npeaks] = static_cast<double>(cpeak);
+    ++npeaks;
+  }
+  return npeaks;
+}
+
+// ---------------------------------------------------------------------------
+// Distillers. Inputs are candidate columns ALREADY sorted by S/N
+// descending. Outputs: unique mask (1 = survivor) and an edge list
+// (fundamental index, absorbed index) with one entry PER MATCHING
+// HARMONIC PAIR (multiplicity feeds nassoc / ddm ratios).
+// Returns the number of edges written (capped at max_edges; the caller
+// retries with a larger buffer if the return value exceeds it).
+// ---------------------------------------------------------------------------
+
+struct EdgeSink {
+  int32_t* src;
+  int32_t* dst;
+  int64_t cap;
+  int64_t n = 0;
+  void add(int64_t s, int64_t d) {
+    if (n < cap) {
+      src[n] = static_cast<int32_t>(s);
+      dst[n] = static_cast<int32_t>(d);
+    }
+    ++n;
+  }
+};
+
+int64_t ps_harmonic_distill(const double* freqs, const int32_t* nhs, int64_t n,
+                            double tol, int32_t max_harm, int32_t fractional,
+                            int32_t keep_related, uint8_t* unique,
+                            int32_t* edge_src, int32_t* edge_dst,
+                            int64_t max_edges) {
+  std::fill(unique, unique + n, uint8_t{1});
+  EdgeSink edges{edge_src, edge_dst, max_edges};
+  const double lo = 1.0 - tol, hi = 1.0 + tol;
+  for (int64_t idx = 0; idx < n; ++idx) {
+    if (!unique[idx]) continue;
+    const double fundi = freqs[idx];
+    for (int64_t jjt = idx + 1; jjt < n; ++jjt) {
+      const double freq = freqs[jjt];
+      const double max_denom = fractional ? std::pow(2.0, nhs[jjt]) : 1.0;
+      bool hit = false;
+      for (int32_t jj = 1; jj <= max_harm; ++jj) {
+        for (int32_t kk = 1; kk <= static_cast<int32_t>(max_denom); ++kk) {
+          const double ratio = kk * freq / (jj * fundi);
+          if (ratio > lo && ratio < hi) {
+            hit = true;
+            if (keep_related) edges.add(idx, jjt);
+          }
+        }
+      }
+      if (hit) unique[jjt] = 0;
+    }
+  }
+  return edges.n;
+}
+
+int64_t ps_accel_distill(const double* freqs, const double* accs, int64_t n,
+                         double tobs_over_c, double tol, int32_t keep_related,
+                         uint8_t* unique, int32_t* edge_src, int32_t* edge_dst,
+                         int64_t max_edges) {
+  std::fill(unique, unique + n, uint8_t{1});
+  EdgeSink edges{edge_src, edge_dst, max_edges};
+  for (int64_t idx = 0; idx < n; ++idx) {
+    if (!unique[idx]) continue;
+    const double fundi_freq = freqs[idx];
+    const double fundi_acc = accs[idx];
+    const double edge = fundi_freq * tol;
+    for (int64_t jj = idx + 1; jj < n; ++jj) {
+      const double delta_acc = fundi_acc - accs[jj];
+      const double acc_freq =
+          fundi_freq + delta_acc * fundi_freq * tobs_over_c;
+      bool hit;
+      if (acc_freq > fundi_freq) {
+        hit = freqs[jj] > fundi_freq - edge && freqs[jj] < acc_freq + edge;
+      } else {
+        hit = freqs[jj] < fundi_freq + edge && freqs[jj] > acc_freq - edge;
+      }
+      if (hit) {
+        if (keep_related) edges.add(idx, jj);
+        unique[jj] = 0;
+      }
+    }
+  }
+  return edges.n;
+}
+
+int64_t ps_dm_distill(const double* freqs, int64_t n, double tol,
+                      int32_t keep_related, uint8_t* unique, int32_t* edge_src,
+                      int32_t* edge_dst, int64_t max_edges) {
+  std::fill(unique, unique + n, uint8_t{1});
+  EdgeSink edges{edge_src, edge_dst, max_edges};
+  const double lo = 1.0 - tol, hi = 1.0 + tol;
+  for (int64_t idx = 0; idx < n; ++idx) {
+    if (!unique[idx]) continue;
+    const double fundi = freqs[idx];
+    for (int64_t jj = idx + 1; jj < n; ++jj) {
+      const double ratio = freqs[jj] / fundi;
+      if (ratio > lo && ratio < hi) {
+        if (keep_related) edges.add(idx, jj);
+        unique[jj] = 0;
+      }
+    }
+  }
+  return edges.n;
+}
+
+}  // extern "C"

@@ -72,8 +72,14 @@ def cluster_peaks(
     Walks ascending indices; within a run where consecutive gaps stay
     below ``min_gap`` keeps the highest snr. Quirk preserved: ``lastidx``
     only advances when a higher snr is found, so a slow ramp of weak
-    peaks can terminate a cluster early.
+    peaks can terminate a cluster early. Runs in the native C++ host
+    runtime when available.
     """
+    from .. import native
+
+    res = native.cluster_peaks(np.asarray(idxs), np.asarray(snrs), count, min_gap)
+    if res is not None:
+        return res
     peak_idx = []
     peak_snr = []
     ii = 0

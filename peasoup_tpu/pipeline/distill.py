@@ -37,12 +37,24 @@ class BaseDistiller:
     def condition(self, cands, idx, unique) -> None:
         raise NotImplementedError
 
+    def _native(self, cands):
+        """Return (unique_mask, edge_src, edge_dst) from the C++ runtime,
+        or None to use the Python survivor loop."""
+        return None
+
     def distill(self, cands: List[Candidate]) -> List[Candidate]:
         size = len(cands)
         cands = sorted(cands, key=lambda c: -c.snr)  # S/N desc, stable
         self.freqs = np.array([c.freq for c in cands], dtype=np.float64)
         self.accs = np.array([c.acc for c in cands], dtype=np.float64)
         self.nhs = np.array([c.nh for c in cands], dtype=np.int64)
+        native_res = self._native(cands)
+        if native_res is not None:
+            unique, src, dst = native_res
+            if self.keep_related:
+                for s, d in zip(src, dst):
+                    cands[s].append(cands[d])
+            return [c for c, u in zip(cands, unique) if u]
         unique = np.ones(size, dtype=bool)
         idx = 0
         while idx < size:
@@ -62,6 +74,14 @@ class HarmonicDistiller(BaseDistiller):
         self.tolerance = tol
         self.max_harm = int(max_harm)
         self.fractional_harms = fractional_harms
+
+    def _native(self, cands):
+        from .. import native
+
+        return native.harmonic_distill(
+            self.freqs, self.nhs, self.tolerance, self.max_harm,
+            self.fractional_harms, self.keep_related,
+        )
 
     def condition(self, cands, idx, unique) -> None:
         size = len(cands)
@@ -109,6 +129,14 @@ class AccelerationDistiller(BaseDistiller):
         self.tobs_over_c = tobs / SPEED_OF_LIGHT
         self.tolerance = tol
 
+    def _native(self, cands):
+        from .. import native
+
+        return native.accel_distill(
+            self.freqs, self.accs, self.tobs_over_c, self.tolerance,
+            self.keep_related,
+        )
+
     def condition(self, cands, idx, unique) -> None:
         size = len(cands)
         if idx + 1 >= size:
@@ -140,6 +168,11 @@ class DMDistiller(BaseDistiller):
     def __init__(self, tol: float, keep_related: bool):
         super().__init__(keep_related)
         self.tolerance = tol
+
+    def _native(self, cands):
+        from .. import native
+
+        return native.dm_distill(self.freqs, self.tolerance, self.keep_related)
 
     def condition(self, cands, idx, unique) -> None:
         size = len(cands)

@@ -79,6 +79,7 @@ class SearchResult:
     timers: dict
     nsamps: int
     size: int
+    n_accel_trials: int = 0  # total DM x accel trials actually searched
 
 
 def _level_windows(
@@ -317,4 +318,5 @@ class PeasoupSearch:
             timers=timers,
             nsamps=fil.nsamps,
             size=size,
+            n_accel_trials=sum(len(a) for a in accel_lists),
         )

@@ -10,9 +10,10 @@ jax = pytest.importorskip("jax")
 
 
 def load_graft():
-    spec = importlib.util.spec_from_file_location(
-        "__graft_entry__", "/root/repo/__graft_entry__.py"
-    )
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "..", "__graft_entry__.py")
+    spec = importlib.util.spec_from_file_location("__graft_entry__", path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod

@@ -1,0 +1,113 @@
+"""Native C++ host runtime: parity against the pure-Python oracles."""
+
+import numpy as np
+import pytest
+
+from peasoup_tpu import native
+from peasoup_tpu.core import Candidate
+from peasoup_tpu.io.sigproc import pack_bits
+from peasoup_tpu.pipeline.distill import (
+    AccelerationDistiller,
+    DMDistiller,
+    HarmonicDistiller,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable"
+)
+
+
+@pytest.mark.parametrize("nbits", [1, 2, 4, 8])
+def test_unpack_bits_parity(nbits, rng):
+    samples = rng.integers(0, 1 << nbits, size=4096).astype(np.uint8)
+    packed = pack_bits(samples, nbits)
+    out = native.unpack_bits(packed, nbits)
+    np.testing.assert_array_equal(out, samples)
+
+
+def test_cluster_peaks_parity(rng):
+    # random sparse crossings, ascending indices
+    from peasoup_tpu.ops import peaks as peaks_mod
+
+    n = 500
+    idxs = np.sort(rng.choice(100000, size=n, replace=False)).astype(np.int32)
+    snrs = rng.uniform(9, 50, size=n).astype(np.float32)
+
+    nat = native.cluster_peaks(idxs, snrs, n, 30)
+    # force the Python path
+    py_idx, py_snr = [], []
+    ii = 0
+    while ii < n:
+        cpeak, cidx, last = snrs[ii], idxs[ii], idxs[ii]
+        ii += 1
+        while ii < n and (idxs[ii] - last) < 30:
+            if snrs[ii] > cpeak:
+                cpeak, cidx, last = snrs[ii], idxs[ii], idxs[ii]
+            ii += 1
+        py_idx.append(cidx)
+        py_snr.append(cpeak)
+    np.testing.assert_array_equal(nat[0], py_idx)
+    np.testing.assert_allclose(nat[1], py_snr, rtol=1e-6)
+
+
+def random_cands(rng, n=300):
+    cands = []
+    for _ in range(n):
+        f0 = rng.uniform(0.5, 100.0)
+        # half the candidates are near-harmonics of a smaller set
+        if rng.random() < 0.5 and cands:
+            base = cands[rng.integers(0, len(cands))]
+            f0 = base.freq * rng.integers(1, 5) * (1 + rng.normal(0, 3e-5))
+        cands.append(
+            Candidate(
+                dm=float(rng.uniform(0, 100)),
+                dm_idx=int(rng.integers(0, 50)),
+                acc=float(rng.choice([-5.0, 0.0, 5.0])),
+                nh=int(rng.integers(0, 5)),
+                snr=float(rng.uniform(9, 100)),
+                freq=float(f0),
+            )
+        )
+    return cands
+
+
+def clone(cands):
+    return [
+        Candidate(dm=c.dm, dm_idx=c.dm_idx, acc=c.acc, nh=c.nh, snr=c.snr,
+                  freq=c.freq)
+        for c in cands
+    ]
+
+
+def summarize(cands):
+    return [(round(c.freq, 9), round(c.snr, 5), c.count_assoc()) for c in cands]
+
+
+@pytest.mark.parametrize(
+    "maker",
+    [
+        lambda: HarmonicDistiller(1e-4, 16, keep_related=True),
+        lambda: HarmonicDistiller(1e-4, 16, keep_related=True,
+                                  fractional_harms=False),
+        lambda: AccelerationDistiller(40.0, 1e-4, keep_related=True),
+        lambda: DMDistiller(1e-4, keep_related=True),
+        lambda: DMDistiller(1e-4, keep_related=False),
+    ],
+)
+def test_distill_parity(maker, rng):
+    cands = random_cands(rng)
+    d_native = maker()
+    out_native = d_native.distill(clone(cands))
+
+    d_python = maker()
+    d_python._native = lambda cands: None  # force the Python loop
+    out_python = d_python.distill(clone(cands))
+
+    assert summarize(out_native) == summarize(out_python)
+
+
+def test_distill_empty_and_single():
+    d = DMDistiller(1e-4, keep_related=True)
+    assert d.distill([]) == []
+    one = [Candidate(freq=10.0, snr=20.0)]
+    assert len(d.distill(one)) == 1
