@@ -83,6 +83,10 @@ def write_birdie_list(
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
 
+    from .peasoup import apply_platform_env
+
+    apply_platform_env()
+
     import jax.numpy as jnp
 
     from ..io.sigproc import read_filterbank

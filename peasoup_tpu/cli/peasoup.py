@@ -55,12 +55,31 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--freq_tol", type=float, default=0.0001)
     p.add_argument("-v", "--verbose", action="store_true")
     p.add_argument("-p", "--progress_bar", action="store_true")
+    p.add_argument(
+        "--checkpoint", default="",
+        help="Checkpoint file for resumable searches (TPU extension; "
+        "the reference has no checkpointing)",
+    )
     return p
+
+
+def apply_platform_env() -> None:
+    """Honor JAX_PLATFORMS even when the ambient interpreter setup
+    (e.g. a sitecustomize registering a TPU plugin) overrode the
+    platform via jax.config after env parsing."""
+    import os
+
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        import jax
+
+        jax.config.update("jax_platforms", platforms)
 
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     outdir = args.outdir or default_outdir()
+    apply_platform_env()
 
     # Heavy imports after arg parsing so --help stays fast
     from ..io.output import CandidateFileWriter, OutputFileWriter
@@ -93,6 +112,7 @@ def main(argv: list[str] | None = None) -> int:
         freq_tol=args.freq_tol,
         verbose=args.verbose,
         progress_bar=args.progress_bar,
+        checkpoint_file=args.checkpoint,
     )
     t0 = time.time()
     if args.progress_bar:

@@ -96,11 +96,6 @@ def cluster_peaks(
     return out_idx[:n].copy(), out_snr[:n].copy()
 
 
-def _edge_buffers(n_hint: int) -> tuple[np.ndarray, np.ndarray]:
-    cap = max(n_hint, 1024)
-    return np.empty(cap, np.int32), np.empty(cap, np.int32)
-
-
 def _run_distill(call, n: int):
     """Run a distill entry point, growing the edge buffer on overflow."""
     cap = max(4 * n, 1024)

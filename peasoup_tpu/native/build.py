@@ -16,27 +16,38 @@ LIB = os.path.join(_DIR, "libpeasoup_host.so")
 
 
 def build(force: bool = False) -> str | None:
-    """Compile the shared library; returns its path or None on failure."""
+    """Compile the shared library; returns its path or None on failure.
+
+    Compiles to a temp path and os.replace()s into place so concurrent
+    first-use builds (e.g. many sharded-search workers on a cold
+    checkout) never dlopen a half-written file.
+    """
     if not force and os.path.exists(LIB) and os.path.getmtime(
         LIB
     ) >= os.path.getmtime(SRC):
         return LIB
+    import tempfile
+
+    fd, tmp = tempfile.mkstemp(dir=_DIR, suffix=".so.tmp")
+    os.close(fd)
     cmd = [
         os.environ.get("CXX", "g++"),
         "-O3",
-        "-march=native",
         "-shared",
         "-fPIC",
         "-std=c++17",
         SRC,
         "-o",
-        LIB,
+        tmp,
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, LIB)
     except (subprocess.CalledProcessError, FileNotFoundError) as exc:
         import warnings
 
+        if os.path.exists(tmp):
+            os.unlink(tmp)
         detail = getattr(exc, "stderr", "") or str(exc)
         warnings.warn(f"native build failed, using Python fallback: {detail}")
         return None

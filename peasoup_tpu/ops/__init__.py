@@ -8,3 +8,4 @@ from .peaks import find_peaks_device, cluster_peaks
 from .fold import fold_time_series, fold_time_series_np
 from .fold_optimise import FoldOptimiser
 from .coincidence import coincidence_mask
+from .correlate import baseline_pairs, find_delays

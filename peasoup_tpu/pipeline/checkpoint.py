@@ -1,0 +1,86 @@
+"""Checkpoint/resume of per-DM-trial search results.
+
+The reference has NO checkpointing — a crash mid-sweep loses everything
+(SURVEY.md §5: errors are thrown and crash the process,
+include/utils/exceptions.hpp). This module is the TPU framework's
+addition: after each device block the driver persists the static-size
+peak sets already searched, keyed by DM-trial index, so a long sweep
+resumes where it stopped. The checkpoint is invalidated by a config
+key derived from every search-affecting parameter.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+import numpy as np
+
+
+class SearchCheckpoint:
+    """Atomic .npz store of {dm_idx: (idxs, snrs, counts)}."""
+
+    def __init__(self, path: str, config_key: str) -> None:
+        self.path = path
+        self.config_key = config_key
+
+    @staticmethod
+    def make_key(cfg, fil, size: int, ndm: int) -> str:
+        """Config key over everything that changes per-trial results,
+        including the observation's identity (header), so a checkpoint
+        from one beam/file never resumes a search of another."""
+        h = fil.header
+        fields = (
+            fil.nsamps, fil.nchans, size, ndm,
+            fil.tsamp, fil.fch1, fil.foff,
+            getattr(h, "tstart", None), getattr(h, "source_name", None),
+            getattr(h, "nbits", None),
+            cfg.dm_start, cfg.dm_end, cfg.dm_tol, cfg.dm_pulse_width,
+            cfg.acc_start, cfg.acc_end, cfg.acc_tol, cfg.acc_pulse_width,
+            cfg.boundary_5_freq, cfg.boundary_25_freq, cfg.nharmonics,
+            cfg.min_snr, cfg.min_freq, cfg.max_freq,
+            cfg.killfilename, cfg.zapfilename,
+        )
+        return repr(fields)
+
+    def load(self) -> dict[int, tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        """Restore completed trials; {} if absent or config changed."""
+        if not self.path or not os.path.exists(self.path):
+            return {}
+        try:
+            with np.load(self.path, allow_pickle=False) as z:
+                if str(z["config_key"]) != self.config_key:
+                    return {}
+                dm_idxs = z["dm_idxs"]
+                return {
+                    int(d): (z[f"idxs_{d}"], z[f"snrs_{d}"], z[f"counts_{d}"])
+                    for d in dm_idxs
+                }
+        except (OSError, KeyError, ValueError):
+            return {}  # corrupt/partial file: start over, never crash
+
+    def save(
+        self, results: dict[int, tuple[np.ndarray, np.ndarray, np.ndarray]]
+    ) -> None:
+        """Write-all + atomic rename (safe against mid-write crashes)."""
+        if not self.path:
+            return
+        arrays: dict[str, np.ndarray] = {
+            "config_key": np.asarray(self.config_key),
+            "dm_idxs": np.asarray(sorted(results), dtype=np.int64),
+        }
+        for d, (idxs, snrs, counts) in results.items():
+            arrays[f"idxs_{d}"] = idxs
+            arrays[f"snrs_{d}"] = snrs
+            arrays[f"counts_{d}"] = counts
+        dirname = os.path.dirname(os.path.abspath(self.path)) or "."
+        os.makedirs(dirname, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=dirname, suffix=".ckpt.tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez(f, **arrays)
+            os.replace(tmp, self.path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise

@@ -28,7 +28,9 @@ from ..ops.zap import birdie_mask
 from ..plan.accel_plan import AccelerationPlan
 from ..plan.dm_plan import DMPlan
 from ..plan.fft_plan import choose_fft_size
+from ..utils import ProgressBar, trace_span
 from .accel_search import make_batched_search_fn
+from .checkpoint import SearchCheckpoint
 from .distill import AccelerationDistiller, DMDistiller, HarmonicDistiller
 from .folder import MultiFolder
 from .score import CandidateScorer
@@ -69,6 +71,7 @@ class SearchConfig:
     dedisp_block: int = 16  # DM trials per dedispersion launch
     accel_bucket: int = 16  # accel batch padded to a multiple of this
     dm_block: int = 8  # DM trials searched per device call
+    checkpoint_file: str = ""  # resumable per-DM-trial result store
 
 
 @dataclass
@@ -135,14 +138,15 @@ class PeasoupSearch:
             killmask=killmask,
         )
         t0 = time.time()
-        trials = dedisperse(
-            fil.data,
-            dm_plan.delay_samples(),
-            dm_plan.killmask,
-            dm_plan.out_nsamps,
-            scale=output_scale(fil.nbits, int(dm_plan.killmask.sum())),
-            block=cfg.dedisp_block,
-        )
+        with trace_span("Dedisperse"):  # NVTX parity: pipeline_multi.cu:318
+            trials = dedisperse(
+                fil.data,
+                dm_plan.delay_samples(),
+                dm_plan.killmask,
+                dm_plan.out_nsamps,
+                scale=output_scale(fil.nbits, int(dm_plan.killmask.sum())),
+                block=cfg.dedisp_block,
+            )
         timers["dedispersion"] = time.time() - t0
 
         # --- search setup ---------------------------------------------------
@@ -200,54 +204,54 @@ class PeasoupSearch:
 
         search_block = make_batched_search_fn(cfg.min_snr)
         tim_len = min(size, trials.shape[1])
+
+        ckpt = None
         per_dm_results: dict[int, tuple] = {}
-        for padded, dm_indices in sorted(by_bucket.items()):
-            for start in range(0, len(dm_indices), cfg.dm_block):
-                chunk = dm_indices[start : start + cfg.dm_block]
-                real = len(chunk)
-                # pad the block by repeating the first trial (discarded)
-                block_idx = chunk + [chunk[0]] * (cfg.dm_block - real)
-                afs = np.zeros((cfg.dm_block, padded), dtype=np.float32)
-                for row, dm_idx in enumerate(block_idx):
-                    accs = accel_lists[dm_idx]
-                    afs[row, : len(accs)] = accel_factor(
-                        accs, fil.tsamp
-                    ).astype(np.float32)
-                tims_dev = jnp.asarray(trials[block_idx, :tim_len])
-                afs_dev = jnp.asarray(afs)
-                max_peaks = cfg.max_peaks
-                while True:
-                    peaks = search_block(
-                        tims_dev,
-                        afs_dev,
-                        zapmask_dev,
-                        windows,
-                        size=size,
-                        nsamps_valid=nsamps_valid,
-                        nharms=cfg.nharmonics,
-                        max_peaks=max_peaks,
-                        pos5=pos5,
-                        pos25=pos25,
-                    )
-                    counts = np.asarray(peaks.counts)
-                    if counts.max() <= max_peaks:
-                        break
-                    # overflow: escalate the static compaction size so no
-                    # threshold crossing is lost (the reference sizes for
-                    # 100000, peakfinder.hpp:61); costs one extra compile
-                    # only on pathological blocks
-                    max_peaks = 1 << int(np.ceil(np.log2(counts.max())))
-                idxs = np.asarray(peaks.idxs)  # (B, L, A, maxp)
-                snrs = np.asarray(peaks.snrs)
-                for row in range(real):
-                    # trim to this trial's own maximum count: bounds host
-                    # memory and detaches the padded block buffers
-                    mx = max(int(counts[row].max()), 1)
-                    per_dm_results[chunk[row]] = (
-                        idxs[row][:, :, :mx].copy(),
-                        snrs[row][:, :, :mx].copy(),
-                        counts[row].copy(),
-                    )
+        if cfg.checkpoint_file:
+            ckpt = SearchCheckpoint(
+                cfg.checkpoint_file,
+                SearchCheckpoint.make_key(cfg, fil, size, dm_plan.ndm),
+            )
+            per_dm_results = ckpt.load()
+            if cfg.verbose and per_dm_results:
+                print(
+                    f"Resuming: {len(per_dm_results)}/{dm_plan.ndm} DM "
+                    f"trials restored from {cfg.checkpoint_file}"
+                )
+
+        chunks = [
+            dm_indices[start : start + cfg.dm_block]
+            for padded, dm_indices in sorted(by_bucket.items())
+            for start in range(0, len(dm_indices), cfg.dm_block)
+        ]
+        progress = ProgressBar() if cfg.progress_bar else None
+        if progress:
+            progress.start()
+        last_ckpt = time.time()
+        dirty = False
+        for n_chunk, chunk in enumerate(chunks):
+            if all(d in per_dm_results for d in chunk):
+                continue  # restored from checkpoint
+            with trace_span("DM-Loop"):  # NVTX parity: pipeline_multi.cu:144
+                self._search_chunk(
+                    chunk, accel_lists, trials, tim_len, zapmask_dev,
+                    windows, search_block, per_dm_results,
+                    size=size, nsamps_valid=nsamps_valid,
+                    pos5=pos5, pos25=pos25, tsamp=fil.tsamp,
+                )
+            dirty = True
+            # rate-limit full-rewrite saves: a crash loses at most ~10 s
+            # of device work instead of paying O(n^2) rewrite I/O
+            if ckpt is not None and time.time() - last_ckpt > 10.0:
+                ckpt.save(per_dm_results)
+                last_ckpt = time.time()
+                dirty = False
+            if progress:
+                progress.update((n_chunk + 1) / len(chunks))
+        if ckpt is not None and dirty:
+            ckpt.save(per_dm_results)
+        if progress:
+            progress.stop()
         timers["search_device"] = time.time() - t0
 
         # --- host candidate bookkeeping (ascending DM order) ----------------
@@ -320,3 +324,61 @@ class PeasoupSearch:
             size=size,
             n_accel_trials=sum(len(a) for a in accel_lists),
         )
+
+    def _search_chunk(
+        self, chunk, accel_lists, trials, tim_len, zapmask_dev, windows,
+        search_block, per_dm_results, *, size, nsamps_valid, pos5, pos25,
+        tsamp,
+    ) -> None:
+        """Run one (dm_block, accel_bucket) device tile and bank the
+        static-size peak sets for every real trial in the chunk."""
+        cfg = self.config
+        real = len(chunk)
+        bucket = cfg.accel_bucket
+        padded = max(
+            int(math.ceil(len(accel_lists[d]) / bucket) * bucket)
+            for d in chunk
+        )
+        # pad the block by repeating the first trial (discarded)
+        block_idx = chunk + [chunk[0]] * (cfg.dm_block - real)
+        afs = np.zeros((cfg.dm_block, padded), dtype=np.float32)
+        for row, dm_idx in enumerate(block_idx):
+            accs = accel_lists[dm_idx]
+            afs[row, : len(accs)] = accel_factor(accs, tsamp).astype(
+                np.float32
+            )
+        tims_dev = jnp.asarray(trials[block_idx, :tim_len])
+        afs_dev = jnp.asarray(afs)
+        max_peaks = cfg.max_peaks
+        while True:
+            peaks = search_block(
+                tims_dev,
+                afs_dev,
+                zapmask_dev,
+                windows,
+                size=size,
+                nsamps_valid=nsamps_valid,
+                nharms=cfg.nharmonics,
+                max_peaks=max_peaks,
+                pos5=pos5,
+                pos25=pos25,
+            )
+            counts = np.asarray(peaks.counts)
+            if counts.max() <= max_peaks:
+                break
+            # overflow: escalate the static compaction size so no
+            # threshold crossing is lost (the reference sizes for
+            # 100000, peakfinder.hpp:61); costs one extra compile
+            # only on pathological blocks
+            max_peaks = 1 << int(np.ceil(np.log2(counts.max())))
+        idxs = np.asarray(peaks.idxs)  # (B, L, A, maxp)
+        snrs = np.asarray(peaks.snrs)
+        for row in range(real):
+            # trim to this trial's own maximum count: bounds host
+            # memory and detaches the padded block buffers
+            mx = max(int(counts[row].max()), 1)
+            per_dm_results[chunk[row]] = (
+                idxs[row][:, :, :mx].copy(),
+                snrs[row][:, :, :mx].copy(),
+                counts[row].copy(),
+            )

@@ -1,0 +1,2 @@
+from .trace import Stopwatch, trace_span
+from .progress import ProgressBar

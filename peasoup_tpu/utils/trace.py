@@ -1,0 +1,64 @@
+"""Tracing and phase timing.
+
+Reference: NVTX ranges via PUSH_NVTX_RANGE/POP_NVTX_RANGE macros
+(include/utils/nvtx.hpp:8-24) around the "Dedisperse", "DM-Loop",
+"Acceleration-Loop" and "Harmonic summing" spans, plus a gettimeofday
+``Stopwatch`` accumulator (include/utils/stopwatch.hpp:9-144) feeding
+the overview.xml <execution_times> table.
+
+TPU equivalent: ``trace_span`` emits a ``jax.profiler.TraceAnnotation``
+(visible in TensorBoard/perfetto traces captured with
+``jax.profiler.trace``) and the same span names are used by the search
+driver; ``Stopwatch`` keeps the reference's accumulate-across-starts
+semantics for the XML timing table.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+import jax
+
+
+class Stopwatch:
+    """Accumulating wall-clock timer (stopwatch.hpp:9-144 semantics:
+    stop() adds to the running total; reset() clears)."""
+
+    def __init__(self) -> None:
+        self._total = 0.0
+        self._t0: float | None = None
+
+    def start(self) -> None:
+        self._t0 = time.time()
+
+    def stop(self) -> None:
+        if self._t0 is None:
+            raise RuntimeError("Stopwatch stopped before being started")
+        self._total += time.time() - self._t0
+        self._t0 = None
+
+    def reset(self) -> None:
+        self._total = 0.0
+        self._t0 = None
+
+    def getTime(self) -> float:  # noqa: N802 - reference method name
+        return self._total
+
+    @property
+    def elapsed(self) -> float:
+        return self._total
+
+
+@contextmanager
+def trace_span(name: str, stopwatch: Stopwatch | None = None):
+    """Profiler span named like the reference's NVTX ranges, optionally
+    accumulating into a Stopwatch for the XML timing table."""
+    if stopwatch is not None:
+        stopwatch.start()
+    with jax.profiler.TraceAnnotation(name):
+        try:
+            yield
+        finally:
+            if stopwatch is not None:
+                stopwatch.stop()

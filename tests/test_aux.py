@@ -1,0 +1,177 @@
+"""Tests for the auxiliary subsystems: cross-beam correlator,
+checkpoint/resume, stopwatch/trace spans, progress bar."""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from peasoup_tpu.ops.correlate import baseline_pairs, find_delays
+from peasoup_tpu.pipeline.checkpoint import SearchCheckpoint
+from peasoup_tpu.utils import ProgressBar, Stopwatch, trace_span
+
+
+# --------------------------------------------------------------------------
+# correlator (reference: DelayFinder, include/transforms/correlator.hpp)
+# --------------------------------------------------------------------------
+
+def test_baseline_pairs_order():
+    pairs = baseline_pairs(4)
+    # reference loop order: ii outer, jj=ii+1.. inner (correlator.hpp:62-69)
+    assert pairs.tolist() == [
+        [0, 1], [0, 2], [0, 3], [1, 2], [1, 3], [2, 3]
+    ]
+
+
+def test_find_delays_recovers_known_lags():
+    rng = np.random.default_rng(42)
+    n = 1024
+    base = rng.normal(size=n).astype(np.float32)
+    lags = {1: 7, 2: -11}  # beam index -> circular shift vs beam 0
+    beams = np.stack(
+        [base] + [np.roll(base, lags[i]) for i in (1, 2)]
+    )
+    res = find_delays(beams, max_delay=32)
+    got = {tuple(p): int(l) for p, l in zip(res.pairs.tolist(), res.lag)}
+    # cc(x, y) peaks at lag where y = roll(x, lag)
+    assert got[(0, 1)] == 7
+    assert got[(0, 2)] == -11
+    assert got[(1, 2)] == -18  # relative shift between beams 1 and 2
+
+
+def test_find_delays_distance_window_convention():
+    """distance indexes [pos lags 0..D-1, neg lags -D..-1] like the
+    reference's two D2H copies (correlator.hpp:77-78)."""
+    n = 256
+    x = np.zeros(n, dtype=np.float32)
+    x[10] = 1.0
+    y = np.roll(x, -3)  # negative lag
+    res = find_delays(np.stack([x, y]), max_delay=8)
+    assert int(res.distance[0]) == 2 * 8 - 3
+    assert int(res.lag[0]) == -3
+
+
+def test_find_delays_complex_input_and_validation():
+    rng = np.random.default_rng(0)
+    z = (rng.normal(size=(2, 128)) + 1j * rng.normal(size=(2, 128))).astype(
+        np.complex64
+    )
+    res = find_delays(z, max_delay=16)
+    assert res.power.shape == (1,)
+    with pytest.raises(ValueError):
+        find_delays(z, max_delay=100)  # > nsamps/2
+    with pytest.raises(ValueError):
+        find_delays(z[0], max_delay=4)  # not 2-D
+
+
+# --------------------------------------------------------------------------
+# checkpoint/resume
+# --------------------------------------------------------------------------
+
+def _fake_results(dm_idxs, seed=0):
+    rng = np.random.default_rng(seed)
+    out = {}
+    for d in dm_idxs:
+        out[d] = (
+            rng.integers(0, 1000, size=(5, 3, 4)).astype(np.int32),
+            rng.normal(size=(5, 3, 4)).astype(np.float32),
+            rng.integers(0, 4, size=(5, 3)).astype(np.int32),
+        )
+    return out
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    path = str(tmp_path / "ck.npz")
+    ck = SearchCheckpoint(path, "key1")
+    results = _fake_results([0, 3, 7])
+    ck.save(results)
+    restored = SearchCheckpoint(path, "key1").load()
+    assert sorted(restored) == [0, 3, 7]
+    for d in results:
+        for a, b in zip(results[d], restored[d]):
+            np.testing.assert_array_equal(a, b)
+
+
+def test_checkpoint_config_mismatch_discards(tmp_path):
+    path = str(tmp_path / "ck.npz")
+    SearchCheckpoint(path, "key1").save(_fake_results([1]))
+    assert SearchCheckpoint(path, "DIFFERENT").load() == {}
+
+
+def test_checkpoint_missing_and_corrupt(tmp_path):
+    assert SearchCheckpoint(str(tmp_path / "nope.npz"), "k").load() == {}
+    bad = tmp_path / "bad.npz"
+    bad.write_bytes(b"not an npz at all")
+    assert SearchCheckpoint(str(bad), "k").load() == {}
+
+
+def test_checkpoint_atomic_no_tmp_left(tmp_path):
+    path = str(tmp_path / "ck.npz")
+    ck = SearchCheckpoint(path, "key")
+    ck.save(_fake_results([0]))
+    ck.save(_fake_results([0, 1]))  # overwrite
+    leftovers = [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+    assert leftovers == []
+    assert sorted(ck.load()) == [0, 1]
+
+
+def test_search_resume_end_to_end(tutorial_fil, tmp_path):
+    """A checkpointed re-run must reproduce the uncheckpointed result."""
+    from peasoup_tpu.io import read_filterbank
+    from peasoup_tpu.pipeline.search import PeasoupSearch, SearchConfig
+
+    fil = read_filterbank(tutorial_fil)
+    common = dict(dm_end=30.0, acc_start=0.0, acc_end=0.0, npdmp=0)
+    ref = PeasoupSearch(SearchConfig(**common)).run(fil)
+
+    path = str(tmp_path / "search.ckpt.npz")
+    first = PeasoupSearch(
+        SearchConfig(checkpoint_file=path, **common)
+    ).run(fil)
+    assert os.path.exists(path)
+    resumed = PeasoupSearch(
+        SearchConfig(checkpoint_file=path, **common)
+    ).run(fil)
+
+    for a, b in ((first, ref), (resumed, ref)):
+        assert len(a.candidates) == len(b.candidates)
+        for ca, cb in zip(a.candidates, b.candidates):
+            assert ca.freq == cb.freq and ca.snr == cb.snr
+            assert ca.dm == cb.dm and ca.acc == cb.acc
+
+
+# --------------------------------------------------------------------------
+# stopwatch / trace / progress
+# --------------------------------------------------------------------------
+
+def test_stopwatch_accumulates():
+    sw = Stopwatch()
+    sw.start(); sw.stop()
+    first = sw.elapsed
+    sw.start(); sw.stop()
+    assert sw.getTime() >= first  # accumulates across start/stop pairs
+    sw.reset()
+    assert sw.elapsed == 0.0
+    with pytest.raises(RuntimeError):
+        sw.stop()
+
+
+def test_trace_span_times_and_nests():
+    sw = Stopwatch()
+    with trace_span("DM-Loop", sw):
+        with trace_span("Acceleration-Loop"):
+            pass
+    assert sw.elapsed >= 0.0
+
+
+def test_progress_bar_output():
+    buf = io.StringIO()
+    pb = ProgressBar(stream=buf, min_interval=0.0)
+    pb.start()
+    pb.update(0.5)
+    pb.stop()
+    out = buf.getvalue()
+    assert "50.0%" in out and "100.0%" in out and "ETA" in out
+    pb.update(0.9)  # after stop: no-op
+    assert "90" not in buf.getvalue()
