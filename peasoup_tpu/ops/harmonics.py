@@ -5,29 +5,73 @@ fold level h in 1..5, sum_{k=1..2^h} p[(int)(i*k/2^h + 0.5)] scaled by
 rsqrt(2^h), accumulating across levels (level h reuses level h-1's sum
 and adds only the odd-k/2^h gathers).
 
-TPU design: the reference's float index expression (int)(i*k/2^h + 0.5)
+TPU design. The reference's float index expression (int)(i*k/2^h + 0.5)
 is EXACT integer math: (i*k + 2^(h-1)) >> h (the double value is exactly
-representable, truncation == floor). We therefore compute gather indices
-with integer ops on-device — bit-identical to the CUDA index map, with
-no f64. Gathers are batched over the accel-trial axis; XLA fuses the
-adds between gathers.
+representable, truncation == floor). Two implementations:
+
+* ``method="take"``: direct batched jnp.take gathers — the oracle.
+* ``method="mxu"`` (default): the gather index map is PERIODIC in the
+  output index: writing i = q*2^h + r, src(i) = q*k + c_r with
+  c_r = (r*k + 2^(h-1)) >> h a compile-time constant <= k. So the
+  whole level-h harmonic-k gather is
+
+      out.reshape(Q, 2^h) = X @ C,   X[q, c] = p[q*k + c] (c <= k),
+      C[c, r] = [c == c_r]  (one column-wise one-hot per r)
+
+  where X is two dense strided reshapes/slices of p (contiguous
+  vector loads) and C is a tiny constant (k+1, 2^h) matrix: the
+  irregular gather becomes an MXU matmul. Because each C column is
+  one-hot, the matmul result is the exact gather value (zeros add
+  exactly), so "mxu" and "take" agree bitwise in f32 (tests assert
+  equality; Precision.HIGHEST keeps f32 exactness on the MXU).
 """
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
-@partial(jax.jit, static_argnames=("nharms",))
-def harmonic_sums(p: jnp.ndarray, *, nharms: int = 4) -> list[jnp.ndarray]:
+@lru_cache(maxsize=None)
+def _onehot_matrix(k: int, h: int) -> np.ndarray:
+    """(k+1, 2^h) f32 with C[c, r] = 1 iff (r*k + 2^(h-1)) >> h == c."""
+    r = np.arange(1 << h)
+    c_r = (r * k + (1 << (h - 1))) >> h
+    C = np.zeros((k + 1, 1 << h), dtype=np.float32)
+    C[c_r, r] = 1.0
+    return C
+
+
+def _gather_mxu(p: jnp.ndarray, nbins_pad: int, k: int, h: int) -> jnp.ndarray:
+    """out[..., i] = p[..., (i*k + 2^(h-1)) >> h] for i < nbins_pad via
+    strided reshapes + a constant one-hot matmul (p is pre-padded so all
+    slices below are in range)."""
+    q_count = nbins_pad >> h
+    body = p[..., : q_count * k].reshape(*p.shape[:-1], q_count, k)
+    # edge column c == k: p[(q+1)*k], hit when k <= 2^(h-1)
+    edge = p[..., k : k * (q_count + 1) : k][..., None]
+    x = jnp.concatenate([body, edge], axis=-1)  # (..., Q, k+1)
+    C = jnp.asarray(_onehot_matrix(k, h))
+    out = jnp.einsum(
+        "...qc,cr->...qr", x, C, precision=jax.lax.Precision.HIGHEST
+    )
+    return out.reshape(*p.shape[:-1], nbins_pad)
+
+
+@partial(jax.jit, static_argnames=("nharms", "method"))
+def harmonic_sums(
+    p: jnp.ndarray, *, nharms: int = 4, method: str = "mxu"
+) -> list[jnp.ndarray]:
     """Cumulative fractional-harmonic sums of a spectrum.
 
     Args:
       p: (..., nbins) float32 spectrum (normalised).
       nharms: number of fold levels (<= 5, like the unrolled kernel).
+      method: "mxu" (strided-reshape + one-hot matmul) or "take"
+        (direct gather); bitwise-identical results.
 
     Returns a list of ``nharms`` arrays shaped like ``p``; entry h-1 is
     the 2^h-harmonic sum scaled by rsqrt(2^h).
@@ -35,14 +79,29 @@ def harmonic_sums(p: jnp.ndarray, *, nharms: int = 4) -> list[jnp.ndarray]:
     if not 0 < nharms <= 5:
         raise ValueError("nharms must be in 1..5")
     nbins = p.shape[-1]
-    i = jnp.arange(nbins, dtype=jnp.int32)
+    if method == "take":
+        i = jnp.arange(nbins, dtype=jnp.int32)
+        out = []
+        val = p
+        for h in range(1, nharms + 1):
+            half = 1 << (h - 1)
+            for k in range(1, 1 << h, 2):  # odd: new gathers this level
+                src = (i * k + half) >> h
+                val = val + jnp.take(p, src, axis=-1)
+            out.append(val * jnp.float32(2.0 ** (-h / 2.0)))
+        return out
+    if method != "mxu":
+        raise ValueError(f"unknown method {method!r}")
+
+    align = 1 << nharms
+    nbins_pad = (nbins + align - 1) // align * align
+    # strided slices below reach at most nbins_pad + align source bins;
+    # src indices for i < nbins stay < nbins, so the zero pad is inert
+    pp = jnp.pad(p, [(0, 0)] * (p.ndim - 1) + [(0, nbins_pad + align - nbins)])
     out = []
     val = p
     for h in range(1, nharms + 1):
-        denom_log2 = h
-        half = 1 << (h - 1)
-        for k in range(1, 1 << h, 2):  # odd numerators only: new this level
-            src = (i * k + half) >> denom_log2
-            val = val + jnp.take(p, src, axis=-1)
+        for k in range(1, 1 << h, 2):
+            val = val + _gather_mxu(pp, nbins_pad, k, h)[..., :nbins]
         out.append(val * jnp.float32(2.0 ** (-h / 2.0)))
     return out

@@ -1,0 +1,22 @@
+"""Pallas TPU kernels for the hot ops.
+
+Each kernel has a pure-jnp twin in ``peasoup_tpu.ops`` used as the
+oracle in tests (interpret mode on CPU) and as the fallback on
+non-TPU backends or when a kernel's preconditions don't hold.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def backend_supports_pallas() -> bool:
+    """Compiled Mosaic kernels need a real TPU backend; everywhere else
+    the kernels still run via the interpreter (tests) or fall back."""
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:
+        return False
+
+
+from .resample import resample_block_pallas, resample_block  # noqa: E402

@@ -1,0 +1,161 @@
+"""Pallas TPU kernel for time-domain acceleration resampling.
+
+Reference: resample_kernelII, out[i] = in[rint(i + i*af*(i-N))]
+(src/kernels.cu:314-346) — a per-element gather in CUDA. BASELINE.md
+names this op as a Pallas target.
+
+TPU design — NO gather at all. The shift s(i) = rint(af*i*(i-N)) is a
+slowly varying step function: its slope |d s/d i| = |af*(2i-N)| <=
+|af|*N is tiny for physical accelerations (~1e-7..1e-4 samples/sample).
+Pick a block size BLK with |af|*N*BLK <= 2; then within one output
+block the shift takes at most 4 distinct values, so the block is a
+SELECT among 4 shifted copies of one contiguous window:
+
+  HBM --async DMA--> VMEM window [ws, ws+W), W = BLK + 2*MARGIN
+  out[j] = select(s(i0+j) - s_base, window[j+v], ..., window[j+v+3])
+
+which is pure vector ops + one dynamic-offset DMA per block — the
+gather is traded for HBM streaming at full bandwidth.
+
+Boundary handling: the input is padded with a MARGIN-sample leading
+apron (+ tail slack) so the window start ws = i0 + s(i0) is ALWAYS in
+range — no clamping, so the select never misaligns at the array ends
+(an earlier clamped-window design silently corrupted the first/last
+blocks once |af|*N*BLK approached 1). Reads clipped to sample 0 by the
+reference's index clip land exactly on x[0] through the apron. The
+index arithmetic uses the same f32 ops as the jnp twin
+(ops/resample.py), so results are bitwise identical.
+
+Window-start validity under the precondition |af|*N*BLK <= 2
+(enforced by choose_block): |s(i0)| <= |af|*i0*(N-i0) < i0 for i0 > 0
+(since |af|*N < 1), so ws = i0 + s(i0) >= 0, and ws <= N - BLK + 2 so
+ws + W <= N_pad. In-block local offsets vs = src + MARGIN - ws - j lie
+in [MARGIN - 2 - spread, MARGIN + 2 + spread] with spread <= 3.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_MARGIN = 64  # leading apron; also window slack each side of a block
+_SELECT_SPAN = 4  # distinct shift values handled per block
+_PAD_TAIL = 3 * _MARGIN  # trailing slack: ws + W <= n + 2 + 2*MARGIN
+
+
+def choose_block(af_max: float, n: int) -> int:
+    """Largest power-of-two block with shift spread <= SELECT_SPAN-1,
+    clamped to [128, 2048]. Returns 0 if no valid block exists (caller
+    must use the jnp fallback). This is the single source of truth for
+    the kernel's preconditions."""
+    if af_max < 0:
+        raise ValueError("af_max must be >= 0")
+    limit = 2.0 / (af_max * n) if af_max > 0 else float("inf")
+    blk = 128
+    if blk > limit or n % blk or n < blk + 2 * _MARGIN:
+        return 0
+    while (
+        blk * 2 <= min(limit, 2048)
+        and n % (blk * 2) == 0
+        and n >= blk * 2 + 2 * _MARGIN
+    ):
+        blk *= 2
+    return blk
+
+
+def _kernel(af_ref, x_ref, out_ref, win_ref, sem, *, n: int, blk: int):
+    d = pl.program_id(0)
+    t = pl.program_id(2)
+    w = blk + 2 * _MARGIN
+    af = af_ref[0, 0]
+    nf = jnp.float32(n)
+    i0 = t * blk
+    i0f = jnp.float32(i0)
+    s0 = jnp.rint(af * (i0f * (i0f - nf))).astype(jnp.int32)
+    ws = i0 + s0  # window origin in the PADDED array; in range by above
+
+    copy = pltpu.make_async_copy(
+        x_ref.at[d, pl.ds(ws, w)], win_ref.at[0], sem
+    )
+    copy.start()
+
+    j = jax.lax.broadcasted_iota(jnp.int32, (1, blk), 1)
+    ivec = (i0 + j).astype(jnp.float32)  # exact: i < 2^24
+    quad = ivec * (ivec - nf)  # same single f32 rounding as jnp twin
+    shift = jnp.rint(af * quad).astype(jnp.int32)
+    src = jnp.clip(i0 + j + shift, 0, n - 1)  # reference's index clip
+    vs = src + _MARGIN - ws - j  # local window offset minus j, >= 0
+    vmin = jnp.min(vs)
+
+    copy.wait()
+    acc = jnp.zeros((1, blk), jnp.float32)
+    for s in range(_SELECT_SPAN):
+        shifted = win_ref[0:1, pl.ds(vmin + s, blk)]
+        acc = jnp.where(vs == vmin + s, shifted, acc)
+    out_ref[0, 0, :] = acc[0]
+
+
+@lru_cache(maxsize=None)
+def _build(d: int, a: int, n: int, blk: int, interpret: bool):
+    w = blk + 2 * _MARGIN
+    kernel = partial(_kernel, n=n, blk=blk)
+    return pl.pallas_call(
+        kernel,
+        grid=(d, a, n // blk),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1), lambda dd, aa, tt: (dd, aa),
+                memory_space=pltpu.SMEM,
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, blk), lambda dd, aa, tt: (dd, aa, tt),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((d, a, n), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((1, w), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+        ],
+        interpret=interpret,
+    )
+
+
+def resample_block_pallas(
+    x: jnp.ndarray,  # (D, N) f32 time series per DM trial
+    afs: jnp.ndarray,  # (D, A) f32 acceleration factors a*tsamp/2c
+    *,
+    block: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """(D, A, N) resampled series; ``block`` must come from
+    choose_block (guarantees max|afs|*N*block <= 2)."""
+    d, n = x.shape
+    a = afs.shape[1]
+    if n % block or n < block + 2 * _MARGIN:
+        raise ValueError(f"N={n} incompatible with block={block}")
+    # leading apron: clipped-to-0 reads resolve to x[0]; tail slack
+    # keeps every window DMA in bounds without clamping (see module doc)
+    xp = jnp.pad(x.astype(jnp.float32), ((0, 0), (_MARGIN, _PAD_TAIL)))
+    fn = _build(d, a, n, block, interpret)
+    return fn(afs.astype(jnp.float32), xp)
+
+
+def resample_block(
+    x: jnp.ndarray, afs: jnp.ndarray, af_max: float, *, interpret: bool = False
+) -> jnp.ndarray:
+    """Dispatch: Pallas kernel when choose_block accepts and we're on
+    TPU (or interpreting); else the jnp gather twin."""
+    from ..resample import resample_accel
+    from . import backend_supports_pallas
+
+    _, n = x.shape
+    blk = choose_block(af_max, n)
+    if blk and (interpret or backend_supports_pallas()):
+        return resample_block_pallas(x, afs, block=blk, interpret=interpret)
+    return jax.vmap(resample_accel)(x, afs)

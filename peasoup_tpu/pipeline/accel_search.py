@@ -44,22 +44,9 @@ class AccelSearchPeaks(NamedTuple):
     counts: jax.Array
 
 
-def search_trial_core(
-    tim: jax.Array,  # (>=size,) u8/f32 dedispersed time series
-    afs: jax.Array,  # (A,) f32 acceleration factors a*tsamp/2c (padded)
-    zapmask: jax.Array,  # (size//2+1,) bool birdie mask
-    windows: jax.Array,  # (nharms+1, 2) i32 [start_idx, limit) per level
-    *,
-    threshold: float,
-    size: int,
-    nsamps_valid: int,
-    nharms: int,
-    max_peaks: int,
-    pos5: int,
-    pos25: int,
-) -> AccelSearchPeaks:
-    """Pure search body for one DM trial; vmap/shard_map-compatible."""
-    # --- once per DM trial ------------------------------------------------
+def _preprocess_trial(tim, zapmask, *, size, nsamps_valid, pos5, pos25):
+    """Once-per-DM-trial stage: pad, whiten, zap, stats, back to time
+    domain (pipeline_multi.cu:160-204). Returns (xd, mean, std)."""
     x = tim[:size].astype(jnp.float32)
     if nsamps_valid < size:
         # mean-pad the tail like the reference (pipeline_multi.cu:160-163);
@@ -73,12 +60,18 @@ def search_trial_core(
     s0 = form_interpolated(fser)
     mean, _, std = spectrum_stats(s0)
     xd = jnp.fft.irfft(fser, n=size)
+    return xd, mean, std
 
-    # --- batched over acceleration trials ---------------------------------
-    xr = resample_accel(xd, afs)  # (A, size)
-    fr = jnp.fft.rfft(xr, axis=-1)  # (A, size//2+1)
+
+def _spectra_and_peaks(
+    xr, mean, std, windows, *, threshold, nharms, max_peaks, stack_axis
+):
+    """Post-resample stage: batched rfft, interbin, normalise, harmonic
+    sums, per-level peak compaction (pipeline_multi.cu:216-234).
+    ``xr`` is (..., A, size); mean/std broadcast against (..., A)."""
+    fr = jnp.fft.rfft(xr, axis=-1)
     s = form_interpolated(fr)
-    s = normalise(s, mean[None], std[None])
+    s = normalise(s, mean, std)
     sums = harmonic_sums(s, nharms=nharms)
     levels = [s] + sums
 
@@ -95,7 +88,36 @@ def search_trial_core(
         snrs.append(s_)
         counts.append(c_)
     return AccelSearchPeaks(
-        idxs=jnp.stack(idxs), snrs=jnp.stack(snrs), counts=jnp.stack(counts)
+        idxs=jnp.stack(idxs, axis=stack_axis),
+        snrs=jnp.stack(snrs, axis=stack_axis),
+        counts=jnp.stack(counts, axis=stack_axis),
+    )
+
+
+def search_trial_core(
+    tim: jax.Array,  # (>=size,) u8/f32 dedispersed time series
+    afs: jax.Array,  # (A,) f32 acceleration factors a*tsamp/2c (padded)
+    zapmask: jax.Array,  # (size//2+1,) bool birdie mask
+    windows: jax.Array,  # (nharms+1, 2) i32 [start_idx, limit) per level
+    *,
+    threshold: float,
+    size: int,
+    nsamps_valid: int,
+    nharms: int,
+    max_peaks: int,
+    pos5: int,
+    pos25: int,
+) -> AccelSearchPeaks:
+    """Pure search body for one DM trial; vmap/shard_map-compatible."""
+    xd, mean, std = _preprocess_trial(
+        tim, zapmask, size=size, nsamps_valid=nsamps_valid,
+        pos5=pos5, pos25=pos25,
+    )
+    xr = resample_accel(xd, afs)  # (A, size)
+    return _spectra_and_peaks(
+        xr, mean[None], std[None], windows,
+        threshold=threshold, nharms=nharms, max_peaks=max_peaks,
+        stack_axis=0,
     )
 
 
@@ -121,14 +143,61 @@ def make_search_fn(threshold: float):
     return search_dm_trial
 
 
+def search_block_core(
+    tims: jax.Array,  # (D, >=size) u8/f32 dedispersed time series block
+    afs: jax.Array,  # (D, A) f32 acceleration factors (padded)
+    zapmask: jax.Array,
+    windows: jax.Array,
+    *,
+    threshold: float,
+    size: int,
+    nsamps_valid: int,
+    nharms: int,
+    max_peaks: int,
+    pos5: int,
+    pos25: int,
+    pallas_block: int = 0,
+    pallas_interpret: bool = False,
+) -> AccelSearchPeaks:
+    """Block-batched search: all per-DM preprocessing vmapped, then the
+    (D, A) accel grid processed as single batched array programs. With
+    ``pallas_block`` > 0 the resampling gather runs as the Pallas
+    windowed-select kernel (ops/pallas/resample.py); otherwise the jnp
+    gather twin. Results are bitwise identical either way.
+    """
+    xd, mean, std = jax.vmap(
+        lambda tim: _preprocess_trial(
+            tim, zapmask, size=size, nsamps_valid=nsamps_valid,
+            pos5=pos5, pos25=pos25,
+        )
+    )(tims)  # (D, size), (D,), (D,)
+
+    if pallas_block > 0:
+        from ..ops.pallas.resample import resample_block_pallas
+
+        xr = resample_block_pallas(
+            xd, afs, block=pallas_block, interpret=pallas_interpret
+        )
+    else:
+        xr = jax.vmap(resample_accel)(xd, afs)  # (D, A, size)
+
+    # stack levels at axis 1 -> (D, nharms+1, A, ...) to match
+    # vmap(search_trial_core)'s layout
+    return _spectra_and_peaks(
+        xr, mean[:, None], std[:, None], windows,
+        threshold=threshold, nharms=nharms, max_peaks=max_peaks,
+        stack_axis=1,
+    )
+
+
 @lru_cache(maxsize=None)
-def make_batched_search_fn(threshold: float):
+def make_batched_search_fn(threshold: float, pallas_block: int = 0):
     """Jitted (D, ...) -> (D, ...) search over a block of DM trials.
 
     A fixed (dm_block, accel_bucket) tile shape is the unit of device
-    work (SURVEY.md §7): one compile covers the whole run, and the vmap
-    amortises dispatch — the reference instead launches ~10 kernels per
-    (DM, accel) pair (src/pipeline_multi.cu:209-239).
+    work (SURVEY.md §7): one compile covers the whole run, and the
+    batching amortises dispatch — the reference instead launches ~10
+    kernels per (DM, accel) pair (src/pipeline_multi.cu:209-239).
     """
 
     @partial(
@@ -138,12 +207,11 @@ def make_batched_search_fn(threshold: float):
     )
     def search_dm_block(tims, afs, zapmask, windows, *, size, nsamps_valid,
                         nharms, max_peaks, pos5, pos25) -> AccelSearchPeaks:
-        return jax.vmap(
-            lambda t, a: search_trial_core(
-                t, a, zapmask, windows,
-                threshold=threshold, size=size, nsamps_valid=nsamps_valid,
-                nharms=nharms, max_peaks=max_peaks, pos5=pos5, pos25=pos25,
-            )
-        )(tims, afs)
+        return search_block_core(
+            tims, afs, zapmask, windows,
+            threshold=threshold, size=size, nsamps_valid=nsamps_valid,
+            nharms=nharms, max_peaks=max_peaks, pos5=pos5, pos25=pos25,
+            pallas_block=pallas_block,
+        )
 
     return search_dm_block

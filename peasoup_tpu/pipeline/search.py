@@ -72,6 +72,7 @@ class SearchConfig:
     accel_bucket: int = 16  # accel batch padded to a multiple of this
     dm_block: int = 8  # DM trials searched per device call
     checkpoint_file: str = ""  # resumable per-DM-trial result store
+    use_pallas: bool = True  # Pallas resample kernel on TPU backends
 
 
 @dataclass
@@ -202,7 +203,19 @@ class PeasoupSearch:
             padded = int(math.ceil(len(accs) / bucket) * bucket)
             by_bucket.setdefault(padded, []).append(dm_idx)
 
-        search_block = make_batched_search_fn(cfg.min_snr)
+        pallas_block = 0
+        if cfg.use_pallas:
+            from ..ops.pallas import backend_supports_pallas
+            from ..ops.pallas.resample import choose_block
+
+            af_max = max(
+                (float(np.abs(accel_factor(a, fil.tsamp)).max())
+                 for a in accel_lists if len(a)),
+                default=0.0,
+            )
+            if backend_supports_pallas():
+                pallas_block = choose_block(af_max, size)
+        search_block = make_batched_search_fn(cfg.min_snr, pallas_block)
         tim_len = min(size, trials.shape[1])
 
         ckpt = None

@@ -228,6 +228,17 @@ class TestHarmonics:
         single = harmonic_sums(jnp.asarray(p[1]), nharms=2)
         np.testing.assert_allclose(np.asarray(outs[0][1]), np.asarray(single[0]))
 
+    @pytest.mark.parametrize("nbins", [96, 256, 1000, 4097])
+    def test_mxu_matches_take_bitwise(self, rng, nbins):
+        """The one-hot-matmul formulation must reproduce the direct
+        gather EXACTLY (one-hot columns -> exact values; zero adds are
+        exact), on awkward non-multiple-of-32 sizes too."""
+        p = rng.normal(size=(2, nbins)).astype(np.float32)
+        mxu = harmonic_sums(jnp.asarray(p), nharms=5, method="mxu")
+        take = harmonic_sums(jnp.asarray(p), nharms=5, method="take")
+        for a, b in zip(mxu, take):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
 
 class TestPeaks:
     def test_device_compaction(self):

@@ -1,0 +1,171 @@
+"""Interpret-mode parity tests for the Pallas TPU kernels vs their
+pure-jnp twins (which are themselves oracle-tested in test_ops.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from peasoup_tpu.ops.pallas.resample import (
+    choose_block,
+    resample_block,
+    resample_block_pallas,
+)
+from peasoup_tpu.ops.resample import accel_factor, resample_accel
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)
+
+
+class TestChooseBlock:
+    def test_zero_accel_gives_max(self):
+        assert choose_block(0.0, 1 << 20) == 2048
+
+    def test_scales_down_with_slope(self):
+        # af*N*blk <= 2 must hold for the returned block
+        n = 1 << 20
+        af = 1e-9
+        blk = choose_block(af, n)
+        assert blk >= 128 and af * n * blk <= 2.0
+
+    def test_extreme_slope_rejects(self):
+        assert choose_block(1e-3, 1 << 23) == 0
+
+    def test_tiny_n_rejects(self):
+        assert choose_block(0.0, 128) == 0
+
+
+class TestResamplePallas:
+    @pytest.mark.parametrize("n,accs", [
+        (4096, [0.0, 50.0, -50.0]),
+        (16384, [5.0, -5.0, 125.5, -125.5]),
+    ])
+    def test_matches_jnp_twin_bitwise(self, rng, n, accs):
+        tsamp = 256e-6
+        x = rng.normal(size=(2, n)).astype(np.float32)
+        afs = np.stack([
+            accel_factor(np.asarray(accs), tsamp).astype(np.float32)
+        ] * 2)
+        af_max = float(np.abs(afs).max())
+        blk = choose_block(af_max, n)
+        assert blk > 0
+        got = resample_block_pallas(
+            jnp.asarray(x), jnp.asarray(afs), block=blk, interpret=True
+        )
+        want = jax.vmap(resample_accel)(jnp.asarray(x), jnp.asarray(afs))
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    @pytest.mark.parametrize("sign", [1.0, -1.0])
+    @pytest.mark.parametrize("af_n_blk", [0.5, 1.0, 1.5, 2.0])
+    def test_boundary_blocks_at_high_slope(self, rng, sign, af_n_blk):
+        """Regression: with af*N*blk near the precondition limit, the
+        shift varies across the window margin in the FIRST block (af>0)
+        and LAST block (af<0); a clamped-window design silently
+        corrupted those blocks. Must stay bitwise equal to the twin."""
+        n, blk = 4096, 512
+        af = np.float32(sign * af_n_blk / (n * blk))
+        x = rng.normal(size=(1, n)).astype(np.float32)
+        afs = np.full((1, 1), af, dtype=np.float32)
+        got = resample_block_pallas(
+            jnp.asarray(x), jnp.asarray(afs), block=blk, interpret=True
+        )
+        want = jax.vmap(resample_accel)(jnp.asarray(x), jnp.asarray(afs))
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_differing_afs_per_dm_row(self, rng):
+        n = 4096
+        x = rng.normal(size=(3, n)).astype(np.float32)
+        afs = rng.uniform(-1e-7, 1e-7, size=(3, 4)).astype(np.float32)
+        got = resample_block_pallas(
+            jnp.asarray(x), jnp.asarray(afs), block=512, interpret=True
+        )
+        want = jax.vmap(resample_accel)(jnp.asarray(x), jnp.asarray(afs))
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_dispatch_fallback_on_bad_shapes(self, rng):
+        # N too small for any valid block: dispatcher must fall back to
+        # the jnp twin, not raise
+        n = 128
+        x = rng.normal(size=(1, n)).astype(np.float32)
+        afs = np.zeros((1, 2), dtype=np.float32)
+        out = resample_block(
+            jnp.asarray(x), jnp.asarray(afs), 0.0, interpret=True
+        )
+        want = jax.vmap(resample_accel)(jnp.asarray(x), jnp.asarray(afs))
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
+
+    def test_dispatch_uses_pallas_when_valid(self, rng):
+        n = 2048
+        x = rng.normal(size=(1, n)).astype(np.float32)
+        afs = np.full((1, 2), 1e-8, dtype=np.float32)
+        out = resample_block(
+            jnp.asarray(x), jnp.asarray(afs), 1e-8, interpret=True
+        )
+        want = jax.vmap(resample_accel)(jnp.asarray(x), jnp.asarray(afs))
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
+
+
+class TestBlockCoreParity:
+    """search_block_core must equal vmap(search_trial_core), with and
+    without the Pallas resample path."""
+
+    def _inputs(self, rng, size=4096, d=3, a=4, nharms=2):
+        from peasoup_tpu.pipeline.search import _level_windows
+
+        t = np.arange(size)
+        tims = np.stack([
+            np.clip(
+                rng.normal(30, 3, size=size)
+                + 12.0 * (((t * 0.000256) / 0.032) % 1.0 < 0.1),
+                0, 255,
+            ) for _ in range(d)
+        ]).astype(np.uint8)
+        accs = np.linspace(-20.0, 20.0, a)
+        afs = np.stack([
+            accel_factor(accs, 0.000256).astype(np.float32)
+        ] * d)
+        zap = jnp.zeros(size // 2 + 1, dtype=bool)
+        windows = jnp.asarray(_level_windows(size, nharms, 0.1, 1100.0, 0.000256))
+        return jnp.asarray(tims), jnp.asarray(afs), zap, windows, nharms
+
+    def test_block_core_matches_vmapped_trial_core(self, rng):
+        from peasoup_tpu.pipeline.accel_search import (
+            search_block_core,
+            search_trial_core,
+        )
+
+        tims, afs, zap, windows, nharms = self._inputs(rng)
+        kw = dict(
+            threshold=6.0, size=tims.shape[1], nsamps_valid=tims.shape[1],
+            nharms=nharms, max_peaks=64, pos5=8, pos25=80,
+        )
+        blocked = search_block_core(tims, afs, zap, windows, **kw)
+        trial = jax.vmap(
+            lambda t_, a_: search_trial_core(t_, a_, zap, windows, **kw)
+        )(tims, afs)
+        np.testing.assert_array_equal(np.asarray(blocked.idxs), np.asarray(trial.idxs))
+        np.testing.assert_array_equal(np.asarray(blocked.snrs), np.asarray(trial.snrs))
+        np.testing.assert_array_equal(np.asarray(blocked.counts), np.asarray(trial.counts))
+
+    def test_block_core_pallas_matches_jnp(self, rng):
+        from peasoup_tpu.pipeline.accel_search import search_block_core
+        from peasoup_tpu.ops.pallas.resample import choose_block
+
+        tims, afs, zap, windows, nharms = self._inputs(rng)
+        af_max = float(np.abs(np.asarray(afs)).max())
+        blk = choose_block(af_max, tims.shape[1])
+        assert blk > 0
+        kw = dict(
+            threshold=6.0, size=tims.shape[1], nsamps_valid=tims.shape[1],
+            nharms=nharms, max_peaks=64, pos5=8, pos25=80,
+        )
+        plain = search_block_core(tims, afs, zap, windows, **kw)
+        pallas = search_block_core(
+            tims, afs, zap, windows, **kw,
+            pallas_block=blk, pallas_interpret=True,
+        )
+        np.testing.assert_array_equal(np.asarray(plain.idxs), np.asarray(pallas.idxs))
+        np.testing.assert_array_equal(np.asarray(plain.snrs), np.asarray(pallas.snrs))
+        np.testing.assert_array_equal(np.asarray(plain.counts), np.asarray(pallas.counts))
