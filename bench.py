@@ -22,6 +22,39 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import numpy as np
 
 
+def bench_fft(n: int = 1 << 23, iters: int = 50) -> int:
+    """hcfft-equivalent micro-bench (reference src/hcfft.cpp:14-42):
+    mean seconds per R2C+C2R round trip at N=2^23. Secondary mode,
+    invoked explicitly with --fft."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.asarray(np.random.default_rng(0).normal(size=n).astype(np.float32))
+
+    @jax.jit
+    def roundtrip(v):
+        return jnp.fft.irfft(jnp.fft.rfft(v), n=n)
+
+    roundtrip(x).block_until_ready()  # compile
+    t0 = time.time()
+    y = x
+    for _ in range(iters):
+        y = roundtrip(y)
+    y.block_until_ready()
+    per_iter = (time.time() - t0) / iters
+    print(
+        json.dumps(
+            {
+                "metric": "fft_r2c_c2r_roundtrip",
+                "value": round(per_iter * 1e3, 3),
+                "unit": "ms/iter@2^23",
+                "vs_baseline": 0.0,  # reference harness recorded no number
+            }
+        )
+    )
+    return 0
+
+
 def main() -> int:
     from peasoup_tpu.io import read_filterbank
     from peasoup_tpu.pipeline import PeasoupSearch, SearchConfig
@@ -66,4 +99,6 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    if "--fft" in sys.argv:
+        sys.exit(bench_fft())
     sys.exit(main())

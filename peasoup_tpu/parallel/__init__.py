@@ -1,3 +1,8 @@
 from .mesh import make_mesh, device_count
 from .sharded_search import make_sharded_search_fn
 from .coincidence import baseline_beam, sharded_coincidence
+from .distributed_fft import (
+    distributed_fft,
+    distributed_rfft,
+    unshuffle_fft_order,
+)

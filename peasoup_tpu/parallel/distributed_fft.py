@@ -1,0 +1,187 @@
+"""Distributed FFT over a TPU mesh axis (sequence parallelism).
+
+The reference never splits a time series: each GPU holds the whole
+series (up to 2^23 samples, SURVEY.md §5 "long-context analogue") and
+scaling is across the trial grid only. On TPU the equivalent limit is
+one chip's HBM; this module removes it with a four-step (Bailey)
+decomposition of the DFT across the mesh's sequence axis, turning the
+cross-chip data movement into ONE all-to-all over ICI:
+
+  x viewed as (N1, N2), n = n1*N2 + n2, sharded over n2 (columns):
+    1. local FFT over n1 (each chip holds all rows of its columns)
+    2. local twiddle multiply  exp(-2*pi*i * n2 * k1 / N)
+    3. all-to-all transpose: shards of k1 rows replace shards of n2
+    4. local FFT over n2
+  giving X[k2*N1 + k1] laid out as rows k1 (sharded), columns k2.
+
+A real-input transform packs even/odd samples into a complex series of
+half the length (the classic R2C doubling trick), re-shards the
+shuffled output to natural frequency order with a second all-to-all,
+and untangles the conjugate-symmetric halves with two ppermutes (the
+mirrored blocks + the one-element seam). Total cross-chip traffic for
+an rfft: two all_to_alls + two ppermutes, all over ICI.
+
+These functions are meant to be called INSIDE shard_map (they use
+axis_index/all_to_all/ppermute); `distributed_fft`/`distributed_rfft`
+wrap them for whole-array use on a mesh.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _fft_local_steps(x_cols: jax.Array, n1: int, n2: int, axis: str):
+    """Steps 1-4 on one chip's column block (n1, n2/P) -> row block
+    (n1/P, n2) of X[k2*n1 + k1]."""
+    p = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    cols = n2 // p
+
+    # 1. local FFT along n1 (columns fully resident)
+    w = jnp.fft.fft(x_cols, axis=0)  # rows now k1
+    # 2. twiddle exp(-2i pi n2 k1 / N); n2 are this chip's global columns
+    k1 = jnp.arange(n1)[:, None]
+    n2_global = me * cols + jnp.arange(cols)[None, :]
+    tw = jnp.exp((-2j * jnp.pi / (n1 * n2)) * (k1 * n2_global))
+    w = w * tw.astype(w.dtype)
+    # 3. all-to-all transpose: k1 blocks out, n2 blocks in
+    w = jax.lax.all_to_all(w, axis, split_axis=0, concat_axis=1, tiled=True)
+    # 4. local FFT along n2 (now fully resident)
+    return jnp.fft.fft(w, axis=1)  # (n1/p, n2): rows k1 block, cols k2
+
+
+def fft_sharded(x_cols: jax.Array, n: int, axis: str) -> jax.Array:
+    """C2C DFT of a length-``n`` series inside shard_map.
+
+    Args:
+      x_cols: this chip's (n1, n2/P) column block of x viewed as
+        (n1, n2) row-major with n1 = P (one row block per chip).
+      n: total length (= n1*n2).
+      axis: mesh axis name to decompose over.
+
+    Returns this chip's (1, n2) row block of X arranged [k1, k2] with
+    flat index k = k2*n1 + k1 (use unshuffle_fft_order for natural
+    order).
+    """
+    n1 = x_cols.shape[0]
+    return _fft_local_steps(x_cols, n1, n // n1, axis)
+
+
+def unshuffle_fft_order(x_rows: np.ndarray) -> np.ndarray:
+    """Host helper: gathered (n1, n2) [k1, k2] layout -> natural X[k]
+    (k = k2*n1 + k1 means natural order is the column-major flatten)."""
+    return np.asarray(x_rows).T.reshape(-1)
+
+
+@partial(jax.jit, static_argnames=("mesh", "axis"))
+def distributed_fft(x: jax.Array, mesh: Mesh, axis: str = "seq") -> jax.Array:
+    """C2C FFT of a 1-D complex array over a mesh axis.
+
+    The array is laid out (n1=P, n2) row-major and sharded by columns;
+    output is the (n1, n2) [k1, k2] matrix sharded by rows (flat index
+    k = k2*n1 + k1). One all_to_all crosses chips.
+    """
+    p = mesh.shape[axis]
+    n = x.shape[-1]
+    if n % (p * p):
+        raise ValueError(f"n={n} must be divisible by P^2={p*p}")
+    x2 = x.reshape(p, n // p).astype(jnp.complex64)
+    fn = jax.shard_map(
+        partial(fft_sharded, n=n, axis=axis),
+        mesh=mesh,
+        in_specs=P(None, axis),
+        out_specs=P(axis, None),
+    )
+    return fn(x2)
+
+
+def rfft_sharded(z_cols: jax.Array, n: int, axis: str) -> jax.Array:
+    """R2C DFT inside shard_map via the even/odd packing trick.
+
+    Args:
+      z_cols: (n1, m2/P) column block of z[j] = x[2j] + i*x[2j+1]
+        viewed as (n1, m2) with m = n/2 = n1*m2.
+      n: REAL series length.
+
+    Returns this chip's (m/P,) block of the half-spectrum X[0:m], where
+    m = n/2, in NATURAL frequency order sharded contiguously over chips.
+    (The rfft's bin m is X[m] = Re(Z[0]) - Im(Z[0]) if needed; bins
+    m+1..n-1 are the conjugate mirror.)
+    """
+    p = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    n1 = z_cols.shape[0]
+    m = n // 2
+    m2 = m // n1
+
+    zf = _fft_local_steps(z_cols, n1, m2, axis)  # (n1/p, m2) [k1, k2]
+    # natural-order contiguous block: k = k2*n1 + k1 for k1 in my row
+    # block — NOT contiguous. Re-shard to contiguous blocks of Z with an
+    # all_to_all on k2: Z block b holds k in [b*m/p, (b+1)*m/p).
+    # zf[k1_local, k2] -> flat k = k2*n1 + (me*n1/p + k1_local).
+    # Split k2 into p chunks of m2/p -> chunk c covers k in
+    # [c*(m/p) ... ) interleaved by k1; after all_to_all each chip has
+    # all k1 for its k2 chunk -> transpose locally to natural order.
+    za = jax.lax.all_to_all(zf, axis, split_axis=1, concat_axis=0, tiled=True)
+    # za: (n1, m2/p) = all k1 rows, my k2 chunk
+    z_nat = za.T.reshape(-1)  # flat k = k2_local*n1 + k1, k2 ascending
+
+    # untangle R2C: X[k] = (Z[k] + conj(Z[(m-k) mod m]))/2
+    #                     - (i/2) e^{-2 pi i k/n} (Z[k] - conj(Z[(m-k) mod m]))
+    # need the mirrored block Z[(m-k) mod m]: for my k block
+    # [me*L, me*L+L), mirrors live in blocks (p-1-me) shifted by one
+    # sample -> one ppermute + local roll, plus Z[0]'s special seam.
+    L = m // p
+    # chip me's k block [me*L, me*L+L) needs mirrors (m-k) mod m for
+    # t = k - me*L >= 1: these are k' = b*L + (L-t) for b = p-1-me, so
+    # block b's whole tail — ppermute source j -> dest p-1-j
+    mirror = jax.lax.ppermute(
+        z_nat, axis, [(j, p - 1 - j) for j in range(p)]
+    )
+    # the t=0 seam element is Z[(m - me*L) mod m] = Z[j*L] for
+    # j = (p-me) % p, i.e. chip j's FIRST element -> second ppermute
+    first = jax.lax.ppermute(
+        z_nat[:1], axis, [(j, (p - j) % p) for j in range(p)]
+    )
+    # conj(Z[(m-k) mod m]) for k = me*L + t:
+    #   t=0 -> 'first'; t>=1 -> mirror[L-t] = flip(mirror)[t-1]
+    zm = jnp.concatenate([first, jnp.flip(mirror)[: L - 1]])
+    zmc = jnp.conj(zm)
+
+    k_global = me * L + jnp.arange(L)
+    even = 0.5 * (z_nat + zmc)
+    odd = -0.5j * (z_nat - zmc)
+    wk = jnp.exp((-2j * jnp.pi / n) * k_global)
+    xk = even + wk * odd
+    # k = 0 must be Re(Z[0]) + Im(Z[0]) (whole-series DC): the formula
+    # above already gives it since Z[(m-0)%m]=Z[0]; no special case.
+    return xk
+
+
+@partial(jax.jit, static_argnames=("mesh", "axis"))
+def distributed_rfft(x: jax.Array, mesh: Mesh, axis: str = "seq") -> jax.Array:
+    """First n/2 bins of rfft(x) for real x, sharded contiguously.
+
+    Output matches jnp.fft.rfft(x)[: n//2] (the Nyquist bin is dropped;
+    the search pipeline never uses it on its own).
+    """
+    p = mesh.shape[axis]
+    n = x.shape[-1]
+    m = n // 2
+    if n % 2 or m % (p * p):
+        raise ValueError(f"n={n}: n/2 must be divisible by P^2={p*p}")
+    z = x[0::2] + 1j * x[1::2].astype(jnp.float32)
+    z2 = z.reshape(p, m // p).astype(jnp.complex64)
+    fn = jax.shard_map(
+        partial(rfft_sharded, n=n, axis=axis),
+        mesh=mesh,
+        in_specs=P(None, axis),
+        out_specs=P(axis),
+    )
+    return fn(z2)

@@ -208,12 +208,12 @@ class PeasoupSearch:
             from ..ops.pallas import backend_supports_pallas
             from ..ops.pallas.resample import choose_block
 
-            af_max = max(
-                (float(np.abs(accel_factor(a, fil.tsamp)).max())
-                 for a in accel_lists if len(a)),
-                default=0.0,
-            )
             if backend_supports_pallas():
+                af_max = max(
+                    (float(np.abs(accel_factor(a, fil.tsamp)).max())
+                     for a in accel_lists if len(a)),
+                    default=0.0,
+                )
                 pallas_block = choose_block(af_max, size)
         search_block = make_batched_search_fn(cfg.min_snr, pallas_block)
         tim_len = min(size, trials.shape[1])

@@ -96,13 +96,27 @@ class TestResamplePallas:
         want = jax.vmap(resample_accel)(jnp.asarray(x), jnp.asarray(afs))
         np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
 
-    def test_dispatch_uses_pallas_when_valid(self, rng):
+    def test_dispatch_uses_pallas_when_valid(self, rng, monkeypatch):
+        # outputs are bitwise identical either way, so assert the Pallas
+        # kernel actually ran (a dispatch regression would otherwise be
+        # invisible)
+        import peasoup_tpu.ops.pallas.resample as mod
+
+        calls = []
+        real = mod.resample_block_pallas
+
+        def spy(*args, **kw):
+            calls.append(kw.get("block"))
+            return real(*args, **kw)
+
+        monkeypatch.setattr(mod, "resample_block_pallas", spy)
         n = 2048
         x = rng.normal(size=(1, n)).astype(np.float32)
         afs = np.full((1, 2), 1e-8, dtype=np.float32)
         out = resample_block(
             jnp.asarray(x), jnp.asarray(afs), 1e-8, interpret=True
         )
+        assert calls, "dispatch did not take the Pallas path"
         want = jax.vmap(resample_accel)(jnp.asarray(x), jnp.asarray(afs))
         np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
 
