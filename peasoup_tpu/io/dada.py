@@ -1,0 +1,119 @@
+"""PSRDADA header reader.
+
+Reference: DadaHeader (include/data_types/header.hpp:52-161) — a
+4096-byte text header of ``KEY value`` pairs at the start of a .dada
+file, parsed by substring search. The reference class is unused by the
+pipeline (the `accmap` tool that wanted it references a missing
+data_types/dada.hpp); it is kept here for format parity so .dada
+metadata can be inspected and converted.
+
+Quirk preserved: the reference computes nsamples from the payload size
+as filesize/nchan/nant/npol/2 (header.hpp:157) — the /2 assumes 8-bit
+complex (NDIM=2) sampling regardless of NBIT/NDIM.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+DADA_HDR_SIZE = 4096
+
+
+@dataclass
+class DadaHeader:
+    header_version: float = 0.0
+    header_size: int = 0
+    bw: float = 0.0
+    freq: float = 0.0
+    nant: int = 0
+    nchan: int = 0
+    ndim: int = 0
+    npol: int = 0
+    nbit: int = 0
+    tsamp: float = 0.0
+    osamp_ratio: float = 0.0
+    source_name: str = ""
+    ra: str = ""
+    dec: str = ""
+    proc_file: str = ""
+    mode: str = ""
+    observer: str = ""
+    pid: str = ""
+    obs_offset: int = 0
+    telescope: str = ""
+    instrument: str = ""
+    dsb: int = 0
+    filesize: int = 0
+    dada_filesize: int = 0
+    nsamples: int = 0
+    bytes_per_sec: int = 0
+    utc_start: str = ""
+    ant_id: int = 0
+    file_no: int = 0
+
+    @classmethod
+    def fromfile(cls, filename: str | os.PathLike) -> "DadaHeader":
+        with open(filename, "rb") as f:
+            raw = f.read(DADA_HDR_SIZE)
+            f.seek(0, os.SEEK_END)
+            payload = max(f.tell() - DADA_HDR_SIZE, 0)
+        text = raw.decode("ascii", errors="replace")
+
+        def value(key: str) -> str:
+            # substring search like the reference's get_value
+            # (header.hpp:65-76): first occurrence, next whitespace token
+            pos = text.find(key + " ")
+            if pos < 0:
+                return ""
+            rest = text[pos + len(key) + 1 :]
+            toks = rest.split()
+            return toks[0] if toks else ""
+
+        def fnum(key: str) -> float:
+            v = value(key)
+            try:
+                return float(v)
+            except ValueError:
+                return 0.0
+
+        def inum(key: str) -> int:
+            v = value(key)
+            try:
+                return int(float(v))
+            except ValueError:
+                return 0
+
+        h = cls(
+            header_version=fnum("HDR_VERSION"),
+            header_size=inum("HDR_SIZE"),
+            bw=float(inum("BW")),  # reference uses atoi for BW (:132)
+            freq=fnum("FREQ"),
+            nant=inum("NANT"),
+            nchan=inum("NCHAN"),
+            ndim=inum("NDIM"),
+            npol=inum("NPOL"),
+            nbit=inum("NBIT"),
+            tsamp=fnum("TSAMP"),
+            osamp_ratio=fnum("OSAMP_RATIO"),
+            source_name=value("SOURCE"),
+            ra=value("RA"),
+            dec=value("DEC"),
+            proc_file=value("PROC_FILE"),
+            mode=value("MODE"),
+            observer=value("OBSERVER"),
+            pid=value("PID"),
+            obs_offset=inum("OBS_OFFSET"),
+            telescope=value("TELESCOPE"),
+            instrument=value("INSTRUMENT"),
+            dsb=inum("DSB"),
+            filesize=payload,
+            dada_filesize=inum("FILE_SIZE"),
+            bytes_per_sec=inum("BYTES_PER_SECOND"),
+            utc_start=value("UTC_START"),
+            ant_id=inum("ANT_ID"),
+            file_no=inum("FILE_NUMBER"),
+        )
+        denom = max(h.nchan, 1) * max(h.nant, 1) * max(h.npol, 1) * 2
+        h.nsamples = payload // denom
+        return h

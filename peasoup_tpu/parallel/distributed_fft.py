@@ -33,7 +33,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 
 def _fft_local_steps(x_cols: jax.Array, n1: int, n2: int, axis: str):

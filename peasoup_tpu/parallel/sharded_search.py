@@ -13,20 +13,27 @@ merge on join (pipeline_multi.cu:356-359).
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..pipeline.accel_search import AccelSearchPeaks, search_trial_core
+from ..pipeline.accel_search import AccelSearchPeaks, search_block_core
 
 
-def make_sharded_search_fn(mesh: Mesh, threshold: float, axis: str = "dm"):
+@lru_cache(maxsize=None)
+def make_sharded_search_fn(
+    mesh: Mesh, threshold: float, axis: str = "dm", pallas_block: int = 0
+):
     """Jitted (D, ...) -> (D, ...) search with D sharded over ``axis``.
 
     D must be a multiple of the mesh axis size (pad the trial block and
     the afs rows; padded rows are searched but discarded by the host).
+    Each chip runs the block-batched core on its local trials; with
+    ``pallas_block`` > 0 the Pallas resample kernel runs per chip.
+    Cached (mesh/threshold/axis/block are hashable) so repeat runs reuse
+    the compiled executable like make_batched_search_fn.
     """
 
     @partial(
@@ -48,13 +55,12 @@ def make_sharded_search_fn(mesh: Mesh, threshold: float, axis: str = "dm"):
         pos25: int,
     ) -> AccelSearchPeaks:
         def local(tims_l, afs_l, zap_l, win_l):
-            return jax.vmap(
-                lambda t, a: search_trial_core(
-                    t, a, zap_l, win_l,
-                    threshold=threshold, size=size, nsamps_valid=nsamps_valid,
-                    nharms=nharms, max_peaks=max_peaks, pos5=pos5, pos25=pos25,
-                )
-            )(tims_l, afs_l)
+            return search_block_core(
+                tims_l, afs_l, zap_l, win_l,
+                threshold=threshold, size=size, nsamps_valid=nsamps_valid,
+                nharms=nharms, max_peaks=max_peaks, pos5=pos5, pos25=pos25,
+                pallas_block=pallas_block,
+            )
 
         return jax.shard_map(
             local,

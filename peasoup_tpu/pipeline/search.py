@@ -70,9 +70,13 @@ class SearchConfig:
     max_peaks: int = 512  # static peak-compaction size per spectrum
     dedisp_block: int = 16  # DM trials per dedispersion launch
     accel_bucket: int = 16  # accel batch padded to a multiple of this
-    dm_block: int = 8  # DM trials searched per device call
+    dm_block: int = 8  # DM trials searched per device call (per chip)
     checkpoint_file: str = ""  # resumable per-DM-trial result store
     use_pallas: bool = True  # Pallas resample kernel on TPU backends
+    # device sharding: 0 = auto (all local TPU chips up to
+    # max_num_threads, single-device elsewhere); N = force an N-chip
+    # 'dm' mesh (tests use this on the virtual CPU mesh)
+    shard_devices: int = 0
 
 
 @dataclass
@@ -116,6 +120,23 @@ def _freq_factor(size: int, nh: int, tsamp: float) -> float:
 class PeasoupSearch:
     def __init__(self, config: SearchConfig):
         self.config = config
+        self._eff_dm_block = config.dm_block
+        self._dm_sharding = None
+
+    def _pick_devices(self) -> list:
+        """Devices to shard DM trials over. Auto mode mirrors the
+        reference's one-worker-per-GPU-up-to--t policy
+        (pipeline_multi.cu:276-277) on TPU backends; elsewhere it stays
+        single-device unless shard_devices forces a mesh (tests)."""
+        import jax
+
+        devs = jax.local_devices()
+        cfg = self.config
+        if cfg.shard_devices > 0:
+            return devs[: min(cfg.shard_devices, len(devs))]
+        if devs and devs[0].platform == "tpu":
+            return devs[: min(len(devs), cfg.max_num_threads)]
+        return devs[:1]
 
     def run(self, fil: Filterbank) -> SearchResult:
         cfg = self.config
@@ -215,7 +236,29 @@ class PeasoupSearch:
                     default=0.0,
                 )
                 pallas_block = choose_block(af_max, size)
-        search_block = make_batched_search_fn(cfg.min_snr, pallas_block)
+
+        # --- device selection: shard DM trials over local chips --------
+        # (the reference's analogue: one worker per GPU up to -t,
+        # pipeline_multi.cu:276-277)
+        devices = self._pick_devices()
+        if len(devices) > 1:
+            from ..parallel.mesh import make_mesh
+            from ..parallel.sharded_search import make_sharded_search_fn
+
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            mesh = make_mesh({"dm": len(devices)}, devices=devices)
+            search_block = make_sharded_search_fn(
+                mesh, cfg.min_snr, axis="dm", pallas_block=pallas_block
+            )
+            # per-call block covers dm_block trials per chip; stage
+            # blocks directly onto the mesh (no hop through chip 0)
+            self._dm_sharding = NamedSharding(mesh, PartitionSpec("dm"))
+            self._eff_dm_block = cfg.dm_block * len(devices)
+        else:
+            search_block = make_batched_search_fn(cfg.min_snr, pallas_block)
+            self._dm_sharding = None
+            self._eff_dm_block = cfg.dm_block
         tim_len = min(size, trials.shape[1])
 
         ckpt = None
@@ -233,9 +276,9 @@ class PeasoupSearch:
                 )
 
         chunks = [
-            dm_indices[start : start + cfg.dm_block]
+            dm_indices[start : start + self._eff_dm_block]
             for padded, dm_indices in sorted(by_bucket.items())
-            for start in range(0, len(dm_indices), cfg.dm_block)
+            for start in range(0, len(dm_indices), self._eff_dm_block)
         ]
         progress = ProgressBar() if cfg.progress_bar else None
         if progress:
@@ -346,6 +389,7 @@ class PeasoupSearch:
         """Run one (dm_block, accel_bucket) device tile and bank the
         static-size peak sets for every real trial in the chunk."""
         cfg = self.config
+        dm_block = self._eff_dm_block
         real = len(chunk)
         bucket = cfg.accel_bucket
         padded = max(
@@ -353,15 +397,23 @@ class PeasoupSearch:
             for d in chunk
         )
         # pad the block by repeating the first trial (discarded)
-        block_idx = chunk + [chunk[0]] * (cfg.dm_block - real)
-        afs = np.zeros((cfg.dm_block, padded), dtype=np.float32)
+        block_idx = chunk + [chunk[0]] * (dm_block - real)
+        afs = np.zeros((dm_block, padded), dtype=np.float32)
         for row, dm_idx in enumerate(block_idx):
             accs = accel_lists[dm_idx]
             afs[row, : len(accs)] = accel_factor(accs, tsamp).astype(
                 np.float32
             )
-        tims_dev = jnp.asarray(trials[block_idx, :tim_len])
-        afs_dev = jnp.asarray(afs)
+        import jax
+
+        if self._dm_sharding is not None:
+            tims_dev = jax.device_put(
+                trials[block_idx, :tim_len], self._dm_sharding
+            )
+            afs_dev = jax.device_put(afs, self._dm_sharding)
+        else:
+            tims_dev = jnp.asarray(trials[block_idx, :tim_len])
+            afs_dev = jnp.asarray(afs)
         max_peaks = cfg.max_peaks
         while True:
             peaks = search_block(

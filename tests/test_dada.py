@@ -1,0 +1,60 @@
+"""DadaHeader parity tests (reference: include/data_types/header.hpp:52-161)."""
+
+import numpy as np
+
+from peasoup_tpu.io.dada import DADA_HDR_SIZE, DadaHeader
+
+HDR = """HDR_VERSION 1.0
+HDR_SIZE 4096
+BW 400
+FREQ 1382.0
+NANT 1
+NCHAN 1024
+NDIM 2
+NPOL 1
+NBIT 8
+TSAMP 0.00064
+SOURCE J0437-4715
+RA 04:37:15.8
+DEC -47:15:09.1
+TELESCOPE MeerKAT
+INSTRUMENT CBF
+OBS_OFFSET 0
+FILE_SIZE 8388608
+BYTES_PER_SECOND 1600000000
+UTC_START 2014-02-13-05:52:12
+ANT_ID 3
+FILE_NUMBER 7
+"""
+
+
+def _write_dada(path, payload_bytes):
+    raw = HDR.encode().ljust(DADA_HDR_SIZE, b"\x00")
+    with open(path, "wb") as f:
+        f.write(raw)
+        f.write(np.zeros(payload_bytes, dtype=np.uint8).tobytes())
+
+
+def test_dada_header_roundtrip(tmp_path):
+    path = tmp_path / "x.dada"
+    _write_dada(path, 1024 * 2 * 100)  # nchan*2*nsamps
+    h = DadaHeader.fromfile(path)
+    assert h.header_version == 1.0
+    assert h.nchan == 1024 and h.nbit == 8 and h.npol == 1
+    assert h.freq == 1382.0 and h.bw == 400.0
+    assert h.tsamp == 0.00064
+    assert h.source_name == "J0437-4715"
+    assert h.telescope == "MeerKAT" and h.ant_id == 3 and h.file_no == 7
+    assert h.utc_start == "2014-02-13-05:52:12"
+    assert h.filesize == 1024 * 2 * 100
+    # reference quirk: nsamples = filesize/nchan/nant/npol/2
+    assert h.nsamples == 100
+    assert h.dada_filesize == 8388608
+
+
+def test_dada_missing_keys_are_defaults(tmp_path):
+    path = tmp_path / "y.dada"
+    with open(path, "wb") as f:
+        f.write(b"HDR_VERSION 1.0\n".ljust(DADA_HDR_SIZE, b"\x00"))
+    h = DadaHeader.fromfile(path)
+    assert h.nchan == 0 and h.source_name == "" and h.nsamples == 0

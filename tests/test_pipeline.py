@@ -83,6 +83,23 @@ class TestEndToEnd:
         assert len(res.dm_list) >= 1
         assert len(res.candidates) <= 10
 
+    def test_sharded_search_matches_single_device(self, synthetic):
+        """The full driver on an 8-chip 'dm' mesh must produce the same
+        candidate list as the single-device path."""
+        if len(jax.devices()) < 8:
+            pytest.skip("need 8 devices")
+        path, _, _ = synthetic
+        fil = read_filterbank(path)
+        common = dict(dm_end=40.0, nharmonics=2, npdmp=0, limit=100)
+        single = PeasoupSearch(SearchConfig(**common)).run(fil)
+        sharded = PeasoupSearch(
+            SearchConfig(shard_devices=8, **common)
+        ).run(fil)
+        assert len(single.candidates) == len(sharded.candidates) > 0
+        for a, b in zip(single.candidates, sharded.candidates):
+            assert a.freq == b.freq and a.snr == b.snr
+            assert a.dm == b.dm and a.acc == b.acc and a.nh == b.nh
+
 
 class TestDistillers:
     def test_harmonic_distiller_absorbs(self):
