@@ -7,6 +7,8 @@ non-TPU backends or when a kernel's preconditions don't hold.
 
 from __future__ import annotations
 
+from functools import lru_cache
+
 import jax
 
 
@@ -16,6 +18,36 @@ def backend_supports_pallas() -> bool:
     try:
         return jax.default_backend() == "tpu"
     except RuntimeError:
+        return False
+
+
+@lru_cache(maxsize=1)
+def probe_pallas_resample() -> bool:
+    """One-time REAL compile+run probe of the resample kernel.
+
+    The kernels are interpret-tested everywhere, but Mosaic's compiled
+    feature set differs per backend/toolchain; a production search must
+    degrade to the jnp twin rather than crash, so eligibility is
+    established by actually running a tiny kernel once per process.
+    """
+    if not backend_supports_pallas():
+        return False
+    try:
+        import numpy as np
+        import jax.numpy as jnp
+
+        from .resample import resample_block_pallas
+
+        n = 1024
+        x = jnp.asarray(np.arange(2 * n, dtype=np.float32).reshape(2, n))
+        afs = jnp.asarray(np.full((2, 2), 1e-9, dtype=np.float32))
+        out = np.asarray(resample_block_pallas(x, afs, block=128))
+        return bool(np.isfinite(out).all()) and out.shape == (2, 2, n)
+    except Exception as exc:  # any Mosaic/compile failure -> jnp path
+        import warnings
+
+        warnings.warn(f"Pallas resample kernel unavailable, using jnp "
+                      f"fallback: {type(exc).__name__}: {exc}")
         return False
 
 
