@@ -21,16 +21,19 @@ def backend_supports_pallas() -> bool:
         return False
 
 
-@lru_cache(maxsize=1)
-def probe_pallas_resample() -> bool:
-    """One-time REAL compile+run probe of the resample kernel.
+@lru_cache(maxsize=None)
+def probe_pallas_resample(n: int, block: int) -> bool:
+    """REAL compile+run probe of the resample kernel at the shape the
+    caller is about to use (cached per (n, block)).
 
     The kernels are interpret-tested everywhere, but Mosaic's compiled
     feature set differs per backend/toolchain; a production search must
     degrade to the jnp twin rather than crash, so eligibility is
-    established by actually running a tiny kernel once per process.
-    """
-    if not backend_supports_pallas():
+    established by actually compiling and running the kernel with the
+    production n and block (grid trimmed to one DM x one accel trial —
+    the VMEM window, DMA shapes, and roll lowering are what vary with
+    shape, and those are set by (n, block))."""
+    if not backend_supports_pallas() or block <= 0:
         return False
     try:
         import numpy as np
@@ -38,16 +41,16 @@ def probe_pallas_resample() -> bool:
 
         from .resample import resample_block_pallas
 
-        n = 1024
-        x = jnp.asarray(np.arange(2 * n, dtype=np.float32).reshape(2, n))
-        afs = jnp.asarray(np.full((2, 2), 1e-9, dtype=np.float32))
-        out = np.asarray(resample_block_pallas(x, afs, block=128))
-        return bool(np.isfinite(out).all()) and out.shape == (2, 2, n)
+        x = jnp.asarray(np.arange(n, dtype=np.float32).reshape(1, n))
+        afs = jnp.asarray(np.full((1, 1), 1e-12, dtype=np.float32))
+        out = np.asarray(resample_block_pallas(x, afs, block=block))
+        return bool(np.isfinite(out).all()) and out.shape == (1, 1, n)
     except Exception as exc:  # any Mosaic/compile failure -> jnp path
         import warnings
 
-        warnings.warn(f"Pallas resample kernel unavailable, using jnp "
-                      f"fallback: {type(exc).__name__}: {exc}")
+        warnings.warn(f"Pallas resample kernel unavailable at n={n}, "
+                      f"block={block}; using jnp fallback: "
+                      f"{type(exc).__name__}: {exc}")
         return False
 
 

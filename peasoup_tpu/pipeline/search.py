@@ -226,16 +226,20 @@ class PeasoupSearch:
 
         pallas_block = 0
         if cfg.use_pallas:
-            from ..ops.pallas import backend_supports_pallas
+            from ..ops.pallas import probe_pallas_resample
             from ..ops.pallas.resample import choose_block
 
-            if backend_supports_pallas():
-                af_max = max(
-                    (float(np.abs(accel_factor(a, fil.tsamp)).max())
-                     for a in accel_lists if len(a)),
-                    default=0.0,
-                )
-                pallas_block = choose_block(af_max, size)
+            af_max = max(
+                (float(np.abs(accel_factor(a, fil.tsamp)).max())
+                 for a in accel_lists if len(a)),
+                default=0.0,
+            )
+            pallas_block = choose_block(af_max, size)
+            # real compile+run probe at the production shape: degrade
+            # to the jnp twin instead of crashing on Mosaic toolchains
+            # that reject this kernel
+            if pallas_block and not probe_pallas_resample(size, pallas_block):
+                pallas_block = 0
 
         # --- device selection: shard DM trials over local chips --------
         # (the reference's analogue: one worker per GPU up to -t,
