@@ -65,7 +65,7 @@ def output_scale(nbits: int, nchans_kept: int) -> float:
     return 1.0 if max_sum <= 255 else 255.0 / max_sum
 
 
-def dedisperse(
+def dedisperse_device(
     fil_tc: np.ndarray,
     delays: np.ndarray,
     killmask: np.ndarray,
@@ -74,11 +74,15 @@ def dedisperse(
     quantize: bool = True,
     scale: float = 1.0,
     block: int = 16,
-) -> np.ndarray:
-    """Host-driving wrapper: dedisperse all DM trials in device-sized blocks.
+) -> jax.Array:
+    """Dedisperse all DM trials in device-sized blocks, keeping the
+    (ndm, out_nsamps) result RESIDENT on device.
 
-    Blocks bound peak HBM ((block+1) * T * 4 bytes of working set); the
-    filterbank itself is transferred once.
+    The filterbank is transferred once and the trials never round-trip
+    through the host — the search slices trial rows on device (the
+    reference instead keeps trials in host RAM and re-uploads each one,
+    timeseries.hpp:335-344). Blocks bound peak HBM ((block+1) * T * 4
+    bytes of working set).
     """
     ndm = delays.shape[0]
     fil_dev = jnp.asarray(fil_tc)
@@ -98,6 +102,39 @@ def dedisperse(
             quantize=quantize,
             scale=scale,
         )
-        res = np.asarray(res)
+        outs.append(res[: block - pad] if pad else res)
+    return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
+
+
+def dedisperse(
+    fil_tc: np.ndarray,
+    delays: np.ndarray,
+    killmask: np.ndarray,
+    out_nsamps: int,
+    *,
+    quantize: bool = True,
+    scale: float = 1.0,
+    block: int = 16,
+) -> np.ndarray:
+    """Host-resident variant: trials are fetched per device block, so
+    HBM never holds more than one block (for surveys whose full trial
+    set would crowd the chip; cf. reference host-RAM trials,
+    dedisperser.hpp:101-103)."""
+    ndm = delays.shape[0]
+    fil_dev = jnp.asarray(fil_tc)
+    kill_dev = jnp.asarray(killmask)
+    outs = []
+    for start in range(0, ndm, block):
+        d = np.asarray(delays[start : start + block], dtype=np.int32)
+        pad = 0
+        if len(d) < block:
+            pad = block - len(d)
+            d = np.pad(d, ((0, pad), (0, 0)))
+        res = np.asarray(
+            dedisperse_block(
+                fil_dev, jnp.asarray(d), kill_dev,
+                out_nsamps=out_nsamps, quantize=quantize, scale=scale,
+            )
+        )
         outs.append(res[: block - pad] if pad else res)
     return np.concatenate(outs, axis=0)

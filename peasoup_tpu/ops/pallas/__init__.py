@@ -37,14 +37,25 @@ def probe_pallas_resample(n: int, block: int) -> bool:
         return False
     try:
         import numpy as np
+        import jax
         import jax.numpy as jnp
 
         from .resample import resample_block_pallas
+        from ..resample import resample_accel
 
+        # af near the choose_block precondition limit: the shift walks
+        # through every select arm, so a wrong pltpu.roll lowering (off
+        # by a lane, wrong direction) cannot return oracle-equal data
+        af = 1.9 / (float(n) * block)
         x = jnp.asarray(np.arange(n, dtype=np.float32).reshape(1, n))
-        afs = jnp.asarray(np.full((1, 1), 1e-12, dtype=np.float32))
+        afs = jnp.asarray(np.asarray([[af, -af]], dtype=np.float32))
         out = np.asarray(resample_block_pallas(x, afs, block=block))
-        return bool(np.isfinite(out).all()) and out.shape == (1, 1, n)
+        if out.shape != (1, 2, n):
+            return False
+        # the kernel's index math is the same f32 ops as the jnp twin:
+        # anything but bitwise equality means a broken lowering
+        ref = np.asarray(resample_accel(x[0], afs[0]))
+        return bool(np.array_equal(out[0], ref))
     except Exception as exc:  # any Mosaic/compile failure -> jnp path
         import warnings
 

@@ -6,12 +6,16 @@ host then clusters neighbours within ``min_gap`` bins
 (PeakFinder::identify_unique_peaks, include/transforms/peakfinder.hpp:27-56).
 
 TPU design: copy_if's dynamic output shape is hostile to XLA, so the
-compaction uses jnp.nonzero with a static ``max_peaks`` size (the
-reference hard-codes max_cands=100000 for the same reason,
-peakfinder.hpp:61). Indices come out ascending, which the host
-clustering pass requires. The search-range window [start_idx, limit)
-is applied as part of the mask, mirroring the (min_freq, max_freq)
-windowing in find_candidates (peakfinder.hpp:82-84).
+compaction is static-size with ``max_peaks`` slots (the reference
+hard-codes max_cands=100000 for the same reason, peakfinder.hpp:61).
+The compaction itself runs as lax.top_k over the key ``-index`` masked
+to crossings: top_k of the negated indices returns the FIRST max_peaks
+crossings in ascending index order, which is exactly nonzero(size=k)
+semantics but lowers ~10x faster on TPU than the cumsum/scatter
+compaction XLA emits for sized nonzero. Indices come out ascending,
+which the host clustering pass requires. The search-range window
+[start_idx, limit) is applied as part of the mask, mirroring the
+(min_freq, max_freq) windowing in find_candidates (peakfinder.hpp:82-84).
 """
 
 from __future__ import annotations
@@ -41,13 +45,22 @@ def find_peaks_device(
     nbins = spec.shape[-1]
     i = jnp.arange(nbins, dtype=jnp.int32)
 
+    k = min(max_peaks, nbins)
+
     def one(s, thr, lo, hi):
         mask = (i >= lo) & (i < hi) & (s > thr)
-        idxs = jnp.nonzero(mask, size=max_peaks, fill_value=nbins)[0].astype(
-            jnp.int32
-        )
-        snrs = jnp.where(idxs < nbins, s[jnp.clip(idxs, 0, nbins - 1)], 0.0)
-        return idxs, snrs, mask.sum().astype(jnp.int32)
+        count = mask.sum().astype(jnp.int32)
+        # top_k over -index: picks the first k crossings, in ascending
+        # index order (descending key order)
+        key = jnp.where(mask, -i, jnp.int32(-nbins - 1))
+        kv, ki = jax.lax.top_k(key, k)
+        valid = kv > -nbins - 1
+        idxs = jnp.where(valid, ki, nbins).astype(jnp.int32)
+        snrs = jnp.where(valid, s[jnp.clip(ki, 0, nbins - 1)], 0.0)
+        if k < max_peaks:
+            idxs = jnp.pad(idxs, (0, max_peaks - k), constant_values=nbins)
+            snrs = jnp.pad(snrs, (0, max_peaks - k))
+        return idxs, snrs, count
 
     batch = spec.shape[:-1]
     if batch:
@@ -62,6 +75,82 @@ def find_peaks_device(
             count.reshape(batch),
         )
     return one(spec, threshold, start_idx, limit)
+
+
+@partial(jax.jit, static_argnames=("min_gap",))
+def cluster_peaks_device(
+    idxs: jnp.ndarray,  # (..., mx) i32 ascending crossings, padded with nbins
+    snrs: jnp.ndarray,  # (..., mx) f32
+    nbins: jnp.ndarray,  # scalar i32: pad sentinel (any idx >= nbins is pad)
+    *,
+    min_gap: int = 30,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Exact ON-DEVICE port of identify_unique_peaks
+    (peakfinder.hpp:27-56), vectorised over every leading cell.
+
+    The reference walks crossings sequentially per spectrum; here a
+    lax.scan walks the (small, static) compacted slot axis once while
+    every (dm, level, accel) cell advances in parallel lanes — turning
+    a 13k-call host loop into one device pass, and shrinking the
+    device->host transfer to cluster peaks (tens) instead of raw
+    crossings (hundreds). Quirk preserved: ``lastidx`` advances only
+    when a higher snr is found, so a slow ramp of weak peaks can
+    terminate a cluster early.
+
+    Returns (cluster idxs (..., mx) i32 ascending padded with nbins,
+    cluster snrs (..., mx) f32 0-padded, cluster count (...,) i32).
+    """
+    batch = idxs.shape[:-1]
+    mx = idxs.shape[-1]
+    flat_i = idxs.reshape(-1, mx).T  # (mx, lanes)
+    flat_s = snrs.reshape(-1, mx).T
+    lanes = flat_i.shape[1]
+    # one trailing pad step flushes the final open cluster
+    flat_i = jnp.concatenate(
+        [flat_i, jnp.full((1, lanes), nbins, dtype=flat_i.dtype)]
+    )
+    flat_s = jnp.concatenate([flat_s, jnp.zeros((1, lanes), flat_s.dtype)])
+
+    def step(carry, xs):
+        open_, cpeak, cpeakidx, lastidx = carry
+        idx, snr = xs
+        is_pad = idx >= nbins
+        close = open_ & (is_pad | (idx - lastidx >= min_gap))
+        start = (~open_ | close) & ~is_pad
+        update = open_ & ~close & ~is_pad & (snr > cpeak)
+        take = start | update
+        carry = (
+            (open_ & ~is_pad) | start,
+            jnp.where(take, snr, cpeak),
+            jnp.where(take, idx, cpeakidx),
+            jnp.where(take, idx, lastidx),
+        )
+        return carry, (close, cpeakidx, cpeak)
+
+    # derive the init carry from the inputs so its sharding/varying
+    # type matches the scan body's outputs under shard_map
+    zero_i = flat_i[0] * 0
+    init = (zero_i < -1, flat_s[0] * 0, zero_i, zero_i)
+    _, (valid, eidx, esnr) = jax.lax.scan(step, init, (flat_i, flat_s))
+    # compact the scattered emissions to the head, preserving order
+    # (same -index top_k trick as find_peaks_device)
+    valid = valid.T  # (lanes, mx+1)
+    eidx = eidx.T
+    esnr = esnr.T
+    step_i = jnp.arange(mx + 1, dtype=jnp.int32)
+    key = jnp.where(valid, -step_i, jnp.int32(-(mx + 2)))
+    kv, ki = jax.lax.top_k(key, mx)
+    ok = kv > -(mx + 2)
+    cidx = jnp.where(
+        ok, jnp.take_along_axis(eidx, ki, axis=-1), nbins
+    ).astype(jnp.int32)
+    csnr = jnp.where(ok, jnp.take_along_axis(esnr, ki, axis=-1), 0.0)
+    ccount = valid.sum(axis=-1).astype(jnp.int32)
+    return (
+        cidx.reshape(*batch, mx),
+        csnr.reshape(*batch, mx),
+        ccount.reshape(batch),
+    )
 
 
 def cluster_peaks(

@@ -54,6 +54,47 @@ def resample_accel(x: jnp.ndarray, afs: jnp.ndarray) -> jnp.ndarray:
     return jax.vmap(one)(afs)
 
 
+@partial(jax.jit, static_argnames=("smax",))
+def resample_select(
+    x: jnp.ndarray,  # (D, N) f32 time series per DM trial
+    afs: jnp.ndarray,  # (D, A) f32 acceleration factors a*tsamp/2c
+    *,
+    smax: int,
+) -> jnp.ndarray:
+    """Gather-free resampling for small shift spans.
+
+    For physical accelerations the shift s(i) = rint(af*i*(i-N)) spans
+    only a handful of integer values over the WHOLE series
+    (|s| <= |af|*N^2/4); each output is then a SELECT among 2*smax+1
+    shifted copies of x — pure elementwise VPU work at full HBM
+    bandwidth instead of a gather. Edge-padding reproduces the
+    reference's index clip exactly (x[clip(i+s, 0, N-1)],
+    src/kernels.cu:341-345), so results are bitwise identical to
+    :func:`resample_accel`. ``smax`` must be >= max|afs|*N^2/4
+    (see :func:`select_span`).
+
+    Returns (D, A, N).
+    """
+    n = x.shape[-1]
+    idx = jnp.arange(n, dtype=jnp.float32)
+    quad = idx * (idx - jnp.float32(n))  # exact inputs, one f32 rounding
+    shift = jnp.rint(afs[..., None] * quad).astype(jnp.int32)  # (D, A, N)
+    xp = jnp.pad(x.astype(jnp.float32), ((0, 0), (smax, smax)), mode="edge")
+    out = jnp.zeros(shift.shape, jnp.float32)
+    for s in range(-smax, smax + 1):
+        arm = jax.lax.dynamic_slice_in_dim(xp, smax + s, n, axis=1)  # (D, N)
+        out = jnp.where(shift == jnp.int32(s), arm[:, None, :], out)
+    return out
+
+
+def select_span(af_max: float, n: int, limit: int = 64) -> int:
+    """Static shift bound for :func:`resample_select`: ceil of
+    max|af|*N^2/4 plus one guard sample, or 0 when the span exceeds
+    ``limit`` (caller should use the gather path instead)."""
+    smax = int(np.ceil(af_max * (n / 2.0) ** 2)) + 1
+    return smax if smax <= limit else 0
+
+
 @jax.jit
 def resample_accel_quadratic(x: jnp.ndarray, af: jnp.ndarray) -> jnp.ndarray:
     """The folder's variant: out[i] = x[i + rint(af*((i-N/2)^2-(N/2)^2))]

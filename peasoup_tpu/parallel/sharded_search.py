@@ -24,7 +24,11 @@ from ..pipeline.accel_search import AccelSearchPeaks, search_block_core
 
 @lru_cache(maxsize=None)
 def make_sharded_search_fn(
-    mesh: Mesh, threshold: float, axis: str = "dm", pallas_block: int = 0
+    mesh: Mesh,
+    threshold: float,
+    axis: str = "dm",
+    pallas_block: int = 0,
+    select_smax: int = 0,
 ):
     """Jitted (D, ...) -> (D, ...) search with D sharded over ``axis``.
 
@@ -59,7 +63,7 @@ def make_sharded_search_fn(
                 tims_l, afs_l, zap_l, win_l,
                 threshold=threshold, size=size, nsamps_valid=nsamps_valid,
                 nharms=nharms, max_peaks=max_peaks, pos5=pos5, pos25=pos25,
-                pallas_block=pallas_block,
+                pallas_block=pallas_block, select_smax=select_smax,
             )
 
         return jax.shard_map(
@@ -67,7 +71,7 @@ def make_sharded_search_fn(
             mesh=mesh,
             in_specs=(P(axis), P(axis), P(), P()),
             out_specs=AccelSearchPeaks(
-                idxs=P(axis), snrs=P(axis), counts=P(axis)
+                idxs=P(axis), snrs=P(axis), counts=P(axis), ccounts=P(axis)
             ),
         )(tims, afs, zapmask, windows)
 

@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.harmonics import harmonic_sums
-from ..ops.peaks import find_peaks_device
+from ..ops.peaks import cluster_peaks_device, find_peaks_device
 from ..ops.rednoise import whiten_fseries
 from ..ops.resample import resample_accel
 from ..ops.spectrum import form_interpolated, normalise, spectrum_stats
@@ -36,12 +36,18 @@ class AccelSearchPeaks(NamedTuple):
     """Static-size peak sets for one DM trial.
 
     idxs/snrs: (nharms+1, A, max_peaks) — level 0 is the fundamental
-    spectrum, level h the 2^h-harmonic sum. counts: (nharms+1, A).
+    spectrum, level h the 2^h-harmonic sum. counts: (nharms+1, A) raw
+    threshold crossings (the overflow-escalation signal). With
+    on-device clustering (``cluster=True``, the default) idxs/snrs hold
+    the min-gap CLUSTER peaks (identify_unique_peaks semantics) and
+    ccounts their per-cell count; without it ccounts == counts and
+    idxs/snrs are the raw crossings.
     """
 
     idxs: jax.Array
     snrs: jax.Array
     counts: jax.Array
+    ccounts: jax.Array
 
 
 def _preprocess_trial(tim, zapmask, *, size, nsamps_valid, pos5, pos25):
@@ -64,18 +70,23 @@ def _preprocess_trial(tim, zapmask, *, size, nsamps_valid, pos5, pos25):
 
 
 def _spectra_and_peaks(
-    xr, mean, std, windows, *, threshold, nharms, max_peaks, stack_axis
+    xr, mean, std, windows, *, threshold, nharms, max_peaks, stack_axis,
+    cluster=True,
 ):
     """Post-resample stage: batched rfft, interbin, normalise, harmonic
-    sums, per-level peak compaction (pipeline_multi.cu:216-234).
-    ``xr`` is (..., A, size); mean/std broadcast against (..., A)."""
+    sums, per-level peak compaction (pipeline_multi.cu:216-234), and —
+    with ``cluster`` — the min-gap peak clustering the reference runs
+    on the host (peakfinder.hpp:27-56), kept on device so only cluster
+    peaks ever cross the host link. ``xr`` is (..., A, size); mean/std
+    broadcast against (..., A)."""
     fr = jnp.fft.rfft(xr, axis=-1)
     s = form_interpolated(fr)
     s = normalise(s, mean, std)
     sums = harmonic_sums(s, nharms=nharms)
     levels = [s] + sums
+    nbins = s.shape[-1]
 
-    idxs, snrs, counts = [], [], []
+    idxs, snrs, counts, ccounts = [], [], [], []
     for lvl, spec in enumerate(levels):
         i_, s_, c_ = find_peaks_device(
             spec,
@@ -84,13 +95,19 @@ def _spectra_and_peaks(
             windows[lvl, 1],
             max_peaks=max_peaks,
         )
+        if cluster:
+            i_, s_, cc_ = cluster_peaks_device(i_, s_, jnp.int32(nbins))
+        else:
+            cc_ = c_
         idxs.append(i_)
         snrs.append(s_)
         counts.append(c_)
+        ccounts.append(cc_)
     return AccelSearchPeaks(
         idxs=jnp.stack(idxs, axis=stack_axis),
         snrs=jnp.stack(snrs, axis=stack_axis),
         counts=jnp.stack(counts, axis=stack_axis),
+        ccounts=jnp.stack(ccounts, axis=stack_axis),
     )
 
 
@@ -107,6 +124,7 @@ def search_trial_core(
     max_peaks: int,
     pos5: int,
     pos25: int,
+    cluster: bool = True,
 ) -> AccelSearchPeaks:
     """Pure search body for one DM trial; vmap/shard_map-compatible."""
     xd, mean, std = _preprocess_trial(
@@ -117,7 +135,7 @@ def search_trial_core(
     return _spectra_and_peaks(
         xr, mean[None], std[None], windows,
         threshold=threshold, nharms=nharms, max_peaks=max_peaks,
-        stack_axis=0,
+        stack_axis=0, cluster=cluster,
     )
 
 
@@ -130,14 +148,16 @@ def make_search_fn(threshold: float):
     @partial(
         jax.jit,
         static_argnames=("size", "nsamps_valid", "nharms", "max_peaks", "pos5",
-                         "pos25"),
+                         "pos25", "cluster"),
     )
     def search_dm_trial(tim, afs, zapmask, windows, *, size, nsamps_valid,
-                        nharms, max_peaks, pos5, pos25) -> AccelSearchPeaks:
+                        nharms, max_peaks, pos5, pos25,
+                        cluster=True) -> AccelSearchPeaks:
         return search_trial_core(
             tim, afs, zapmask, windows,
             threshold=threshold, size=size, nsamps_valid=nsamps_valid,
             nharms=nharms, max_peaks=max_peaks, pos5=pos5, pos25=pos25,
+            cluster=cluster,
         )
 
     return search_dm_trial
@@ -158,12 +178,16 @@ def search_block_core(
     pos25: int,
     pallas_block: int = 0,
     pallas_interpret: bool = False,
+    select_smax: int = 0,
+    cluster: bool = True,
 ) -> AccelSearchPeaks:
     """Block-batched search: all per-DM preprocessing vmapped, then the
     (D, A) accel grid processed as single batched array programs. With
     ``pallas_block`` > 0 the resampling gather runs as the Pallas
-    windowed-select kernel (ops/pallas/resample.py); otherwise the jnp
-    gather twin. Results are bitwise identical either way.
+    windowed-select kernel (ops/pallas/resample.py); with
+    ``select_smax`` > 0 as the gather-free jnp select
+    (ops/resample.py:resample_select); otherwise the jnp gather twin.
+    Results are bitwise identical in all three modes.
     """
     xd, mean, std = jax.vmap(
         lambda tim: _preprocess_trial(
@@ -178,6 +202,10 @@ def search_block_core(
         xr = resample_block_pallas(
             xd, afs, block=pallas_block, interpret=pallas_interpret
         )
+    elif select_smax > 0:
+        from ..ops.resample import resample_select
+
+        xr = resample_select(xd, afs, smax=select_smax)  # (D, A, size)
     else:
         xr = jax.vmap(resample_accel)(xd, afs)  # (D, A, size)
 
@@ -186,12 +214,14 @@ def search_block_core(
     return _spectra_and_peaks(
         xr, mean[:, None], std[:, None], windows,
         threshold=threshold, nharms=nharms, max_peaks=max_peaks,
-        stack_axis=1,
+        stack_axis=1, cluster=cluster,
     )
 
 
 @lru_cache(maxsize=None)
-def make_batched_search_fn(threshold: float, pallas_block: int = 0):
+def make_batched_search_fn(
+    threshold: float, pallas_block: int = 0, select_smax: int = 0
+):
     """Jitted (D, ...) -> (D, ...) search over a block of DM trials.
 
     A fixed (dm_block, accel_bucket) tile shape is the unit of device
@@ -203,15 +233,17 @@ def make_batched_search_fn(threshold: float, pallas_block: int = 0):
     @partial(
         jax.jit,
         static_argnames=("size", "nsamps_valid", "nharms", "max_peaks", "pos5",
-                         "pos25"),
+                         "pos25", "cluster"),
     )
     def search_dm_block(tims, afs, zapmask, windows, *, size, nsamps_valid,
-                        nharms, max_peaks, pos5, pos25) -> AccelSearchPeaks:
+                        nharms, max_peaks, pos5, pos25,
+                        cluster=True) -> AccelSearchPeaks:
         return search_block_core(
             tims, afs, zapmask, windows,
             threshold=threshold, size=size, nsamps_valid=nsamps_valid,
             nharms=nharms, max_peaks=max_peaks, pos5=pos5, pos25=pos25,
-            pallas_block=pallas_block,
+            pallas_block=pallas_block, select_smax=select_smax,
+            cluster=cluster,
         )
 
     return search_dm_block

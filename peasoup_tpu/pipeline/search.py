@@ -21,9 +21,8 @@ import numpy as np
 from ..core.candidates import Candidate, CandidateCollection
 from ..io.masks import read_killfile, read_zapfile
 from ..io.sigproc import Filterbank
-from ..ops.dedisperse import dedisperse, output_scale
-from ..ops.peaks import cluster_peaks
-from ..ops.resample import accel_factor
+from ..ops.dedisperse import dedisperse, dedisperse_device, output_scale
+from ..ops.resample import accel_factor, select_span
 from ..ops.zap import birdie_mask
 from ..plan.accel_plan import AccelerationPlan
 from ..plan.dm_plan import DMPlan
@@ -70,7 +69,7 @@ class SearchConfig:
     max_peaks: int = 512  # static peak-compaction size per spectrum
     dedisp_block: int = 16  # DM trials per dedispersion launch
     accel_bucket: int = 16  # accel batch padded to a multiple of this
-    dm_block: int = 8  # DM trials searched per device call (per chip)
+    dm_block: int = 0  # DM trials per device call; 0 = auto from HBM budget
     checkpoint_file: str = ""  # resumable per-DM-trial result store
     use_pallas: bool = True  # Pallas resample kernel on TPU backends
     # device sharding: 0 = auto (all local TPU chips up to
@@ -118,9 +117,18 @@ def _freq_factor(size: int, nh: int, tsamp: float) -> float:
 
 
 class PeasoupSearch:
+    # HBM accounting for auto dm_block sizing: total usable chip memory,
+    # the spectra working-set budget carved from it (after the
+    # device-resident trials), the cap on live peak-output buffers
+    # queued per dispatch wave, and the trials size beyond which the
+    # trial block spills to host RAM instead of living in HBM
+    TOTAL_HBM = 12_000_000_000
+    MEM_BUDGET = 6_000_000_000
+    WAVE_BUDGET = 1_000_000_000
+    TRIALS_DEVICE_LIMIT = 4_000_000_000
+
     def __init__(self, config: SearchConfig):
         self.config = config
-        self._eff_dm_block = config.dm_block
         self._dm_sharding = None
 
     def _pick_devices(self) -> list:
@@ -160,8 +168,15 @@ class PeasoupSearch:
             killmask=killmask,
         )
         t0 = time.time()
+        # trials live on device (sliced there per chunk, no re-uploads)
+        # unless the whole block would crowd out the search working set
+        # — huge surveys spill to host RAM like the reference
+        # (dedisperser.hpp:101-103) and pay a per-chunk upload instead
+        trials_bytes = dm_plan.ndm * dm_plan.out_nsamps
+        spill = trials_bytes > self.TRIALS_DEVICE_LIMIT
         with trace_span("Dedisperse"):  # NVTX parity: pipeline_multi.cu:318
-            trials = dedisperse(
+            dd = dedisperse if spill else dedisperse_device
+            trials = dd(
                 fil.data,
                 dm_plan.delay_samples(),
                 dm_plan.killmask,
@@ -169,6 +184,9 @@ class PeasoupSearch:
                 scale=output_scale(fil.nbits, int(dm_plan.killmask.sum())),
                 block=cfg.dedisp_block,
             )
+            if not spill:
+                # tiny sync so the phase timer means what it says
+                np.asarray(trials[-1, -1])
         timers["dedispersion"] = time.time() - t0
 
         # --- search setup ---------------------------------------------------
@@ -214,6 +232,12 @@ class PeasoupSearch:
         # tile shape, vmapped over the block (vs the reference's per-trial
         # kernel launches). The search itself is device work; candidate
         # clustering/distilling below is tiny host work per trial.
+        #
+        # Host<->device protocol (the chip may sit behind a high-latency
+        # link, so transfers are the enemy): trials stay device-resident,
+        # every chunk of a wave is DISPATCHED asynchronously, then the
+        # wave's counts come back in ONE packed D2H, and the peak arrays
+        # in ONE more, trimmed to the observed per-chunk maximum count.
         t0 = time.time()
         accel_lists = [
             acc_plan.generate_accel_list(float(dm)) for dm in dm_plan.dm_list
@@ -224,22 +248,25 @@ class PeasoupSearch:
             padded = int(math.ceil(len(accs) / bucket) * bucket)
             by_bucket.setdefault(padded, []).append(dm_idx)
 
+        af_max = max(
+            (float(np.abs(accel_factor(a, fil.tsamp)).max())
+             for a in accel_lists if len(a)),
+            default=0.0,
+        )
         pallas_block = 0
         if cfg.use_pallas:
             from ..ops.pallas import probe_pallas_resample
             from ..ops.pallas.resample import choose_block
 
-            af_max = max(
-                (float(np.abs(accel_factor(a, fil.tsamp)).max())
-                 for a in accel_lists if len(a)),
-                default=0.0,
-            )
             pallas_block = choose_block(af_max, size)
-            # real compile+run probe at the production shape: degrade
-            # to the jnp twin instead of crashing on Mosaic toolchains
-            # that reject this kernel
+            # real compile+run probe, oracle-checked: degrade to the
+            # jnp twin instead of crashing (or silently corrupting) on
+            # Mosaic toolchains that mis-handle this kernel
             if pallas_block and not probe_pallas_resample(size, pallas_block):
                 pallas_block = 0
+        # gather-free select resample whenever the shift span is small
+        # (used when Pallas is off or fails at the production shape)
+        select_smax = select_span(af_max, size)
 
         # --- device selection: shard DM trials over local chips --------
         # (the reference's analogue: one worker per GPU up to -t,
@@ -252,17 +279,24 @@ class PeasoupSearch:
             from jax.sharding import NamedSharding, PartitionSpec
 
             mesh = make_mesh({"dm": len(devices)}, devices=devices)
-            search_block = make_sharded_search_fn(
-                mesh, cfg.min_snr, axis="dm", pallas_block=pallas_block
-            )
-            # per-call block covers dm_block trials per chip; stage
-            # blocks directly onto the mesh (no hop through chip 0)
+
+            def build_search(pb: int):
+                return make_sharded_search_fn(
+                    mesh, cfg.min_snr, axis="dm", pallas_block=pb,
+                    select_smax=select_smax if pb == 0 else 0,
+                )
+
+            # stage blocks directly onto the mesh (no hop through chip 0)
             self._dm_sharding = NamedSharding(mesh, PartitionSpec("dm"))
-            self._eff_dm_block = cfg.dm_block * len(devices)
         else:
-            search_block = make_batched_search_fn(cfg.min_snr, pallas_block)
+
+            def build_search(pb: int):
+                return make_batched_search_fn(
+                    cfg.min_snr, pb, select_smax if pb == 0 else 0
+                )
+
             self._dm_sharding = None
-            self._eff_dm_block = cfg.dm_block
+        search_block = build_search(pallas_block)
         tim_len = min(size, trials.shape[1])
 
         ckpt = None
@@ -279,57 +313,117 @@ class PeasoupSearch:
                     f"trials restored from {cfg.checkpoint_file}"
                 )
 
-        chunks = [
-            dm_indices[start : start + self._eff_dm_block]
-            for padded, dm_indices in sorted(by_bucket.items())
-            for start in range(0, len(dm_indices), self._eff_dm_block)
-        ]
+        # chunk sizing: a PER-CHIP block of d_local trials, auto-sized
+        # from a working-set budget of ~12 spectrum-sized f32 arrays per
+        # (dm, accel) cell. The device call covers d_local * n_dev
+        # trials; keeping the per-chip shape independent of the device
+        # count makes sharded and single-device results bitwise
+        # identical (same XLA program per chip), mirroring the
+        # reference's share-nothing per-GPU workers.
+        size_spec_b = (size // 2 + 1) * 4
+        # spectra budget: what's left of HBM after the device-resident
+        # trials and the queued wave outputs
+        mem_budget = min(
+            self.MEM_BUDGET,
+            self.TOTAL_HBM
+            - (0 if spill else trials_bytes)
+            - self.WAVE_BUDGET,
+        )
+        mem_budget = max(mem_budget, 500_000_000)
+        chunks: list[tuple[list[int], int]] = []  # (dm indices, dm_block)
+        for padded, dm_indices in sorted(by_bucket.items()):
+            if cfg.dm_block > 0:
+                d_local = cfg.dm_block
+            else:
+                cells = max(8, int(mem_budget / (size_spec_b * 12)))
+                d_local = max(1, min(128, cells // max(1, padded)))
+            d_blk = d_local * len(devices)
+            chunks.extend(
+                (dm_indices[s : s + d_blk], d_blk)
+                for s in range(0, len(dm_indices), d_blk)
+            )
+
+        # wave sizing: bound the live device output buffers (and give the
+        # checkpoint a save point per wave)
+        def chunk_out_bytes(chunk):
+            dm_indices, d_blk = chunk
+            padded = int(
+                math.ceil(len(accel_lists[dm_indices[0]]) / bucket) * bucket
+            )
+            return d_blk * (cfg.nharmonics + 1) * padded * cfg.max_peaks * 8
+
+        waves: list[list[tuple[list[int], int]]] = []
+        wave: list[tuple[list[int], int]] = []
+        wave_bytes = 0
+        for chunk in chunks:
+            if wave and wave_bytes + chunk_out_bytes(chunk) > self.WAVE_BUDGET:
+                waves.append(wave)
+                wave, wave_bytes = [], 0
+            wave.append(chunk)
+            wave_bytes += chunk_out_bytes(chunk)
+        if wave:
+            waves.append(wave)
+
         progress = ProgressBar() if cfg.progress_bar else None
         if progress:
             progress.start()
-        last_ckpt = time.time()
-        dirty = False
-        for n_chunk, chunk in enumerate(chunks):
-            if all(d in per_dm_results for d in chunk):
-                continue  # restored from checkpoint
-            with trace_span("DM-Loop"):  # NVTX parity: pipeline_multi.cu:144
-                self._search_chunk(
-                    chunk, accel_lists, trials, tim_len, zapmask_dev,
-                    windows, search_block, per_dm_results,
-                    size=size, nsamps_valid=nsamps_valid,
-                    pos5=pos5, pos25=pos25, tsamp=fil.tsamp,
-                )
-            dirty = True
-            # rate-limit full-rewrite saves: a crash loses at most ~10 s
-            # of device work instead of paying O(n^2) rewrite I/O
-            if ckpt is not None and time.time() - last_ckpt > 10.0:
-                ckpt.save(per_dm_results)
-                last_ckpt = time.time()
-                dirty = False
+        n_done = 0
+        for wave in waves:
+            todo = [
+                c for c in wave
+                if not all(d in per_dm_results for d in c[0])
+            ]
+            if todo:
+                with trace_span("DM-Loop"):  # NVTX parity: pipeline_multi.cu:144
+                    try:
+                        self._search_wave(
+                            todo, accel_lists, trials, tim_len, zapmask_dev,
+                            windows, search_block, per_dm_results,
+                            size=size, nsamps_valid=nsamps_valid,
+                            pos5=pos5, pos25=pos25, tsamp=fil.tsamp,
+                        )
+                    except Exception:
+                        # the oracle probe runs at a reduced shape; if
+                        # the Pallas kernel still fails at the full
+                        # production shape (e.g. SMEM accel-table
+                        # pressure), fall back to the jnp resample and
+                        # redo the wave rather than crash the search
+                        if pallas_block == 0:
+                            raise
+                        pallas_block = 0
+                        search_block = build_search(0)
+                        self._search_wave(
+                            todo, accel_lists, trials, tim_len, zapmask_dev,
+                            windows, search_block, per_dm_results,
+                            size=size, nsamps_valid=nsamps_valid,
+                            pos5=pos5, pos25=pos25, tsamp=fil.tsamp,
+                        )
+                if ckpt is not None:
+                    ckpt.save(per_dm_results)
+            n_done += len(wave)
             if progress:
-                progress.update((n_chunk + 1) / len(chunks))
-        if ckpt is not None and dirty:
-            ckpt.save(per_dm_results)
+                progress.update(n_done / len(chunks))
         if progress:
             progress.stop()
         timers["search_device"] = time.time() - t0
 
         # --- host candidate bookkeeping (ascending DM order) ----------------
+        # idxs/snrs arrive ALREADY clustered (identify_unique_peaks ran
+        # on device); the host only builds candidates and distils.
         t_host = time.time()
         dm_trial_cands = CandidateCollection()
         for dm_idx, dm in enumerate(dm_plan.dm_list):
-            idxs, snrs, counts = per_dm_results.pop(dm_idx)
+            idxs, snrs, ccounts = per_dm_results.pop(dm_idx)
             accs = accel_lists[dm_idx]
             accel_trial_cands = CandidateCollection()
             for a_idx in range(len(accs)):
                 acc = float(accs[a_idx])
                 trial_cands: list[Candidate] = []
                 for lvl in range(cfg.nharmonics + 1):
-                    n_found = int(counts[lvl, a_idx])
-                    pk_idx, pk_snr = cluster_peaks(
-                        idxs[lvl, a_idx], snrs[lvl, a_idx], n_found
-                    )
-                    for b, s in zip(pk_idx, pk_snr):
+                    n_found = int(ccounts[lvl, a_idx])
+                    for b, s in zip(
+                        idxs[lvl, a_idx, :n_found], snrs[lvl, a_idx, :n_found]
+                    ):
                         trial_cands.append(
                             Candidate(
                                 dm=float(dm),
@@ -385,23 +479,23 @@ class PeasoupSearch:
             n_accel_trials=sum(len(a) for a in accel_lists),
         )
 
-    def _search_chunk(
+    def _dispatch_chunk(
         self, chunk, accel_lists, trials, tim_len, zapmask_dev, windows,
-        search_block, per_dm_results, *, size, nsamps_valid, pos5, pos25,
-        tsamp,
-    ) -> None:
-        """Run one (dm_block, accel_bucket) device tile and bank the
-        static-size peak sets for every real trial in the chunk."""
+        search_block, max_peaks, *, size, nsamps_valid, pos5, pos25, tsamp,
+    ):
+        """Asynchronously launch one (dm_block, accel_bucket) device
+        tile; returns (device peaks, padded accel count)."""
         cfg = self.config
-        dm_block = self._eff_dm_block
-        real = len(chunk)
         bucket = cfg.accel_bucket
+        dm_indices, dm_block = chunk
+        real = len(dm_indices)
         padded = max(
             int(math.ceil(len(accel_lists[d]) / bucket) * bucket)
-            for d in chunk
+            for d in dm_indices
         )
-        # pad the block by repeating the first trial (discarded)
-        block_idx = chunk + [chunk[0]] * (dm_block - real)
+        # pad the block to its fixed shape by repeating the first trial
+        # (discarded): one compile per (dm_block, padded) tile shape
+        block_idx = dm_indices + [dm_indices[0]] * (dm_block - real)
         afs = np.zeros((dm_block, padded), dtype=np.float32)
         for row, dm_idx in enumerate(block_idx):
             accs = accel_lists[dm_idx]
@@ -410,44 +504,135 @@ class PeasoupSearch:
             )
         import jax
 
+        if isinstance(trials, np.ndarray):
+            # spilled trials: slice on host, upload the chunk
+            rows = jnp.asarray(trials[block_idx, :tim_len])
+        else:
+            # trial rows are sliced ON DEVICE (trials never left the chip)
+            rows = jnp.take(
+                trials,
+                jnp.asarray(np.asarray(block_idx, dtype=np.int32)),
+                axis=0,
+            )[:, :tim_len]
         if self._dm_sharding is not None:
-            tims_dev = jax.device_put(
-                trials[block_idx, :tim_len], self._dm_sharding
-            )
+            tims_dev = jax.device_put(rows, self._dm_sharding)
             afs_dev = jax.device_put(afs, self._dm_sharding)
         else:
-            tims_dev = jnp.asarray(trials[block_idx, :tim_len])
+            tims_dev = rows
             afs_dev = jnp.asarray(afs)
-        max_peaks = cfg.max_peaks
-        while True:
-            peaks = search_block(
-                tims_dev,
-                afs_dev,
-                zapmask_dev,
-                windows,
-                size=size,
-                nsamps_valid=nsamps_valid,
-                nharms=cfg.nharmonics,
-                max_peaks=max_peaks,
-                pos5=pos5,
-                pos25=pos25,
+        peaks = search_block(
+            tims_dev,
+            afs_dev,
+            zapmask_dev,
+            windows,
+            size=size,
+            nsamps_valid=nsamps_valid,
+            nharms=cfg.nharmonics,
+            max_peaks=max_peaks,
+            pos5=pos5,
+            pos25=pos25,
+        )
+        return peaks, padded
+
+    def _search_wave(
+        self, wave, accel_lists, trials, tim_len, zapmask_dev, windows,
+        search_block, per_dm_results, *, size, nsamps_valid, pos5, pos25,
+        tsamp,
+    ) -> None:
+        """Dispatch every chunk of the wave, then fetch results with two
+        packed D2H transfers (counts, then count-trimmed peaks)."""
+        cfg = self.config
+        nlev = cfg.nharmonics + 1
+        disp = dict(
+            size=size, nsamps_valid=nsamps_valid, pos5=pos5, pos25=pos25,
+            tsamp=tsamp,
+        )
+        args = (accel_lists, trials, tim_len, zapmask_dev, windows,
+                search_block)
+
+        pend = []
+        for chunk in wave:
+            peaks, padded = self._dispatch_chunk(
+                chunk, *args, cfg.max_peaks, **disp
             )
-            counts = np.asarray(peaks.counts)
-            if counts.max() <= max_peaks:
-                break
-            # overflow: escalate the static compaction size so no
-            # threshold crossing is lost (the reference sizes for
-            # 100000, peakfinder.hpp:61); costs one extra compile
-            # only on pathological blocks
-            max_peaks = 1 << int(np.ceil(np.log2(counts.max())))
-        idxs = np.asarray(peaks.idxs)  # (B, L, A, maxp)
-        snrs = np.asarray(peaks.snrs)
-        for row in range(real):
-            # trim to this trial's own maximum count: bounds host
-            # memory and detaches the padded block buffers
-            mx = max(int(counts[row].max()), 1)
-            per_dm_results[chunk[row]] = (
-                idxs[row][:, :, :mx].copy(),
-                snrs[row][:, :, :mx].copy(),
-                counts[row].copy(),
+            pend.append([chunk, cfg.max_peaks, peaks, padded])
+
+        # ONE packed counts transfer (raw crossing counts for overflow
+        # detection + cluster counts for fetch trimming) for the whole
+        # wave; chunks whose static compaction overflowed are
+        # re-dispatched with the next power-of-two size (the reference
+        # sizes for 100000 up front, peakfinder.hpp:61) -- rare, and
+        # only they pay extra round trips
+        counts_flat = np.asarray(
+            jnp.concatenate(
+                [p.counts.reshape(-1) for _, _, p, _ in pend]
+                + [p.ccounts.reshape(-1) for _, _, p, _ in pend]
             )
+        )
+        half = counts_flat.size // 2
+        counts_list = []
+        ccounts_list = []
+        off = 0
+        for entry in pend:
+            chunk, max_peaks, peaks, padded = entry
+            n = peaks.counts.shape[0] * nlev * padded
+            counts = counts_flat[off : off + n].reshape(-1, nlev, padded)
+            ccounts = counts_flat[half + off : half + off + n].reshape(
+                -1, nlev, padded
+            )
+            off += n
+            while counts.max() > max_peaks:
+                max_peaks = 1 << int(np.ceil(np.log2(counts.max())))
+                peaks, padded = self._dispatch_chunk(
+                    chunk, *args, max_peaks, **disp
+                )
+                counts = np.asarray(peaks.counts)
+                ccounts = np.asarray(peaks.ccounts)
+                entry[1:] = [max_peaks, peaks, padded]
+            counts_list.append(counts)
+            ccounts_list.append(ccounts)
+
+        # ONE packed peak transfer: per chunk, slice idxs/snrs down to
+        # the observed maximum CLUSTER count (pow2-rounded to bound
+        # recompiles) and bitcast-pack both into a single i32 stream
+        from jax import lax
+
+        mxs, pieces = [], []
+        for (chunk, max_peaks, peaks, padded), ccounts in zip(
+            pend, ccounts_list
+        ):
+            mx = 1 << max(0, int(np.ceil(np.log2(max(1, ccounts.max())))))
+            mx = min(mx, max_peaks)
+            mxs.append(mx)
+            pieces.append(
+                jnp.concatenate(
+                    [
+                        peaks.idxs[..., :mx],
+                        lax.bitcast_convert_type(
+                            peaks.snrs[..., :mx], jnp.int32
+                        ),
+                    ],
+                    axis=-1,
+                ).reshape(-1)
+            )
+        packed = np.asarray(
+            pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+        )
+
+        off = 0
+        for (chunk, _, peaks, padded), ccounts, mx in zip(
+            pend, ccounts_list, mxs
+        ):
+            d = peaks.counts.shape[0]
+            n = d * nlev * padded * 2 * mx
+            block = packed[off : off + n].reshape(d, nlev, padded, 2 * mx)
+            off += n
+            idxs = block[..., :mx]
+            snrs = block[..., mx:].view(np.float32)
+            dm_indices = chunk[0]
+            for row in range(len(dm_indices)):
+                per_dm_results[dm_indices[row]] = (
+                    idxs[row],
+                    snrs[row],
+                    ccounts[row],
+                )

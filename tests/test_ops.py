@@ -285,6 +285,47 @@ class TestPeaks:
         assert idxs.shape == (4, 5, 64)
         assert count.shape == (4, 5)
 
+    def test_device_cluster_matches_host_fuzz(self, rng):
+        """cluster_peaks_device is an exact on-device port of the host
+        identify_unique_peaks walk (quirk included)."""
+        from peasoup_tpu.ops.peaks import cluster_peaks_device
+
+        nbins = 1500
+        for _ in range(60):
+            spec = np.abs(rng.normal(1, 1.0, size=nbins)).astype(np.float32)
+            for _ in range(rng.integers(0, 6)):
+                c = int(rng.integers(0, nbins))
+                w = int(rng.integers(1, 70))
+                spec[max(0, c - w // 2): c + w // 2] += rng.uniform(3, 9)
+            idxs, snrs, count = find_peaks_device(
+                jnp.asarray(spec), 4.0, 0, nbins, max_peaks=256
+            )
+            idxs, snrs, count = np.asarray(idxs), np.asarray(snrs), int(count)
+            if count > 256:
+                continue
+            hi, hs = cluster_peaks(idxs, snrs, count)
+            ci, cs, cc = cluster_peaks_device(
+                jnp.asarray(idxs), jnp.asarray(snrs), jnp.int32(nbins)
+            )
+            cc = int(cc)
+            assert cc == len(hi)
+            np.testing.assert_array_equal(np.asarray(ci)[:cc], hi)
+            np.testing.assert_allclose(np.asarray(cs)[:cc], hs)
+            assert np.all(np.asarray(ci)[cc:] == nbins)
+
+    def test_device_cluster_full_slots(self):
+        """A completely full slot axis (no padding) still flushes the
+        final cluster via the appended sentinel step."""
+        from peasoup_tpu.ops.peaks import cluster_peaks_device
+
+        idxs = np.arange(0, 400, 100, dtype=np.int32)  # 4 slots, all real
+        snrs = np.array([5.0, 6.0, 7.0, 8.0], dtype=np.float32)
+        ci, cs, cc = cluster_peaks_device(
+            jnp.asarray(idxs), jnp.asarray(snrs), jnp.int32(1000)
+        )
+        assert int(cc) == 4
+        np.testing.assert_array_equal(np.asarray(ci), idxs)
+
 
 class TestDedisperse:
     def test_realigns_dispersed_impulse(self):
