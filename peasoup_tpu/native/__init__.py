@@ -51,6 +51,12 @@ def _load() -> Optional[ctypes.CDLL]:
     ]
     lib.ps_harmonic_distill.restype = ctypes.c_int64
 
+    lib.ps_harmonic_distill_seg.argtypes = [
+        _f64p, _i32p, _i64p, ctypes.c_int64, ctypes.c_double, ctypes.c_int32,
+        ctypes.c_int32, _i8p,
+    ]
+    lib.ps_harmonic_distill_seg.restype = None
+
     lib.ps_accel_distill.argtypes = [
         _f64p, _f64p, ctypes.c_int64, ctypes.c_double, ctypes.c_double,
         ctypes.c_int32, _i8p, _i32p, _i32p, ctypes.c_int64,
@@ -123,6 +129,26 @@ def harmonic_distill(freqs, nhs, tol, max_harm, fractional, keep_related):
         ),
         n,
     )
+
+
+def harmonic_distill_seg(
+    freqs, nhs, seg_off, tol, max_harm, fractional
+) -> Optional[np.ndarray]:
+    """Distill every segment (= accel trial) in one native call. Rows
+    must be pre-sorted by S/N descending within each segment; returns
+    the survivor mask in row order, or None without the library."""
+    lib = _load()
+    if lib is None:
+        return None
+    freqs = np.ascontiguousarray(freqs, dtype=np.float64)
+    nhs = np.ascontiguousarray(nhs, dtype=np.int32)
+    seg_off = np.ascontiguousarray(seg_off, dtype=np.int64)
+    unique = np.empty(len(freqs), np.uint8)
+    lib.ps_harmonic_distill_seg(
+        freqs, nhs, seg_off, len(seg_off) - 1, tol, max_harm,
+        int(fractional), unique,
+    )
+    return unique.astype(bool)
 
 
 def accel_distill(freqs, accs, tobs_over_c, tol, keep_related):

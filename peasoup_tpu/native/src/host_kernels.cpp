@@ -135,6 +135,45 @@ int64_t ps_harmonic_distill(const double* freqs, const int32_t* nhs, int64_t n,
   return edges.n;
 }
 
+// Segmented variant: one call distills EVERY accel trial of a run
+// (segment s = rows [seg_off[s], seg_off[s+1])), replacing one
+// ctypes round trip per trial. Rows arrive pre-sorted by S/N
+// descending within each segment; unique flags are written in that
+// same row order. keep_related is always false on this path (the
+// per-accel-trial distill discards non-survivors,
+// src/pipeline_multi.cu:238).
+void ps_harmonic_distill_seg(const double* freqs, const int32_t* nhs,
+                             const int64_t* seg_off, int64_t nseg, double tol,
+                             int32_t max_harm, int32_t fractional,
+                             uint8_t* unique) {
+  const double lo = 1.0 - tol, hi = 1.0 + tol;
+  for (int64_t s = 0; s < nseg; ++s) {
+    const int64_t b = seg_off[s], e = seg_off[s + 1];
+    std::fill(unique + b, unique + e, uint8_t{1});
+    for (int64_t idx = b; idx < e; ++idx) {
+      if (!unique[idx]) continue;
+      const double fundi = freqs[idx];
+      for (int64_t jjt = idx + 1; jjt < e; ++jjt) {
+        if (!unique[jjt]) continue;
+        const double freq = freqs[jjt];
+        const int32_t max_denom =
+            fractional ? (int32_t{1} << nhs[jjt]) : int32_t{1};
+        bool hit = false;
+        for (int32_t jj = 1; jj <= max_harm && !hit; ++jj) {
+          for (int32_t kk = 1; kk <= max_denom; ++kk) {
+            const double ratio = kk * freq / (jj * fundi);
+            if (ratio > lo && ratio < hi) {
+              hit = true;
+              break;
+            }
+          }
+        }
+        if (hit) unique[jjt] = 0;
+      }
+    }
+  }
+}
+
 int64_t ps_accel_distill(const double* freqs, const double* accs, int64_t n,
                          double tobs_over_c, double tol, int32_t keep_related,
                          uint8_t* unique, int32_t* edge_src, int32_t* edge_dst,

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 
-@partial(jax.jit, static_argnames=("max_peaks",))
+@partial(jax.jit, static_argnames=("max_peaks", "block"))
 def find_peaks_device(
     spec: jnp.ndarray,  # (..., nbins) normalised spectrum or harmonic sum
     threshold: jnp.ndarray,
@@ -35,28 +35,61 @@ def find_peaks_device(
     limit: jnp.ndarray,  # scalar or (...,) one-past-last bin
     *,
     max_peaks: int = 4096,
+    block: int = 64,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Compact threshold crossings to fixed-size (idx, snr) arrays.
 
     Returns (indices (..., max_peaks) i32 ascending and padded with
     nbins, snrs (..., max_peaks) f32, count (...,) i32). ``count`` may
     exceed ``max_peaks``; callers should treat that as overflow.
+
+    TPU cost note: lax.top_k lowers to a full per-lane sort whose cost
+    is independent of k, so a single top_k over the whole spectrum pays
+    an O(nbins log nbins) sort per lane. Crossings are sparse, so the
+    compaction runs in two stages: (1) find the first ``max_peaks``
+    length-``block`` blocks that contain a crossing (top_k over
+    nbins/block block keys), (2) gather those blocks and top_k over the
+    ``max_peaks * block`` surviving bins. Identical output to the
+    single-stage form in all cases: if count <= max_peaks the crossing
+    blocks number <= max_peaks and are all selected; if count >
+    max_peaks the first max_peaks crossings live in the first
+    max_peaks crossing-blocks, and ``count`` flags the overflow either
+    way (the driver re-dispatches with a larger size).
     """
     nbins = spec.shape[-1]
     i = jnp.arange(nbins, dtype=jnp.int32)
 
     k = min(max_peaks, nbins)
+    nblk = -(-nbins // block)
+    kb = min(max_peaks, nblk)
+    two_stage = kb * block < nbins  # else the gather buys nothing
 
     def one(s, thr, lo, hi):
         mask = (i >= lo) & (i < hi) & (s > thr)
         count = mask.sum().astype(jnp.int32)
-        # top_k over -index: picks the first k crossings, in ascending
-        # index order (descending key order)
-        key = jnp.where(mask, -i, jnp.int32(-nbins - 1))
-        kv, ki = jax.lax.top_k(key, k)
-        valid = kv > -nbins - 1
-        idxs = jnp.where(valid, ki, nbins).astype(jnp.int32)
-        snrs = jnp.where(valid, s[jnp.clip(ki, 0, nbins - 1)], 0.0)
+        if two_stage:
+            pad = nblk * block - nbins
+            maskp = jnp.pad(mask, (0, pad)).reshape(nblk, block)
+            sp = jnp.pad(s, (0, pad)).reshape(nblk, block)
+            bi = jnp.arange(nblk, dtype=jnp.int32)
+            bkey = jnp.where(maskp.any(-1), -bi, jnp.int32(-nblk - 1))
+            bkv, bki = jax.lax.top_k(bkey, kb)  # ascending block index
+            bvalid = bkv > -nblk - 1
+            selmask = maskp[bki] & bvalid[:, None]  # (kb, block)
+            gidx = bki[:, None] * block + jnp.arange(block, dtype=jnp.int32)
+            key = jnp.where(selmask, -gidx, jnp.int32(-nbins - 1)).reshape(-1)
+            kv, ki = jax.lax.top_k(key, k)
+            valid = kv > -nbins - 1
+            idxs = jnp.where(valid, -kv, nbins).astype(jnp.int32)
+            snrs = jnp.where(valid, sp[bki].reshape(-1)[ki], 0.0)
+        else:
+            # top_k over -index: picks the first k crossings, in
+            # ascending index order (descending key order)
+            key = jnp.where(mask, -i, jnp.int32(-nbins - 1))
+            kv, ki = jax.lax.top_k(key, k)
+            valid = kv > -nbins - 1
+            idxs = jnp.where(valid, ki, nbins).astype(jnp.int32)
+            snrs = jnp.where(valid, s[jnp.clip(ki, 0, nbins - 1)], 0.0)
         if k < max_peaks:
             idxs = jnp.pad(idxs, (0, max_peaks - k), constant_values=nbins)
             snrs = jnp.pad(snrs, (0, max_peaks - k))

@@ -66,7 +66,10 @@ class SearchConfig:
     verbose: bool = False
     progress_bar: bool = False
     # TPU-specific knobs (no reference equivalent)
-    max_peaks: int = 512  # static peak-compaction size per spectrum
+    max_peaks: int = 128  # static peak-compaction size per spectrum
+    # (small on purpose: top_k cost scales with the compaction size, and
+    # chunks whose raw crossing count overflows are re-dispatched at the
+    # next power of two automatically)
     dedisp_block: int = 16  # DM trials per dedispersion launch
     accel_bucket: int = 16  # accel batch padded to a multiple of this
     dm_block: int = 0  # DM trials per device call; 0 = auto from HBM budget
@@ -122,7 +125,7 @@ class PeasoupSearch:
     # device-resident trials), the cap on live peak-output buffers
     # queued per dispatch wave, and the trials size beyond which the
     # trial block spills to host RAM instead of living in HBM
-    TOTAL_HBM = 12_000_000_000
+    TOTAL_HBM = 12_000_000_000  # fallback when the device reports no limit
     MEM_BUDGET = 6_000_000_000
     WAVE_BUDGET = 1_000_000_000
     TRIALS_DEVICE_LIMIT = 4_000_000_000
@@ -130,6 +133,20 @@ class PeasoupSearch:
     def __init__(self, config: SearchConfig):
         self.config = config
         self._dm_sharding = None
+        # size budgets from the real chip when it tells us (memory_stats
+        # is absent on some backends, e.g. the CPU mesh in tests)
+        import jax
+
+        devs = jax.local_devices()
+        try:
+            limit = (devs[0].memory_stats() or {}).get("bytes_limit", 0)
+        except Exception:
+            limit = 0
+        if limit:
+            self.TOTAL_HBM = int(limit)
+            self.MEM_BUDGET = int(limit) // 2
+            self.WAVE_BUDGET = max(int(limit) // 12, 250_000_000)
+            self.TRIALS_DEVICE_LIMIT = int(limit) // 3
 
     def _pick_devices(self) -> list:
         """Devices to shard DM trials over. Auto mode mirrors the
@@ -382,7 +399,7 @@ class PeasoupSearch:
                             size=size, nsamps_valid=nsamps_valid,
                             pos5=pos5, pos25=pos25, tsamp=fil.tsamp,
                         )
-                    except Exception:
+                    except Exception as exc:
                         # the oracle probe runs at a reduced shape; if
                         # the Pallas kernel still fails at the full
                         # production shape (e.g. SMEM accel-table
@@ -390,6 +407,12 @@ class PeasoupSearch:
                         # redo the wave rather than crash the search
                         if pallas_block == 0:
                             raise
+                        import warnings
+
+                        warnings.warn(
+                            "search wave failed with the Pallas resample "
+                            f"enabled ({exc!r}); retrying without Pallas"
+                        )
                         pallas_block = 0
                         search_block = build_search(0)
                         self._search_wave(
@@ -409,38 +432,52 @@ class PeasoupSearch:
 
         # --- host candidate bookkeeping (ascending DM order) ----------------
         # idxs/snrs arrive ALREADY clustered (identify_unique_peaks ran
-        # on device); the host only builds candidates and distils.
+        # on device); the host only builds candidates and distils. The
+        # per-accel-trial harmonic distill runs as ONE segmented native
+        # call over every (dm, accel) trial of the run — Candidate
+        # objects exist only for its survivors (the reference builds one
+        # struct per raw detection, pipeline_multi.cu:233-238).
         t_host = time.time()
+        from .. import native
+
         dm_trial_cands = CandidateCollection()
-        for dm_idx, dm in enumerate(dm_plan.dm_list):
-            idxs, snrs, ccounts = per_dm_results.pop(dm_idx)
-            accs = accel_lists[dm_idx]
-            accel_trial_cands = CandidateCollection()
-            for a_idx in range(len(accs)):
-                acc = float(accs[a_idx])
-                trial_cands: list[Candidate] = []
-                for lvl in range(cfg.nharmonics + 1):
-                    n_found = int(ccounts[lvl, a_idx])
-                    for b, s in zip(
-                        idxs[lvl, a_idx, :n_found], snrs[lvl, a_idx, :n_found]
-                    ):
-                        trial_cands.append(
-                            Candidate(
-                                dm=float(dm),
-                                dm_idx=dm_idx,
-                                acc=acc,
-                                nh=lvl,
-                                snr=float(s),
-                                freq=float(b) * factors[lvl],
+        if native.available():
+            self._distill_trials_segmented(
+                dm_plan, accel_lists, per_dm_results, factors, harm_finder,
+                acc_still, dm_trial_cands,
+            )
+        else:
+            for dm_idx, dm in enumerate(dm_plan.dm_list):
+                idxs, snrs, ccounts = per_dm_results.pop(dm_idx)
+                accs = accel_lists[dm_idx]
+                accel_trial_cands = CandidateCollection()
+                for a_idx in range(len(accs)):
+                    acc = float(accs[a_idx])
+                    trial_cands: list[Candidate] = []
+                    for lvl in range(cfg.nharmonics + 1):
+                        n_found = int(ccounts[lvl, a_idx])
+                        for b, s in zip(
+                            idxs[lvl, a_idx, :n_found],
+                            snrs[lvl, a_idx, :n_found],
+                        ):
+                            trial_cands.append(
+                                Candidate(
+                                    dm=float(dm),
+                                    dm_idx=dm_idx,
+                                    acc=acc,
+                                    nh=lvl,
+                                    snr=float(s),
+                                    freq=float(b) * factors[lvl],
+                                )
                             )
-                        )
-                accel_trial_cands.append(harm_finder.distill(trial_cands))
-            dm_trial_cands.append(acc_still.distill(accel_trial_cands.cands))
-            if cfg.verbose:
-                print(
-                    f"DM {dm:.3f} ({dm_idx+1}/{dm_plan.ndm}): "
-                    f"{len(accs)} accel trials, {len(dm_trial_cands)} cands so far"
-                )
+                    accel_trial_cands.append(harm_finder.distill(trial_cands))
+                dm_trial_cands.append(acc_still.distill(accel_trial_cands.cands))
+                if cfg.verbose:
+                    print(
+                        f"DM {dm:.3f} ({dm_idx+1}/{dm_plan.ndm}): "
+                        f"{len(accs)} accel trials, "
+                        f"{len(dm_trial_cands)} cands so far"
+                    )
         timers["search_host"] = time.time() - t_host
         timers["searching"] = time.time() - t0
 
@@ -478,6 +515,106 @@ class PeasoupSearch:
             size=size,
             n_accel_trials=sum(len(a) for a in accel_lists),
         )
+
+    def _distill_trials_segmented(
+        self, dm_plan, accel_lists, per_dm_results, factors, harm_finder,
+        acc_still, dm_trial_cands,
+    ) -> None:
+        """Vectorised candidate bookkeeping: build (freq, snr, nh) row
+        arrays for every detection with numpy, harmonic-distill every
+        accel trial in one segmented native call, then materialise
+        Candidate objects for the survivors only. Ordering matches the
+        object path exactly: rows are stably sorted S/N-descending
+        within each (dm, accel) segment (the !IMPORTANT sort,
+        distiller.hpp:31), so downstream stable sorts see the same tie
+        order."""
+        cfg = self.config
+        from .. import native
+
+        nlev = cfg.nharmonics + 1
+        factors_arr = np.asarray(factors, dtype=np.float64)  # (nlev,)
+        lvl_iota = np.arange(nlev, dtype=np.int32)[None, :, None]
+
+        freq_parts, snr_parts, lvl_parts, a_parts = [], [], [], []
+        seg_counts_parts = []  # (A,) rows per accel trial, per dm
+        for dm_idx in range(dm_plan.ndm):
+            idxs, snrs, ccounts = per_dm_results.pop(dm_idx)
+            A = len(accel_lists[dm_idx])
+            mx = idxs.shape[-1]
+            cc = np.minimum(ccounts[:, :A], mx)  # (nlev, A)
+            validT = (
+                np.arange(mx, dtype=np.int32)[None, None, :]
+                < cc[..., None]
+            ).transpose(1, 0, 2)  # (A, nlev, mx)
+            freq_parts.append(
+                (
+                    idxs[:, :A].transpose(1, 0, 2).astype(np.float64)
+                    * factors_arr[None, :, None]
+                )[validT]
+            )
+            snr_parts.append(snrs[:, :A].transpose(1, 0, 2)[validT])
+            lvl_parts.append(
+                np.broadcast_to(lvl_iota, validT.shape)[validT]
+            )
+            a_parts.append(
+                np.broadcast_to(
+                    np.arange(A, dtype=np.int32)[:, None, None], validT.shape
+                )[validT]
+            )
+            seg_counts_parts.append(validT.sum(axis=(1, 2)))
+
+        freqs_all = np.concatenate(freq_parts)
+        snr_all = np.concatenate(snr_parts).astype(np.float64)
+        lvl_all = np.concatenate(lvl_parts)
+        a_all = np.concatenate(a_parts)
+        seg_counts = np.concatenate(seg_counts_parts).astype(np.int64)
+        dm_of_seg = np.repeat(
+            np.arange(dm_plan.ndm),
+            [len(a) for a in accel_lists[: dm_plan.ndm]],
+        )
+        seg_id = np.repeat(np.arange(seg_counts.size), seg_counts)
+
+        # stable within-segment S/N-descending order (primary key is the
+        # LAST element of the lexsort key tuple)
+        order = np.lexsort((-snr_all, seg_id))
+        seg_off = np.concatenate(
+            [np.zeros(1, np.int64), np.cumsum(seg_counts)]
+        )
+        unique = native.harmonic_distill_seg(
+            freqs_all[order], lvl_all[order], seg_off,
+            harm_finder.tolerance, harm_finder.max_harm,
+            harm_finder.fractional_harms,
+        )
+
+        surv = order[unique]  # original-row ids, in (segment, snr desc) order
+        s_dm = dm_of_seg[seg_id[surv]]
+        s_a = a_all[surv]
+        s_lvl = lvl_all[surv]
+        s_snr = snr_all[surv]
+        s_freq = freqs_all[surv]
+        bounds = np.searchsorted(s_dm, np.arange(dm_plan.ndm + 1))
+        for dm_idx in range(dm_plan.ndm):
+            dm = float(dm_plan.dm_list[dm_idx])
+            accs = accel_lists[dm_idx]
+            lo, hi = bounds[dm_idx], bounds[dm_idx + 1]
+            accel_trial_cands = [
+                Candidate(
+                    dm=dm,
+                    dm_idx=dm_idx,
+                    acc=float(accs[s_a[r]]),
+                    nh=int(s_lvl[r]),
+                    snr=float(s_snr[r]),
+                    freq=float(s_freq[r]),
+                )
+                for r in range(lo, hi)
+            ]
+            dm_trial_cands.append(acc_still.distill(accel_trial_cands))
+            if cfg.verbose:
+                print(
+                    f"DM {dm:.3f} ({dm_idx+1}/{dm_plan.ndm}): "
+                    f"{len(accs)} accel trials, "
+                    f"{len(dm_trial_cands)} cands so far"
+                )
 
     def _dispatch_chunk(
         self, chunk, accel_lists, trials, tim_len, zapmask_dev, windows,
