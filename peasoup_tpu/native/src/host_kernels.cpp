@@ -105,6 +105,27 @@ struct EdgeSink {
   }
 };
 
+// Harmonic-ratio matcher shared by the per-trial and segmented
+// distills. Counts matching (jj, kk) pairs; with early_exit it stops
+// at the first match (valid only when pair multiplicity is unused,
+// i.e. keep_related is false).
+static inline int harmonic_hits(double fundi, double freq, int32_t nh,
+                                double lo, double hi, int32_t max_harm,
+                                int32_t fractional, bool early_exit) {
+  const int32_t max_denom = fractional ? (int32_t{1} << nh) : int32_t{1};
+  int hits = 0;
+  for (int32_t jj = 1; jj <= max_harm; ++jj) {
+    for (int32_t kk = 1; kk <= max_denom; ++kk) {
+      const double ratio = kk * freq / (jj * fundi);
+      if (ratio > lo && ratio < hi) {
+        ++hits;
+        if (early_exit) return hits;
+      }
+    }
+  }
+  return hits;
+}
+
 int64_t ps_harmonic_distill(const double* freqs, const int32_t* nhs, int64_t n,
                             double tol, int32_t max_harm, int32_t fractional,
                             int32_t keep_related, uint8_t* unique,
@@ -117,19 +138,12 @@ int64_t ps_harmonic_distill(const double* freqs, const int32_t* nhs, int64_t n,
     if (!unique[idx]) continue;
     const double fundi = freqs[idx];
     for (int64_t jjt = idx + 1; jjt < n; ++jjt) {
-      const double freq = freqs[jjt];
-      const double max_denom = fractional ? std::pow(2.0, nhs[jjt]) : 1.0;
-      bool hit = false;
-      for (int32_t jj = 1; jj <= max_harm; ++jj) {
-        for (int32_t kk = 1; kk <= static_cast<int32_t>(max_denom); ++kk) {
-          const double ratio = kk * freq / (jj * fundi);
-          if (ratio > lo && ratio < hi) {
-            hit = true;
-            if (keep_related) edges.add(idx, jjt);
-          }
-        }
-      }
-      if (hit) unique[jjt] = 0;
+      const int hits = harmonic_hits(fundi, freqs[jjt], nhs[jjt], lo, hi,
+                                     max_harm, fractional,
+                                     /*early_exit=*/!keep_related);
+      if (keep_related)
+        for (int h = 0; h < hits; ++h) edges.add(idx, jjt);
+      if (hits) unique[jjt] = 0;
     }
   }
   return edges.n;
@@ -155,20 +169,9 @@ void ps_harmonic_distill_seg(const double* freqs, const int32_t* nhs,
       const double fundi = freqs[idx];
       for (int64_t jjt = idx + 1; jjt < e; ++jjt) {
         if (!unique[jjt]) continue;
-        const double freq = freqs[jjt];
-        const int32_t max_denom =
-            fractional ? (int32_t{1} << nhs[jjt]) : int32_t{1};
-        bool hit = false;
-        for (int32_t jj = 1; jj <= max_harm && !hit; ++jj) {
-          for (int32_t kk = 1; kk <= max_denom; ++kk) {
-            const double ratio = kk * freq / (jj * fundi);
-            if (ratio > lo && ratio < hi) {
-              hit = true;
-              break;
-            }
-          }
-        }
-        if (hit) unique[jjt] = 0;
+        if (harmonic_hits(fundi, freqs[jjt], nhs[jjt], lo, hi, max_harm,
+                          fractional, /*early_exit=*/true))
+          unique[jjt] = 0;
       }
     }
   }

@@ -133,6 +133,12 @@ class PeasoupSearch:
     def __init__(self, config: SearchConfig):
         self.config = config
         self._dm_sharding = None
+        # adaptive compaction size: raw threshold crossings per spectrum
+        # are data-dependent (a bright pulsar crosses at every DM trial,
+        # e.g. tutorial.fil peaks at ~276); once a wave escalates, start
+        # every later wave at the learned size so steady state
+        # dispatches each chunk exactly once
+        self._learned_max_peaks = 0
         # size budgets from the real chip when it tells us (memory_stats
         # is absent on some backends, e.g. the CPU mesh in tests)
         import jax
@@ -270,8 +276,13 @@ class PeasoupSearch:
              for a in accel_lists if len(a)),
             default=0.0,
         )
+        # gather-free select resample whenever the shift span is small:
+        # at small spans the few-way select fuses into the surrounding
+        # program and beats even the Pallas kernel (which still streams
+        # a separate pass over HBM)
+        select_smax = select_span(af_max, size)
         pallas_block = 0
-        if cfg.use_pallas:
+        if cfg.use_pallas and not 0 < select_smax <= 8:
             from ..ops.pallas import probe_pallas_resample
             from ..ops.pallas.resample import choose_block
 
@@ -281,9 +292,6 @@ class PeasoupSearch:
             # Mosaic toolchains that mis-handle this kernel
             if pallas_block and not probe_pallas_resample(size, pallas_block):
                 pallas_block = 0
-        # gather-free select resample whenever the shift span is small
-        # (used when Pallas is off or fails at the production shape)
-        select_smax = select_span(af_max, size)
 
         # --- device selection: shard DM trials over local chips --------
         # (the reference's analogue: one worker per GPU up to -t,
@@ -354,6 +362,14 @@ class PeasoupSearch:
             else:
                 cells = max(8, int(mem_budget / (size_spec_b * 12)))
                 d_local = max(1, min(128, cells // max(1, padded)))
+                # equalise: 59 trials at d_local=56 would pad a 3-trial
+                # tail chunk to 56 rows of device work; split evenly
+                # instead (30+29 -> 30+30). Derived from the GLOBAL
+                # trial count only, so the per-chip block shape — and
+                # therefore the XLA program and its bitwise results —
+                # stays independent of the device count
+                n_parts = -(-len(dm_indices) // d_local)
+                d_local = -(-len(dm_indices) // n_parts)
             d_blk = d_local * len(devices)
             chunks.extend(
                 (dm_indices[s : s + d_blk], d_blk)
@@ -367,7 +383,10 @@ class PeasoupSearch:
             padded = int(
                 math.ceil(len(accel_lists[dm_indices[0]]) / bucket) * bucket
             )
-            return d_blk * (cfg.nharmonics + 1) * padded * cfg.max_peaks * 8
+            # budget with the learned compaction size: later waves (and
+            # repeat runs) dispatch at mp0, not cfg.max_peaks
+            mp = max(cfg.max_peaks, self._learned_max_peaks)
+            return d_blk * (cfg.nharmonics + 1) * padded * mp * 8
 
         waves: list[list[tuple[list[int], int]]] = []
         wave: list[tuple[list[int], int]] = []
@@ -687,12 +706,11 @@ class PeasoupSearch:
         args = (accel_lists, trials, tim_len, zapmask_dev, windows,
                 search_block)
 
+        mp0 = max(cfg.max_peaks, self._learned_max_peaks)
         pend = []
         for chunk in wave:
-            peaks, padded = self._dispatch_chunk(
-                chunk, *args, cfg.max_peaks, **disp
-            )
-            pend.append([chunk, cfg.max_peaks, peaks, padded])
+            peaks, padded = self._dispatch_chunk(chunk, *args, mp0, **disp)
+            pend.append([chunk, mp0, peaks, padded])
 
         # ONE packed counts transfer (raw crossing counts for overflow
         # detection + cluster counts for fetch trimming) for the whole
@@ -720,6 +738,9 @@ class PeasoupSearch:
             off += n
             while counts.max() > max_peaks:
                 max_peaks = 1 << int(np.ceil(np.log2(counts.max())))
+                self._learned_max_peaks = max(
+                    self._learned_max_peaks, max_peaks
+                )
                 peaks, padded = self._dispatch_chunk(
                     chunk, *args, max_peaks, **disp
                 )
