@@ -71,14 +71,18 @@ def _preprocess_trial(tim, zapmask, *, size, nsamps_valid, pos5, pos25):
 
 def _spectra_and_peaks(
     xr, mean, std, windows, *, threshold, nharms, max_peaks, stack_axis,
-    cluster=True,
+    cluster=True, pallas_peaks=False,
 ):
     """Post-resample stage: batched rfft, interbin, normalise, harmonic
     sums, per-level peak compaction (pipeline_multi.cu:216-234), and —
     with ``cluster`` — the min-gap peak clustering the reference runs
     on the host (peakfinder.hpp:27-56), kept on device so only cluster
-    peaks ever cross the host link. ``xr`` is (..., A, size); mean/std
-    broadcast against (..., A)."""
+    peaks ever cross the host link. With ``pallas_peaks`` the
+    compaction + clustering run as the fused streaming kernel
+    (ops/pallas/peaks.py): same outputs, but idxs/snrs hold CLUSTER
+    peaks sized ``max_peaks`` while raw crossings are only counted —
+    overflow then means ccounts > max_peaks, not counts. ``xr`` is
+    (..., A, size); mean/std broadcast against (..., A)."""
     fr = jnp.fft.rfft(xr, axis=-1)
     s = form_interpolated(fr)
     s = normalise(s, mean, std)
@@ -88,17 +92,27 @@ def _spectra_and_peaks(
 
     idxs, snrs, counts, ccounts = [], [], [], []
     for lvl, spec in enumerate(levels):
-        i_, s_, c_ = find_peaks_device(
-            spec,
-            jnp.float32(threshold),
-            windows[lvl, 0],
-            windows[lvl, 1],
-            max_peaks=max_peaks,
-        )
-        if cluster:
-            i_, s_, cc_ = cluster_peaks_device(i_, s_, jnp.int32(nbins))
+        # the fused kernel always clusters; honour cluster=False via
+        # the jnp path rather than silently returning cluster peaks
+        if pallas_peaks and cluster:
+            from ..ops.pallas.peaks import find_cluster_peaks_pallas
+
+            i_, s_, c_, cc_ = find_cluster_peaks_pallas(
+                spec, windows, lvl,
+                threshold=threshold, max_peaks=max_peaks,
+            )
         else:
-            cc_ = c_
+            i_, s_, c_ = find_peaks_device(
+                spec,
+                jnp.float32(threshold),
+                windows[lvl, 0],
+                windows[lvl, 1],
+                max_peaks=max_peaks,
+            )
+            if cluster:
+                i_, s_, cc_ = cluster_peaks_device(i_, s_, jnp.int32(nbins))
+            else:
+                cc_ = c_
         idxs.append(i_)
         snrs.append(s_)
         counts.append(c_)
@@ -180,6 +194,7 @@ def search_block_core(
     pallas_interpret: bool = False,
     select_smax: int = 0,
     cluster: bool = True,
+    pallas_peaks: bool = False,
 ) -> AccelSearchPeaks:
     """Block-batched search: all per-DM preprocessing vmapped, then the
     (D, A) accel grid processed as single batched array programs. With
@@ -214,13 +229,14 @@ def search_block_core(
     return _spectra_and_peaks(
         xr, mean[:, None], std[:, None], windows,
         threshold=threshold, nharms=nharms, max_peaks=max_peaks,
-        stack_axis=1, cluster=cluster,
+        stack_axis=1, cluster=cluster, pallas_peaks=pallas_peaks,
     )
 
 
 @lru_cache(maxsize=None)
 def make_batched_search_fn(
-    threshold: float, pallas_block: int = 0, select_smax: int = 0
+    threshold: float, pallas_block: int = 0, select_smax: int = 0,
+    pallas_peaks: bool = False,
 ):
     """Jitted (D, ...) -> (D, ...) search over a block of DM trials.
 
@@ -243,7 +259,7 @@ def make_batched_search_fn(
             threshold=threshold, size=size, nsamps_valid=nsamps_valid,
             nharms=nharms, max_peaks=max_peaks, pos5=pos5, pos25=pos25,
             pallas_block=pallas_block, select_smax=select_smax,
-            cluster=cluster,
+            cluster=cluster, pallas_peaks=pallas_peaks,
         )
 
     return search_dm_block

@@ -75,6 +75,7 @@ class SearchConfig:
     dm_block: int = 0  # DM trials per device call; 0 = auto from HBM budget
     checkpoint_file: str = ""  # resumable per-DM-trial result store
     use_pallas: bool = True  # Pallas resample kernel on TPU backends
+    use_pallas_peaks: bool = True  # fused threshold+cluster Pallas kernel
     # device sharding: 0 = auto (all local TPU chips up to
     # max_num_threads, single-device elsewhere); N = force an N-chip
     # 'dm' mesh (tests use this on the virtual CPU mesh)
@@ -292,6 +293,19 @@ class PeasoupSearch:
             # Mosaic toolchains that mis-handle this kernel
             if pallas_block and not probe_pallas_resample(size, pallas_block):
                 pallas_block = 0
+        # fused threshold+compact+cluster kernel: output is cluster
+        # peaks, so overflow means cluster count > max_peaks (rare)
+        # rather than raw crossings > max_peaks (common for bright
+        # pulsars) - the escalation key switches accordingly
+        pallas_peaks = False
+        if cfg.use_pallas_peaks:
+            from ..ops.pallas import probe_pallas_peaks
+
+            pallas_peaks = probe_pallas_peaks(
+                size_spec, cfg.nharmonics + 1,
+                max(cfg.max_peaks, self._learned_max_peaks) or cfg.max_peaks,
+            )
+        self._pallas_peaks = pallas_peaks
 
         # --- device selection: shard DM trials over local chips --------
         # (the reference's analogue: one worker per GPU up to -t,
@@ -309,6 +323,7 @@ class PeasoupSearch:
                 return make_sharded_search_fn(
                     mesh, cfg.min_snr, axis="dm", pallas_block=pb,
                     select_smax=select_smax if pb == 0 else 0,
+                    pallas_peaks=pallas_peaks,
                 )
 
             # stage blocks directly onto the mesh (no hop through chip 0)
@@ -317,7 +332,8 @@ class PeasoupSearch:
 
             def build_search(pb: int):
                 return make_batched_search_fn(
-                    cfg.min_snr, pb, select_smax if pb == 0 else 0
+                    cfg.min_snr, pb, select_smax if pb == 0 else 0,
+                    pallas_peaks=pallas_peaks,
                 )
 
             self._dm_sharding = None
@@ -736,8 +752,11 @@ class PeasoupSearch:
                 -1, nlev, padded
             )
             off += n
-            while counts.max() > max_peaks:
-                max_peaks = 1 << int(np.ceil(np.log2(counts.max())))
+            # overflow: raw crossings outgrew the compaction (jnp
+            # path) or clusters outgrew it (fused-kernel path)
+            ov = ccounts if getattr(self, "_pallas_peaks", False) else counts
+            while ov.max() > max_peaks:
+                max_peaks = 1 << int(np.ceil(np.log2(ov.max())))
                 self._learned_max_peaks = max(
                     self._learned_max_peaks, max_peaks
                 )
@@ -746,6 +765,7 @@ class PeasoupSearch:
                 )
                 counts = np.asarray(peaks.counts)
                 ccounts = np.asarray(peaks.ccounts)
+                ov = ccounts if getattr(self, "_pallas_peaks", False) else counts
                 entry[1:] = [max_peaks, peaks, padded]
             counts_list.append(counts)
             ccounts_list.append(ccounts)

@@ -183,3 +183,114 @@ class TestBlockCoreParity:
         np.testing.assert_array_equal(np.asarray(plain.idxs), np.asarray(pallas.idxs))
         np.testing.assert_array_equal(np.asarray(plain.snrs), np.asarray(pallas.snrs))
         np.testing.assert_array_equal(np.asarray(plain.counts), np.asarray(pallas.counts))
+
+
+class TestPallasPeaks:
+    """Fused threshold+compact+cluster kernel (ops/pallas/peaks.py) vs
+    the jnp find_peaks_device + cluster_peaks_device pair, interpret
+    mode. Covers sparse/dense crossings, window edges, multi-level
+    tables, cluster overflow, and row/bin padding."""
+
+    def test_fuzz_parity(self):
+        import jax.numpy as jnp
+
+        from peasoup_tpu.ops.pallas.peaks import find_cluster_peaks_pallas
+        from peasoup_tpu.ops.peaks import (
+            cluster_peaks_device,
+            find_peaks_device,
+        )
+
+        rng = np.random.default_rng(7)
+        for trial in range(6):
+            rows = int(rng.integers(1, 5))
+            n = int(rng.integers(600, 9000))
+            dense = rng.random() < 0.4
+            s = (rng.normal(size=(rows, n)).astype(np.float32) ** 2) * (
+                3.0 if dense else 1.0
+            )
+            thr = 6.0
+            nlev = 3
+            windows = np.stack(
+                [
+                    [int(rng.integers(0, n // 3)),
+                     int(rng.integers(n // 2, n + 1))]
+                    for _ in range(nlev)
+                ]
+            ).astype(np.int32)
+            lvl = int(rng.integers(0, nlev))
+            mx = 32
+            sp = jnp.asarray(s)
+            ci, cs, rc, cc = find_cluster_peaks_pallas(
+                sp, jnp.asarray(windows), lvl,
+                threshold=thr, max_peaks=mx, interpret=True,
+            )
+            i_, s_, c_ = find_peaks_device(
+                sp, jnp.float32(thr), jnp.int32(windows[lvl, 0]),
+                jnp.int32(windows[lvl, 1]), max_peaks=1 << 13,
+            )
+            ji, js, jc = cluster_peaks_device(i_, s_, jnp.int32(n))
+            ci, cs, rc, cc = map(np.asarray, (ci, cs, rc, cc))
+            ji, js, jc, c_ = map(np.asarray, (ji, js, jc, c_))
+            np.testing.assert_array_equal(rc, c_)
+            np.testing.assert_array_equal(cc, jc)
+            for r in range(rows):
+                k = min(int(jc[r]), mx)
+                np.testing.assert_array_equal(ci[r, :k], ji[r, :k])
+                np.testing.assert_array_equal(cs[r, :k], js[r, :k])
+                if int(jc[r]) <= mx:
+                    assert (ci[r, k:] == n).all()
+                    assert (cs[r, k:] == 0).all()
+
+    def test_block_core_pallas_peaks_matches_jnp(self):
+        import jax.numpy as jnp
+
+        from peasoup_tpu.pipeline.accel_search import search_block_core
+        from peasoup_tpu.pipeline.search import _level_windows
+        import peasoup_tpu.ops.pallas.peaks as ppk
+
+        rng = np.random.default_rng(3)
+        size, nharms = 2048, 2
+        d, a = 2, 3
+        t = np.arange(size)
+        tims = jnp.asarray(
+            np.clip(
+                rng.normal(30, 3, size=(d, size))
+                + 12.0 * (((t * 0.000256) / 0.016) % 1.0 < 0.08),
+                0, 255,
+            ).astype(np.uint8)
+        )
+        afs = jnp.asarray(np.zeros((d, a), np.float32))
+        zap = jnp.zeros(size // 2 + 1, bool)
+        windows = jnp.asarray(_level_windows(size, nharms, 0.1, 1100.0, 0.000256))
+        kw = dict(
+            threshold=6.0, size=size, nsamps_valid=size, nharms=nharms,
+            max_peaks=64, pos5=8, pos25=80,
+        )
+        plain = search_block_core(tims, afs, zap, windows, **kw)
+        # route the kernel through interpret mode for the CPU test
+        orig = ppk._build.__wrapped__
+
+        def interp_build(*args):
+            return orig(*args[:-1], True)
+
+        ppk._build.cache_clear()
+        ppk._build = interp_build
+        try:
+            fused = search_block_core(
+                tims, afs, zap, windows, **kw, pallas_peaks=True
+            )
+        finally:
+            import functools
+            ppk._build = functools.lru_cache(maxsize=None)(orig)
+        np.testing.assert_array_equal(
+            np.asarray(plain.idxs), np.asarray(fused.idxs)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(plain.snrs), np.asarray(fused.snrs)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(plain.counts), np.asarray(fused.counts)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(plain.ccounts), np.asarray(fused.ccounts)
+        )
