@@ -232,24 +232,43 @@ def pack_bits(samples: np.ndarray, nbits: int) -> np.ndarray:
     return out
 
 
-@dataclass
 class Filterbank:
-    """A filterbank in host RAM: (nsamps, nchans) u8 samples + header.
+    """A filterbank in host RAM: header + samples.
 
-    Reference keeps the packed bytes and defers unpacking to dedisp
-    (filterbank.hpp:207-250); we unpack once on read.
+    Like the reference (filterbank.hpp:207-250, whose dedisp call
+    consumes the PACKED bytes and unpacks on the GPU), the packed
+    ``raw`` bytes are the primary storage when the file had sub-byte
+    samples: the dedispersion engine uploads them as-is and unpacks on
+    device — a 4x (2-bit) smaller host->device transfer. ``data``
+    unpacks lazily for host-side consumers.
     """
 
     header: SigprocHeader
-    data: np.ndarray  # (nsamps, nchans) uint8
+    _data: np.ndarray | None = None  # (nsamps, nchans) uint8, lazy
+    raw: np.ndarray | None = None  # packed file bytes (None if 8-bit)
+
+    def __init__(self, header, data=None, raw=None):
+        self.header = header
+        self._data = data
+        self.raw = raw
+        if data is None and raw is None:
+            raise ValueError("Filterbank needs data or raw")
+
+    @property
+    def data(self) -> np.ndarray:
+        if self._data is None:
+            self._data = unpack_bits(self.raw, self.header.nbits).reshape(
+                self.header.nsamples, self.header.nchans
+            )
+        return self._data
 
     @property
     def nsamps(self) -> int:
-        return self.data.shape[0]
+        return self.header.nsamples if self._data is None else self._data.shape[0]
 
     @property
     def nchans(self) -> int:
-        return self.data.shape[1]
+        return self.header.nchans
 
     @property
     def tsamp(self) -> float:
@@ -279,9 +298,11 @@ def read_filterbank(path: str | os.PathLike) -> Filterbank:
         nbytes = hdr.nsamples * hdr.nbits * hdr.nchans // 8
         f.seek(hdr.size, _io.SEEK_SET)
         raw = np.frombuffer(f.read(nbytes), dtype=np.uint8)
-    samples = unpack_bits(raw, hdr.nbits)
-    data = samples.reshape(hdr.nsamples, hdr.nchans)
-    return Filterbank(header=hdr, data=data)
+    if hdr.nbits == 8:
+        return Filterbank(
+            header=hdr, data=raw.reshape(hdr.nsamples, hdr.nchans)
+        )
+    return Filterbank(header=hdr, raw=raw.copy())
 
 
 def write_filterbank(path: str | os.PathLike, fil: Filterbank) -> None:

@@ -21,7 +21,12 @@ import numpy as np
 from ..core.candidates import Candidate, CandidateCollection
 from ..io.masks import read_killfile, read_zapfile
 from ..io.sigproc import Filterbank
-from ..ops.dedisperse import dedisperse, dedisperse_device, output_scale
+from ..ops.dedisperse import (
+    dedisperse,
+    dedisperse_device,
+    fil_to_device,
+    output_scale,
+)
 from ..ops.resample import accel_factor, select_span
 from ..ops.zap import birdie_mask
 from ..plan.accel_plan import AccelerationPlan
@@ -201,7 +206,7 @@ class PeasoupSearch:
         with trace_span("Dedisperse"):  # NVTX parity: pipeline_multi.cu:318
             dd = dedisperse if spill else dedisperse_device
             trials = dd(
-                fil.data,
+                fil.data if spill else fil_to_device(fil),
                 dm_plan.delay_samples(),
                 dm_plan.killmask,
                 dm_plan.out_nsamps,
@@ -306,6 +311,8 @@ class PeasoupSearch:
                 max(cfg.max_peaks, self._learned_max_peaks) or cfg.max_peaks,
             )
         self._pallas_peaks = pallas_peaks
+        self._peaks_probe_nlev = cfg.nharmonics + 1
+        self._peaks_probe_nbins = size_spec
 
         # --- device selection: shard DM trials over local chips --------
         # (the reference's analogue: one worker per GPU up to -t,
@@ -319,25 +326,27 @@ class PeasoupSearch:
 
             mesh = make_mesh({"dm": len(devices)}, devices=devices)
 
-            def build_search(pb: int):
+            def build_search(pb: int, pp: bool = pallas_peaks):
                 return make_sharded_search_fn(
                     mesh, cfg.min_snr, axis="dm", pallas_block=pb,
                     select_smax=select_smax if pb == 0 else 0,
-                    pallas_peaks=pallas_peaks,
+                    pallas_peaks=pp,
                 )
 
             # stage blocks directly onto the mesh (no hop through chip 0)
             self._dm_sharding = NamedSharding(mesh, PartitionSpec("dm"))
         else:
 
-            def build_search(pb: int):
+            def build_search(pb: int, pp: bool = pallas_peaks):
                 return make_batched_search_fn(
                     cfg.min_snr, pb, select_smax if pb == 0 else 0,
-                    pallas_peaks=pallas_peaks,
+                    pallas_peaks=pp,
                 )
 
             self._dm_sharding = None
         search_block = build_search(pallas_block)
+        self._build_search = build_search
+        self._cur_pallas_block = pallas_block
         tim_len = min(size, trials.shape[1])
 
         ckpt = None
@@ -760,6 +769,22 @@ class PeasoupSearch:
                 self._learned_max_peaks = max(
                     self._learned_max_peaks, max_peaks
                 )
+                if getattr(self, "_pallas_peaks", False):
+                    # the kernel was only oracle-probed at the startup
+                    # compaction size; re-probe the escalated shape and
+                    # degrade to the jnp path rather than running an
+                    # unvalidated kernel
+                    from ..ops.pallas import probe_pallas_peaks
+
+                    if not probe_pallas_peaks(
+                        self._peaks_probe_nbins, self._peaks_probe_nlev,
+                        max_peaks,
+                    ):
+                        self._pallas_peaks = False
+                        search_block = self._build_search(
+                            self._cur_pallas_block, False
+                        )
+                        args = args[:5] + (search_block,)
                 peaks, padded = self._dispatch_chunk(
                     chunk, *args, max_peaks, **disp
                 )
