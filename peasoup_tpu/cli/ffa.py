@@ -1,0 +1,149 @@
+"""`peasoup-ffa` — FFA pulsar-search pipeline CLI.
+
+Flag-compatible with the reference's FFA spec
+(read_ffa_cmdline_options, include/utils/cmdline.hpp:211-292:
+-i/-o/-k/-t/--nstreams/--dm_start/--dm_end/--dm_tol/--dm_pulse_width/
+--p_start/--p_end/--min_dc/-v/-p with the same defaults), whose
+implementing source (`ffa_pipeline.cu`, Makefile:41) is absent from
+the reference tree — here the search is implemented for real
+(ops/ffa.py). --nstreams and -t are accepted for compatibility; work
+scheduling is XLA's, not CUDA streams'.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+
+def get_default_ffa_output_filename() -> str:
+    """UTC-stamped default like the reference's search CLI
+    (cmdline.hpp:53-59)."""
+    return time.strftime("./%Y-%m-%d-%H:%M_peasoup_ffa.xml", time.gmtime())
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="peasoup-ffa",
+        description="Peasoup/FFAster extension - a TPU FFA pulsar "
+        "search pipeline",
+    )
+    p.add_argument("-i", "--inputfile", required=True,
+                   help="File to process (.fil)")
+    p.add_argument("-o", "--outfilename",
+                   default=None, help="The output filename")
+    p.add_argument("-k", "--killfile", default="", help="Channel mask file")
+    p.add_argument("-t", "--num_threads", type=int, default=14,
+                   help="The number of chips to use")
+    p.add_argument("--nstreams", type=int, default=16,
+                   help="(compatibility) stream count; scheduling is XLA's")
+    p.add_argument("--dm_start", type=float, default=0.0,
+                   help="First DM to dedisperse to")
+    p.add_argument("--dm_end", type=float, default=100.0,
+                   help="Last DM to dedisperse to")
+    p.add_argument("--dm_tol", type=float, default=1.10,
+                   help="DM smearing tolerance (1.11=10%%)")
+    p.add_argument("--dm_pulse_width", type=float, default=64.0,
+                   help="Minimum pulse width (us) for which dm_tol is valid")
+    p.add_argument("--p_start", type=float, default=0.8,
+                   help="Start period for FFA search (s)")
+    p.add_argument("--p_end", type=float, default=20.0,
+                   help="End period for FFA search (s)")
+    p.add_argument("--min_dc", type=float, default=0.001,
+                   help="Minimum duty cycle (fraction)")
+    p.add_argument("--min_snr", type=float, default=8.0,
+                   help="Candidate S/N threshold")
+    p.add_argument("--limit", type=int, default=1000,
+                   help="Maximum candidates to write")
+    p.add_argument("-v", "--verbose", action="store_true")
+    p.add_argument("-p", "--progress_bar", action="store_true")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    out = args.outfilename or get_default_ffa_output_filename()
+
+    from ..io import read_filterbank
+    from ..io.masks import read_killfile
+    from ..io.xml_writer import Element
+    from ..ops.dedisperse import dedisperse, fil_to_device, output_scale
+    from ..ops.ffa import collapse_periods, ffa_search_series
+    from ..plan.dm_plan import DMPlan
+    from ..utils import ProgressBar
+
+    t0 = time.time()
+    fil = read_filterbank(args.inputfile)
+    killmask = (
+        read_killfile(args.killfile, fil.nchans) if args.killfile else None
+    )
+    dm_plan = DMPlan.create(
+        nsamps=fil.nsamps, nchans=fil.nchans, tsamp=fil.tsamp,
+        fch1=fil.fch1, foff=fil.foff, dm_start=args.dm_start,
+        dm_end=args.dm_end, pulse_width=args.dm_pulse_width,
+        tol=args.dm_tol, killmask=killmask,
+    )
+    if args.verbose:
+        print(f"FFA search: {dm_plan.ndm} DM trials, periods "
+              f"{args.p_start}-{args.p_end} s, min_dc {args.min_dc}")
+    # trials are consumed on the host (one FFA per DM trial), so use
+    # the host-resident dedisperse variant: HBM holds one block at a
+    # time (packed upload + on-device unpack still apply)
+    trials = dedisperse(
+        fil_to_device(fil), dm_plan.delay_samples(), dm_plan.killmask,
+        dm_plan.out_nsamps,
+        scale=output_scale(fil.nbits, int(dm_plan.killmask.sum())),
+    )
+
+    progress = ProgressBar() if args.progress_bar else None
+    if progress:
+        progress.start()
+    cands = []
+    for dm_idx, dm in enumerate(dm_plan.dm_list):
+        cands.extend(
+            ffa_search_series(
+                trials[dm_idx].astype(np.float32), fil.tsamp,
+                args.p_start, args.p_end, args.min_dc,
+                dm=float(dm), snr_min=args.min_snr,
+            )
+        )
+        if progress:
+            progress.update((dm_idx + 1) / dm_plan.ndm)
+        if args.verbose:
+            print(f"DM {dm:.3f}: {len(cands)} candidates so far")
+    if progress:
+        progress.stop()
+
+    # collapse duplicates across DM (keep strongest per period cluster)
+    unique = collapse_periods(cands)[: args.limit]
+
+    root = Element("ffa_search")
+    params = root.append(Element("search_parameters"))
+    for k in ("p_start", "p_end", "min_dc", "dm_start", "dm_end",
+              "dm_tol", "dm_pulse_width", "min_snr"):
+        params.append(Element(k, getattr(args, k)))
+    dm_el = root.append(Element("dedispersion_trials"))
+    dm_el.add_attribute("count", dm_plan.ndm)
+    cands_el = root.append(Element("candidates"))
+    for i, c in enumerate(unique):
+        el = cands_el.append(Element("candidate"))
+        el.add_attribute("id", i)
+        el.append(Element("period", c.period))
+        el.append(Element("dm", c.dm))
+        el.append(Element("snr", c.snr))
+        el.append(Element("width", c.width))
+        el.append(Element("duty_cycle", c.dc))
+    times = root.append(Element("execution_times"))
+    times.append(Element("total", time.time() - t0))
+    with open(out, "w") as f:
+        f.write(root.to_string(header=True))
+    print(f"Done: {len(unique)} FFA candidates -> {out} "
+          f"(total {time.time()-t0:.2f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -347,6 +347,7 @@ class PeasoupSearch:
         search_block = build_search(pallas_block)
         self._build_search = build_search
         self._cur_pallas_block = pallas_block
+        self._active_search_block = search_block
         tim_len = min(size, trials.shape[1])
 
         ckpt = None
@@ -439,7 +440,8 @@ class PeasoupSearch:
                     try:
                         self._search_wave(
                             todo, accel_lists, trials, tim_len, zapmask_dev,
-                            windows, search_block, per_dm_results,
+                            windows, self._active_search_block,
+                            per_dm_results,
                             size=size, nsamps_valid=nsamps_valid,
                             pos5=pos5, pos25=pos25, tsamp=fil.tsamp,
                         )
@@ -458,10 +460,14 @@ class PeasoupSearch:
                             f"enabled ({exc!r}); retrying without Pallas"
                         )
                         pallas_block = 0
-                        search_block = build_search(0)
+                        self._cur_pallas_block = 0
+                        self._active_search_block = build_search(
+                            0, getattr(self, "_pallas_peaks", False)
+                        )
                         self._search_wave(
                             todo, accel_lists, trials, tim_len, zapmask_dev,
-                            windows, search_block, per_dm_results,
+                            windows, self._active_search_block,
+                            per_dm_results,
                             size=size, nsamps_valid=nsamps_valid,
                             pos5=pos5, pos25=pos25, tsamp=fil.tsamp,
                         )
@@ -784,6 +790,7 @@ class PeasoupSearch:
                         search_block = self._build_search(
                             self._cur_pallas_block, False
                         )
+                        self._active_search_block = search_block
                         args = args[:5] + (search_block,)
                 peaks, padded = self._dispatch_chunk(
                     chunk, *args, max_peaks, **disp
