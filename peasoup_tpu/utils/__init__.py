@@ -1,2 +1,3 @@
 from .trace import Stopwatch, trace_span
 from .progress import ProgressBar
+from .debug import dump_buffer

@@ -1,0 +1,23 @@
+"""Debug buffer dumps.
+
+Reference: ``Utils::dump_device_buffer`` / ``dump_host_buffer``
+(include/utils/utils.hpp:62-80) copy a device buffer to the host and
+write its raw bytes to a file for offline numpy comparison — the
+reference's test programs (e.g. src/rednoise_test.cpp:90-102) rely on
+it. Here any array-like (device or host) dumps the same way; read back
+with ``np.fromfile(path, dtype=...)``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def dump_buffer(arr, path: str) -> None:
+    """Write the raw little-endian bytes of ``arr`` (device or host) to
+    ``path`` — same on-disk format as the reference's dumps."""
+    host = np.asarray(arr)
+    if host.dtype.byteorder == ">":
+        host = host.astype(host.dtype.newbyteorder("<"))
+    with open(path, "wb") as f:
+        f.write(np.ascontiguousarray(host).tobytes())

@@ -175,3 +175,52 @@ def test_progress_bar_output():
     assert "50.0%" in out and "100.0%" in out and "ETA" in out
     pb.update(0.9)  # after stop: no-op
     assert "90" not in buf.getvalue()
+
+
+class TestAccmapCli:
+    """accmap CLI (reference src/accmap.cpp — broken as shipped there;
+    working here over .fil/.tim beams)."""
+
+    def test_finds_planted_delay(self, tmp_path, capsys):
+        import numpy as np
+
+        from peasoup_tpu.cli.accmap import main
+        from peasoup_tpu.io import write_filterbank
+        from peasoup_tpu.io.sigproc import Filterbank, SigprocHeader
+
+        rng = np.random.default_rng(0)
+        n, nchans = 4096, 4
+        base = rng.normal(100, 5, size=n + 64)
+        files = []
+        for k, off in enumerate((0, 17)):
+            data = np.clip(
+                base[off : off + n, None]
+                + rng.normal(0, 0.5, size=(n, nchans)),
+                0, 255,
+            ).astype(np.uint8)
+            hdr = SigprocHeader(
+                source_name=f"b{k}", data_type=1, nchans=nchans, nbits=8,
+                nifs=1, tsamp=0.001, tstart=50000.0, fch1=1500.0, foff=-1.0,
+            )
+            path = str(tmp_path / f"beam{k}.fil")
+            write_filterbank(path, Filterbank(header=hdr, data=data))
+            files.append(path)
+        assert main(files + ["-d", "64"]) == 0
+        out = capsys.readouterr().out
+        assert "lag" in out
+        lag = int(out.split("lag ")[1].split(" ")[0])
+        assert abs(abs(lag) - 17) <= 1, out
+
+
+class TestDumpBuffer:
+    def test_roundtrip(self, tmp_path):
+        import numpy as np
+        import jax.numpy as jnp
+
+        from peasoup_tpu.utils import dump_buffer
+
+        x = np.arange(100, dtype=np.float32) * 0.5
+        path = str(tmp_path / "buf.bin")
+        dump_buffer(jnp.asarray(x), path)
+        back = np.fromfile(path, dtype=np.float32)
+        np.testing.assert_array_equal(back, x)
