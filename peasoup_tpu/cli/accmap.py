@@ -39,6 +39,9 @@ def _load_series(path: str) -> np.ndarray:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    from .peasoup import apply_platform_env
+
+    apply_platform_env()
     import jax.numpy as jnp
 
     from ..ops.correlate import find_delays
@@ -48,14 +51,17 @@ def main(argv=None) -> int:
     beams = jnp.asarray(np.stack([s[:n] for s in series]))
     res = find_delays(beams, args.max_delay)
     pairs = np.asarray(res.pairs)
+    distance = np.asarray(res.distance)
+    lag = np.asarray(res.lag)
+    power = np.asarray(res.power)
     for k in range(pairs.shape[0]):
         ii, jj = pairs[k]
         # reference prints "<ii> <jj> Distance: <argmax>"
         # (correlator.hpp:85-86); the signed lag is the useful number
         print(
             f"{args.files[ii]} {args.files[jj]} "
-            f"Distance: {int(res.distance[k])} "
-            f"(lag {int(res.lag[k])} samples, power {float(res.power[k]):.3g})"
+            f"Distance: {int(distance[k])} "
+            f"(lag {int(lag[k])} samples, power {float(power[k]):.3g})"
         )
     return 0
 

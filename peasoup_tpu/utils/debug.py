@@ -20,4 +20,4 @@ def dump_buffer(arr, path: str) -> None:
     if host.dtype.byteorder == ">":
         host = host.astype(host.dtype.newbyteorder("<"))
     with open(path, "wb") as f:
-        f.write(np.ascontiguousarray(host).tobytes())
+        f.write(host.tobytes())
