@@ -186,6 +186,41 @@ def cluster_peaks_device(
     )
 
 
+@partial(jax.jit, static_argnames=("total_pad",))
+def compact_peaks_device(
+    idxs: jnp.ndarray,  # (..., mp) peak slots (cluster or raw)
+    snrs: jnp.ndarray,  # (..., mp)
+    ccounts: jnp.ndarray,  # (...) valid slots per cell
+    *,
+    total_pad: int,  # power-of-two >= total valid entries
+) -> jnp.ndarray:
+    """Ragged device-side compaction for the D2H transfer: gather ONLY
+    the valid (idx, snr) slots of every cell into one flat buffer
+    ((2*total_pad,) i32, snrs bitcast), cells in C order, slots in
+    order. The slot arrays are mostly padding (counts are data-
+    dependent), and the host link is slow — this sends exactly the
+    entries plus pow2 slack instead of cells*mp slots. The gather
+    index map is built ON DEVICE from ccounts (cumsum + searchsorted),
+    so the host only supplies the static padded total it learned from
+    the counts transfer."""
+    mp = idxs.shape[-1]
+    cc = jnp.minimum(ccounts.reshape(-1), mp).astype(jnp.int32)
+    ends = jnp.cumsum(cc)
+    starts = ends - cc
+    pos = jnp.arange(total_pad, dtype=jnp.int32)
+    cell = jnp.clip(
+        jnp.searchsorted(ends, pos, side="right"), 0, cc.size - 1
+    ).astype(jnp.int32)
+    within = jnp.clip(pos - jnp.take(starts, cell), 0, mp - 1)
+    flat = cell * mp + within
+    valid = pos < ends[-1]
+    vi = jnp.where(valid, jnp.take(idxs.reshape(-1), flat), 0)
+    vs = jnp.where(valid, jnp.take(snrs.reshape(-1), flat), 0.0)
+    return jnp.concatenate(
+        [vi.astype(jnp.int32), jax.lax.bitcast_convert_type(vs, jnp.int32)]
+    )
+
+
 def cluster_peaks(
     idxs: np.ndarray, snrs: np.ndarray, count: int, min_gap: int = 30
 ) -> tuple[np.ndarray, np.ndarray]:

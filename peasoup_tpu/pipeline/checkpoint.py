@@ -31,7 +31,7 @@ class SearchCheckpoint:
         from one beam/file never resumes a search of another."""
         h = fil.header
         fields = (
-            "v2-clustered",  # per-trial payload format version
+            "v3-ragged",  # per-trial payload format version
             fil.nsamps, fil.nchans, size, ndm,
             fil.tsamp, fil.fch1, fil.foff,
             getattr(h, "tstart", None), getattr(h, "source_name", None),

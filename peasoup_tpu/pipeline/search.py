@@ -116,6 +116,30 @@ def _level_windows(
     return np.asarray(rows, dtype=np.int32)
 
 
+def _densify_ragged(
+    vi: np.ndarray, vs: np.ndarray, cc: np.ndarray
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Expand a per-DM ragged peak stream back to dense
+    (nlev, padded, mx) slot arrays (cells C-order, slots in order) for
+    the object-path fallback."""
+    flat_cc = cc.reshape(-1).astype(np.int64)
+    mx = max(int(flat_cc.max()) if flat_cc.size else 0, 1)
+    idxs = np.zeros((flat_cc.size, mx), np.int64)
+    snrs = np.zeros((flat_cc.size, mx), np.float64)
+    ends = np.cumsum(flat_cc)
+    cell = np.repeat(np.arange(flat_cc.size), flat_cc)
+    within = np.arange(int(flat_cc.sum()), dtype=np.int64) - np.repeat(
+        ends - flat_cc, flat_cc
+    )
+    idxs[cell, within] = vi
+    snrs[cell, within] = vs
+    return (
+        idxs.reshape(*cc.shape, mx),
+        snrs.reshape(*cc.shape, mx),
+        cc,
+    )
+
+
 def _freq_factor(size: int, nh: int, tsamp: float) -> float:
     """Bin index -> frequency for level nh (peakfinder.hpp:89)."""
     size_spec = size // 2 + 1
@@ -498,7 +522,9 @@ class PeasoupSearch:
             )
         else:
             for dm_idx, dm in enumerate(dm_plan.dm_list):
-                idxs, snrs, ccounts = per_dm_results.pop(dm_idx)
+                idxs, snrs, ccounts = _densify_ragged(
+                    *per_dm_results.pop(dm_idx)
+                )
                 accs = accel_lists[dm_idx]
                 accel_trial_cands = CandidateCollection()
                 for a_idx in range(len(accs)):
@@ -583,35 +609,38 @@ class PeasoupSearch:
 
         nlev = cfg.nharmonics + 1
         factors_arr = np.asarray(factors, dtype=np.float64)  # (nlev,)
-        lvl_iota = np.arange(nlev, dtype=np.int32)[None, :, None]
 
         freq_parts, snr_parts, lvl_parts, a_parts = [], [], [], []
         seg_counts_parts = []  # (A,) rows per accel trial, per dm
         for dm_idx in range(dm_plan.ndm):
-            idxs, snrs, ccounts = per_dm_results.pop(dm_idx)
+            vi, vs, cc = per_dm_results.pop(dm_idx)  # ragged stream + counts
             A = len(accel_lists[dm_idx])
-            mx = idxs.shape[-1]
-            cc = np.minimum(ccounts[:, :A], mx)  # (nlev, A)
-            validT = (
-                np.arange(mx, dtype=np.int32)[None, None, :]
-                < cc[..., None]
-            ).transpose(1, 0, 2)  # (A, nlev, mx)
-            freq_parts.append(
-                (
-                    idxs[:, :A].transpose(1, 0, 2).astype(np.float64)
-                    * factors_arr[None, :, None]
-                )[validT]
+            nlev_, padded = cc.shape
+            flat_cc = cc.reshape(-1).astype(np.int64)
+            ends = np.cumsum(flat_cc)
+            starts = ends - flat_cc
+            # cells reordered (a asc, lvl asc), dropping padded accel
+            # slots — the same row order the object path builds
+            cells = (
+                np.arange(nlev_, dtype=np.int64)[None, :] * padded
+                + np.arange(A, dtype=np.int64)[:, None]
+            ).reshape(-1)
+            csel = flat_cc[cells]
+            n = int(csel.sum())
+            seg_e = np.cumsum(csel)
+            src = np.repeat(starts[cells], csel) + (
+                np.arange(n, dtype=np.int64) - np.repeat(seg_e - csel, csel)
             )
-            snr_parts.append(snrs[:, :A].transpose(1, 0, 2)[validT])
-            lvl_parts.append(
-                np.broadcast_to(lvl_iota, validT.shape)[validT]
-            )
+            lvl_rows = np.repeat(np.tile(np.arange(nlev_), A), csel)
+            freq_parts.append(vi[src].astype(np.float64) * factors_arr[lvl_rows])
+            snr_parts.append(vs[src])
+            lvl_parts.append(lvl_rows.astype(np.int32))
             a_parts.append(
-                np.broadcast_to(
-                    np.arange(A, dtype=np.int32)[:, None, None], validT.shape
-                )[validT]
+                np.repeat(
+                    np.repeat(np.arange(A, dtype=np.int32), nlev_), csel
+                )
             )
-            seg_counts_parts.append(validT.sum(axis=(1, 2)))
+            seg_counts_parts.append(csel.reshape(A, nlev_).sum(axis=1))
 
         freqs_all = np.concatenate(freq_parts)
         snr_all = np.concatenate(snr_parts).astype(np.float64)
@@ -741,7 +770,13 @@ class PeasoupSearch:
         pend = []
         for chunk in wave:
             peaks, padded = self._dispatch_chunk(chunk, *args, mp0, **disp)
-            pend.append([chunk, mp0, peaks, padded])
+            # record which peaks mode produced this chunk: a mid-wave
+            # degrade must not re-judge earlier fused-kernel chunks by
+            # raw-crossing counts
+            pend.append(
+                [chunk, mp0, peaks, padded,
+                 getattr(self, "_pallas_peaks", False)]
+            )
 
         # ONE packed counts transfer (raw crossing counts for overflow
         # detection + cluster counts for fetch trimming) for the whole
@@ -751,8 +786,8 @@ class PeasoupSearch:
         # only they pay extra round trips
         counts_flat = np.asarray(
             jnp.concatenate(
-                [p.counts.reshape(-1) for _, _, p, _ in pend]
-                + [p.ccounts.reshape(-1) for _, _, p, _ in pend]
+                [p.counts.reshape(-1) for _, _, p, _, _ in pend]
+                + [p.ccounts.reshape(-1) for _, _, p, _, _ in pend]
             )
         )
         half = counts_flat.size // 2
@@ -760,7 +795,7 @@ class PeasoupSearch:
         ccounts_list = []
         off = 0
         for entry in pend:
-            chunk, max_peaks, peaks, padded = entry
+            chunk, max_peaks, peaks, padded, fused = entry
             n = peaks.counts.shape[0] * nlev * padded
             counts = counts_flat[off : off + n].reshape(-1, nlev, padded)
             ccounts = counts_flat[half + off : half + off + n].reshape(
@@ -769,13 +804,13 @@ class PeasoupSearch:
             off += n
             # overflow: raw crossings outgrew the compaction (jnp
             # path) or clusters outgrew it (fused-kernel path)
-            ov = ccounts if getattr(self, "_pallas_peaks", False) else counts
+            ov = ccounts if fused else counts
             while ov.max() > max_peaks:
                 max_peaks = 1 << int(np.ceil(np.log2(ov.max())))
                 self._learned_max_peaks = max(
                     self._learned_max_peaks, max_peaks
                 )
-                if getattr(self, "_pallas_peaks", False):
+                if fused:
                     # the kernel was only oracle-probed at the startup
                     # compaction size; re-probe the escalated shape and
                     # degrade to the jnp path rather than running an
@@ -786,6 +821,7 @@ class PeasoupSearch:
                         self._peaks_probe_nbins, self._peaks_probe_nlev,
                         max_peaks,
                     ):
+                        fused = False
                         self._pallas_peaks = False
                         search_block = self._build_search(
                             self._cur_pallas_block, False
@@ -797,52 +833,53 @@ class PeasoupSearch:
                 )
                 counts = np.asarray(peaks.counts)
                 ccounts = np.asarray(peaks.ccounts)
-                ov = ccounts if getattr(self, "_pallas_peaks", False) else counts
-                entry[1:] = [max_peaks, peaks, padded]
+                ov = ccounts if fused else counts
+                entry[1:] = [max_peaks, peaks, padded, fused]
             counts_list.append(counts)
             ccounts_list.append(ccounts)
 
-        # ONE packed peak transfer: per chunk, slice idxs/snrs down to
-        # the observed maximum CLUSTER count (pow2-rounded to bound
-        # recompiles) and bitcast-pack both into a single i32 stream
-        from jax import lax
+        # ONE ragged packed peak transfer: the host already knows every
+        # cell's cluster count, so the device gathers EXACTLY the valid
+        # (idx, snr) slots (pow2-padded total to bound recompiles) —
+        # the slot arrays are mostly padding and the link is slow
+        from ..ops.peaks import compact_peaks_device
 
-        mxs, pieces = [], []
-        for (chunk, max_peaks, peaks, padded), ccounts in zip(
+        totals, pieces = [], []
+        for (chunk, max_peaks, peaks, padded, _), ccounts in zip(
             pend, ccounts_list
         ):
-            mx = 1 << max(0, int(np.ceil(np.log2(max(1, ccounts.max())))))
-            mx = min(mx, max_peaks)
-            mxs.append(mx)
+            cc = np.minimum(ccounts, max_peaks)
+            total = int(cc.sum())
+            total_pad = 1 << max(6, int(np.ceil(np.log2(max(1, total)))))
+            totals.append(total_pad)
             pieces.append(
-                jnp.concatenate(
-                    [
-                        peaks.idxs[..., :mx],
-                        lax.bitcast_convert_type(
-                            peaks.snrs[..., :mx], jnp.int32
-                        ),
-                    ],
-                    axis=-1,
-                ).reshape(-1)
+                compact_peaks_device(
+                    peaks.idxs, peaks.snrs, peaks.ccounts,
+                    total_pad=total_pad,
+                )
             )
         packed = np.asarray(
             pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
         )
 
         off = 0
-        for (chunk, _, peaks, padded), ccounts, mx in zip(
-            pend, ccounts_list, mxs
+        for (chunk, max_peaks, peaks, padded, _), ccounts, total_pad in zip(
+            pend, ccounts_list, totals
         ):
-            d = peaks.counts.shape[0]
-            n = d * nlev * padded * 2 * mx
-            block = packed[off : off + n].reshape(d, nlev, padded, 2 * mx)
-            off += n
-            idxs = block[..., :mx]
-            snrs = block[..., mx:].view(np.float32)
+            vi = packed[off : off + total_pad]
+            vs = packed[off + total_pad : off + 2 * total_pad].view(
+                np.float32
+            )
+            off += 2 * total_pad
+            cc = np.minimum(ccounts, max_peaks)  # (d, nlev, padded)
+            # per-row entry ranges within the chunk's ragged stream
+            row_ends = np.cumsum(cc.reshape(cc.shape[0], -1).sum(axis=1))
             dm_indices = chunk[0]
             for row in range(len(dm_indices)):
+                lo = int(row_ends[row - 1]) if row else 0
+                hi = int(row_ends[row])
                 per_dm_results[dm_indices[row]] = (
-                    idxs[row],
-                    snrs[row],
-                    ccounts[row],
+                    vi[lo:hi],
+                    vs[lo:hi],
+                    cc[row],
                 )

@@ -462,3 +462,59 @@ class TestCoincidence:
         out = np.asarray(coincidence_mask(jnp.asarray(beams), 4.0, 3))
         assert out[3] == 0.0  # multibeam -> masked
         assert out[5] == 1.0  # single beam -> kept
+
+
+class TestCompactPeaks:
+    """Ragged device-side peak compaction (ops/peaks.py:
+    compact_peaks_device) and its host-side inverses
+    (pipeline/search.py: _densify_ragged, segmented-distill reindex)."""
+
+    def test_fuzz_against_dense(self):
+        import jax.numpy as jnp
+
+        from peasoup_tpu.ops.peaks import compact_peaks_device
+        from peasoup_tpu.pipeline.search import _densify_ragged
+
+        rng = np.random.default_rng(5)
+        for trial in range(20):
+            shape = tuple(
+                int(rng.integers(1, 5)) for _ in range(int(rng.integers(1, 4)))
+            )
+            mp = int(rng.integers(1, 9))
+            idxs = rng.integers(0, 1000, size=(*shape, mp)).astype(np.int32)
+            snrs = rng.normal(size=(*shape, mp)).astype(np.float32)
+            # counts may exceed slot capacity (fused-kernel overflow)
+            cc = rng.integers(0, mp + 3, size=shape).astype(np.int32)
+            total = int(np.minimum(cc, mp).sum())
+            total_pad = 1 << max(3, int(np.ceil(np.log2(max(1, total)))))
+            packed = np.asarray(
+                compact_peaks_device(
+                    jnp.asarray(idxs), jnp.asarray(snrs), jnp.asarray(cc),
+                    total_pad=total_pad,
+                )
+            )
+            vi = packed[:total_pad]
+            vs = packed[total_pad:].view(np.float32)
+            # oracle: concatenate each cell's first min(cc, mp) slots
+            ccl = np.minimum(cc, mp).reshape(-1)
+            exp_i = np.concatenate(
+                [idxs.reshape(-1, mp)[k, : ccl[k]] for k in range(ccl.size)]
+                or [np.zeros(0, np.int32)]
+            )
+            exp_s = np.concatenate(
+                [snrs.reshape(-1, mp)[k, : ccl[k]] for k in range(ccl.size)]
+                or [np.zeros(0, np.float32)]
+            )
+            np.testing.assert_array_equal(vi[:total], exp_i)
+            np.testing.assert_array_equal(vs[:total], exp_s)
+            assert (vi[total:] == 0).all()
+            # round-trip through the fallback densifier
+            di, ds, dcc = _densify_ragged(
+                vi[:total], vs[:total].astype(np.float64),
+                np.minimum(cc, mp),
+            )
+            for k in range(ccl.size):
+                np.testing.assert_array_equal(
+                    di.reshape(-1, di.shape[-1])[k, : ccl[k]],
+                    idxs.reshape(-1, mp)[k, : ccl[k]],
+                )
