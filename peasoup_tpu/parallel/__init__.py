@@ -1,4 +1,5 @@
 from .mesh import make_mesh, device_count
+from . import multihost
 from .sharded_search import make_sharded_search_fn
 from .coincidence import baseline_beam, sharded_coincidence
 from .distributed_fft import (

@@ -1,0 +1,97 @@
+"""Multi-host (multi-process) execution over ICI + DCN.
+
+The reference scales no further than one node: pthread workers over the
+local GPUs (src/pipeline_multi.cu:33-81), no MPI/NCCL. This module is
+the TPU framework's distributed communication backend: JAX's
+coordinator-based multi-process runtime, with XLA collectives riding
+ICI within a pod slice and DCN between pods/hosts. The search itself
+needs no new code for multi-host — `shard_map` programs built on a
+global mesh (parallel/sharded_search.py, parallel/coincidence.py,
+parallel/distributed_fft.py) run unchanged; only device discovery and
+data placement change.
+
+Deployment pattern (one process per host):
+
+    from peasoup_tpu.parallel import multihost
+    multihost.initialize(coordinator="host0:8476",
+                         num_processes=4, process_id=RANK)
+    mesh = multihost.global_mesh({"beam": 4, "dm": -1},
+                                 dcn_axis="beam")
+    # beams land one per pod (DCN between them), DM trials shard the
+    # pod's chips (ICI); psum over 'beam' crosses DCN, collectives
+    # over 'dm' stay on ICI.
+
+On a single process (no coordinator), everything degrades to the
+local-device behaviour used throughout this repo.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+from jax.sharding import Mesh
+
+from .mesh import make_mesh
+
+
+def initialize(
+    coordinator: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Initialise the multi-process JAX runtime.
+
+    With no arguments, reads the standard env (JAX_COORDINATOR_ADDRESS,
+    JAX_NUM_PROCESSES, JAX_PROCESS_ID — or their cloud-TPU equivalents
+    auto-detected by jax.distributed). Safe no-op when already
+    initialised or when running single-process.
+    """
+    coordinator = coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if num_processes is None:
+        env = os.environ.get("JAX_NUM_PROCESSES")
+        num_processes = int(env) if env else None
+    if process_id is None:
+        env = os.environ.get("JAX_PROCESS_ID")
+        process_id = int(env) if env else None
+    if coordinator is None and num_processes in (None, 1):
+        return  # single-process: nothing to do
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as exc:
+        if "already initialized" not in str(exc):
+            raise
+
+
+def global_mesh(
+    axes: dict[str, int], dcn_axis: str | None = None
+) -> Mesh:
+    """Build a mesh over ALL processes' devices (jax.devices() is
+    global after initialize()).
+
+    ``dcn_axis`` names the axis that should map to the slowest link
+    (across hosts/pods): it is laid out as the LEADING mesh dimension
+    so consecutive devices along every other axis stay within one
+    process's slice (ICI), and only the named axis crosses process
+    boundaries (DCN). With ``-1`` sizes resolved as in make_mesh.
+    """
+    devices = jax.devices()
+    if dcn_axis is not None and dcn_axis in axes:
+        names = [dcn_axis] + [n for n in axes if n != dcn_axis]
+        axes = {n: axes[n] for n in names}
+    return make_mesh(axes, devices=devices)
+
+
+def process_local_slice(mesh: Mesh, axis: str) -> tuple[int, int]:
+    """The [start, stop) block of ``axis`` whose shards live on THIS
+    process — the host-side work partition for feeding per-process
+    data (e.g. which DM trials this host should stage)."""
+    idx = jax.process_index()
+    n = jax.process_count()
+    size = mesh.shape[axis]
+    per = -(-size // n)
+    return min(idx * per, size), min((idx + 1) * per, size)

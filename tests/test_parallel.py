@@ -120,3 +120,32 @@ class TestBaselineBeam:
         assert tim.shape == (4096,)
         assert abs(np.mean(tim)) < 0.1  # normalised
         assert np.std(tim) == pytest.approx(1.0, rel=0.1)
+
+
+class TestMultihost:
+    """Multi-host helpers (parallel/multihost.py). Single-process here:
+    initialize() must no-op, global_mesh must build over all (virtual)
+    devices with the DCN axis leading, and the per-process slice must
+    cover the axis exactly."""
+
+    def test_initialize_noop_single_process(self):
+        from peasoup_tpu.parallel import multihost
+
+        multihost.initialize()  # no coordinator -> no-op
+
+    def test_global_mesh_dcn_axis_leading(self):
+        from peasoup_tpu.parallel import multihost
+
+        mesh = multihost.global_mesh({"dm": -1, "beam": 2}, dcn_axis="beam")
+        assert mesh.axis_names[0] == "beam"
+        assert mesh.shape["beam"] == 2
+        assert mesh.shape["beam"] * mesh.shape["dm"] == len(
+            mesh.devices.reshape(-1)
+        )
+
+    def test_process_local_slice_covers_axis(self):
+        from peasoup_tpu.parallel import multihost
+
+        mesh = multihost.global_mesh({"dm": -1})
+        lo, hi = multihost.process_local_slice(mesh, "dm")
+        assert (lo, hi) == (0, mesh.shape["dm"])  # single process
