@@ -40,14 +40,21 @@ def dedisperse_block(
     """
     x_ct = fil_tc.astype(jnp.float32).T * killmask.astype(jnp.float32)[:, None]
 
+    # accumulate channel by channel with a lax.scan: a (D, C, T_out)
+    # shifted tensor would not fit HBM at survey scale (XLA materialises
+    # vmapped dynamic slices before reducing), while the (D, T_out)
+    # carry is one trial block. Channel sums of <=8-bit samples are
+    # exact integers in f32, so the summation order cannot change the
+    # result.
     def one_channel(row: jax.Array, delay: jax.Array) -> jax.Array:
         return jax.lax.dynamic_slice_in_dim(row, delay, out_nsamps)
 
-    def one_trial(trial_delays: jax.Array) -> jax.Array:
-        shifted = jax.vmap(one_channel)(x_ct, trial_delays)  # (C, T_out)
-        return shifted.sum(axis=0)
+    def body(acc, cin):
+        row, dcol = cin  # (T,) samples, (D,) per-trial delays
+        return acc + jax.vmap(lambda d: one_channel(row, d))(dcol), None
 
-    out = jax.vmap(one_trial)(delays)  # (D, T_out)
+    acc0 = jnp.zeros((delays.shape[0], out_nsamps), jnp.float32)
+    out, _ = jax.lax.scan(body, acc0, (x_ct, delays.T))  # (D, T_out)
     if scale != 1.0:
         out = out * jnp.float32(scale)
     if quantize:

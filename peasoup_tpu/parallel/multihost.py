@@ -63,7 +63,11 @@ def initialize(
             process_id=process_id,
         )
     except RuntimeError as exc:
-        if "already initialized" not in str(exc):
+        msg = str(exc)
+        if (
+            "already initialized" not in msg
+            and "should only be called once" not in msg
+        ):
             raise
 
 
@@ -89,9 +93,31 @@ def global_mesh(
 def process_local_slice(mesh: Mesh, axis: str) -> tuple[int, int]:
     """The [start, stop) block of ``axis`` whose shards live on THIS
     process — the host-side work partition for feeding per-process
-    data (e.g. which DM trials this host should stage)."""
-    idx = jax.process_index()
-    n = jax.process_count()
-    size = mesh.shape[axis]
-    per = -(-size // n)
-    return min(idx * per, size), min((idx + 1) * per, size)
+    data (e.g. which DM trials this host should stage).
+
+    Derived from the mesh's ACTUAL device layout: an axis index is
+    local when any device in its hyperplane belongs to this process
+    (an axis that does not cross processes is therefore fully local
+    on every host). Requires the local indices to be contiguous,
+    which the leading-DCN-axis layout of global_mesh guarantees."""
+    import numpy as np
+
+    pid = jax.process_index()
+    axis_pos = mesh.axis_names.index(axis)
+    planes = np.moveaxis(mesh.devices, axis_pos, 0)
+    local = np.asarray(
+        [
+            any(d.process_index == pid for d in np.ravel(plane))
+            for plane in planes
+        ]
+    )
+    idxs = np.nonzero(local)[0]
+    if idxs.size == 0:
+        return 0, 0
+    lo, hi = int(idxs[0]), int(idxs[-1]) + 1
+    if idxs.size != hi - lo:
+        raise ValueError(
+            f"axis {axis!r} is not contiguous across this process; "
+            "lay the cross-process axis leading (global_mesh dcn_axis)"
+        )
+    return lo, hi

@@ -116,6 +116,14 @@ def _level_windows(
     return np.asarray(rows, dtype=np.int32)
 
 
+def _is_oom(exc: Exception) -> bool:
+    """Device out-of-memory signature (XLA compile- or run-time)."""
+    msg = str(exc)
+    return "RESOURCE_EXHAUSTED" in msg or (
+        "memory" in msg.lower() and "hbm" in msg.lower()
+    )
+
+
 def _densify_ragged(
     vi: np.ndarray, vs: np.ndarray, cc: np.ndarray
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -389,7 +397,7 @@ class PeasoupSearch:
                 )
 
         # chunk sizing: a PER-CHIP block of d_local trials, auto-sized
-        # from a working-set budget of ~12 spectrum-sized f32 arrays per
+        # from a working-set budget of ~16 spectrum-sized f32 arrays per
         # (dm, accel) cell. The device call covers d_local * n_dev
         # trials; keeping the per-chip shape independent of the device
         # count makes sharded and single-device results bitwise
@@ -405,26 +413,34 @@ class PeasoupSearch:
             - self.WAVE_BUDGET,
         )
         mem_budget = max(mem_budget, 500_000_000)
-        chunks: list[tuple[list[int], int]] = []  # (dm indices, dm_block)
-        for padded, dm_indices in sorted(by_bucket.items()):
-            if cfg.dm_block > 0:
-                d_local = cfg.dm_block
-            else:
-                cells = max(8, int(mem_budget / (size_spec_b * 12)))
-                d_local = max(1, min(128, cells // max(1, padded)))
-                # equalise: 59 trials at d_local=56 would pad a 3-trial
-                # tail chunk to 56 rows of device work; split evenly
-                # instead (30+29 -> 30+30). Derived from the GLOBAL
-                # trial count only, so the per-chip block shape — and
-                # therefore the XLA program and its bitwise results —
-                # stays independent of the device count
-                n_parts = -(-len(dm_indices) // d_local)
-                d_local = -(-len(dm_indices) // n_parts)
-            d_blk = d_local * len(devices)
-            chunks.extend(
-                (dm_indices[s : s + d_blk], d_blk)
-                for s in range(0, len(dm_indices), d_blk)
-            )
+
+        def build_chunks(shrink: int) -> list[tuple[list[int], int]]:
+            """(dm indices, dm_block) chunks; ``shrink`` halves the
+            auto block size on device-OOM retries."""
+            out: list[tuple[list[int], int]] = []
+            for padded, dm_indices in sorted(by_bucket.items()):
+                if cfg.dm_block > 0:
+                    d_local = max(1, cfg.dm_block // shrink)
+                else:
+                    cells = max(8, int(mem_budget / (size_spec_b * 16)))
+                    d_local = max(
+                        1, min(128, cells // max(1, padded)) // shrink
+                    )
+                    # equalise: 59 trials at d_local=56 would pad a
+                    # 3-trial tail chunk to 56 rows of device work;
+                    # split evenly instead (30+29 -> 30+30). Derived
+                    # from the GLOBAL trial count only, so the per-chip
+                    # block shape — and therefore the XLA program and
+                    # its bitwise results — stays independent of the
+                    # device count
+                    n_parts = -(-len(dm_indices) // d_local)
+                    d_local = -(-len(dm_indices) // n_parts)
+                d_blk = d_local * len(devices)
+                out.extend(
+                    (dm_indices[s : s + d_blk], d_blk)
+                    for s in range(0, len(dm_indices), d_blk)
+                )
+            return out
 
         # wave sizing: bound the live device output buffers (and give the
         # checkpoint a save point per wave)
@@ -438,68 +454,51 @@ class PeasoupSearch:
             mp = max(cfg.max_peaks, self._learned_max_peaks)
             return d_blk * (cfg.nharmonics + 1) * padded * mp * 8
 
-        waves: list[list[tuple[list[int], int]]] = []
-        wave: list[tuple[list[int], int]] = []
-        wave_bytes = 0
-        for chunk in chunks:
-            if wave and wave_bytes + chunk_out_bytes(chunk) > self.WAVE_BUDGET:
+        def build_waves(chunks):
+            waves: list[list[tuple[list[int], int]]] = []
+            wave: list[tuple[list[int], int]] = []
+            wave_bytes = 0
+            for chunk in chunks:
+                if wave and (
+                    wave_bytes + chunk_out_bytes(chunk) > self.WAVE_BUDGET
+                ):
+                    waves.append(wave)
+                    wave, wave_bytes = [], 0
+                wave.append(chunk)
+                wave_bytes += chunk_out_bytes(chunk)
+            if wave:
                 waves.append(wave)
-                wave, wave_bytes = [], 0
-            wave.append(chunk)
-            wave_bytes += chunk_out_bytes(chunk)
-        if wave:
-            waves.append(wave)
+            return waves
 
         progress = ProgressBar() if cfg.progress_bar else None
         if progress:
             progress.start()
-        n_done = 0
-        for wave in waves:
-            todo = [
-                c for c in wave
-                if not all(d in per_dm_results for d in c[0])
-            ]
-            if todo:
-                with trace_span("DM-Loop"):  # NVTX parity: pipeline_multi.cu:144
-                    try:
-                        self._search_wave(
-                            todo, accel_lists, trials, tim_len, zapmask_dev,
-                            windows, self._active_search_block,
-                            per_dm_results,
-                            size=size, nsamps_valid=nsamps_valid,
-                            pos5=pos5, pos25=pos25, tsamp=fil.tsamp,
-                        )
-                    except Exception as exc:
-                        # the oracle probe runs at a reduced shape; if
-                        # the Pallas kernel still fails at the full
-                        # production shape (e.g. SMEM accel-table
-                        # pressure), fall back to the jnp resample and
-                        # redo the wave rather than crash the search
-                        if pallas_block == 0:
-                            raise
-                        import warnings
+        shrink = 1
+        while True:
+            chunks = build_chunks(shrink)
+            try:
+                self._run_waves(
+                    build_waves(chunks), len(chunks), per_dm_results, ckpt,
+                    progress, build_search, accel_lists,
+                    trials, tim_len, zapmask_dev, windows,
+                    size=size, nsamps_valid=nsamps_valid, pos5=pos5,
+                    pos25=pos25, tsamp=fil.tsamp,
+                )
+                break
+            except Exception as exc:
+                # device OOM: the per-cell working-set heuristic is an
+                # estimate; halve the block and retry (finished trials
+                # are in per_dm_results and are not re-searched)
+                max_blk = max(d for _, d in chunks)
+                if not _is_oom(exc) or max_blk <= len(devices):
+                    raise
+                import warnings
 
-                        warnings.warn(
-                            "search wave failed with the Pallas resample "
-                            f"enabled ({exc!r}); retrying without Pallas"
-                        )
-                        pallas_block = 0
-                        self._cur_pallas_block = 0
-                        self._active_search_block = build_search(
-                            0, getattr(self, "_pallas_peaks", False)
-                        )
-                        self._search_wave(
-                            todo, accel_lists, trials, tim_len, zapmask_dev,
-                            windows, self._active_search_block,
-                            per_dm_results,
-                            size=size, nsamps_valid=nsamps_valid,
-                            pos5=pos5, pos25=pos25, tsamp=fil.tsamp,
-                        )
-                if ckpt is not None:
-                    ckpt.save(per_dm_results)
-            n_done += len(wave)
-            if progress:
-                progress.update(n_done / len(chunks))
+                warnings.warn(
+                    f"device OOM at dm_block={max_blk}; retrying with "
+                    f"half-size blocks ({exc!s:.200})"
+                )
+                shrink *= 2
         if progress:
             progress.stop()
         timers["search_device"] = time.time() - t0
@@ -591,6 +590,60 @@ class PeasoupSearch:
             size=size,
             n_accel_trials=sum(len(a) for a in accel_lists),
         )
+
+    def _run_waves(
+        self, waves, n_chunks, per_dm_results, ckpt, progress, build_search,
+        accel_lists, trials, tim_len, zapmask_dev, windows,
+        *, size, nsamps_valid, pos5, pos25, tsamp,
+    ) -> None:
+        disp = dict(
+            size=size, nsamps_valid=nsamps_valid, pos5=pos5, pos25=pos25,
+            tsamp=tsamp,
+        )
+        n_done = 0
+        for wave in waves:
+            todo = [
+                c for c in wave
+                if not all(d in per_dm_results for d in c[0])
+            ]
+            if todo:
+                with trace_span("DM-Loop"):  # NVTX parity: pipeline_multi.cu:144
+                    try:
+                        self._search_wave(
+                            todo, accel_lists, trials, tim_len, zapmask_dev,
+                            windows, self._active_search_block,
+                            per_dm_results, **disp,
+                        )
+                    except Exception as exc:
+                        # the oracle probe runs at a reduced shape; if
+                        # the Pallas kernel still fails at the full
+                        # production shape (e.g. SMEM accel-table
+                        # pressure), fall back to the jnp resample and
+                        # redo the wave rather than crash the search.
+                        # Device OOMs are NOT a Pallas failure: let the
+                        # outer shrink-retry handle them
+                        if _is_oom(exc) or self._cur_pallas_block == 0:
+                            raise
+                        import warnings
+
+                        warnings.warn(
+                            "search wave failed with the Pallas resample "
+                            f"enabled ({exc!r}); retrying without Pallas"
+                        )
+                        self._cur_pallas_block = 0
+                        self._active_search_block = build_search(
+                            0, getattr(self, "_pallas_peaks", False)
+                        )
+                        self._search_wave(
+                            todo, accel_lists, trials, tim_len, zapmask_dev,
+                            windows, self._active_search_block,
+                            per_dm_results, **disp,
+                        )
+                if ckpt is not None:
+                    ckpt.save(per_dm_results)
+            n_done += len(wave)
+            if progress:
+                progress.update(n_done / n_chunks)
 
     def _distill_trials_segmented(
         self, dm_plan, accel_lists, per_dm_results, factors, harm_finder,
