@@ -426,6 +426,24 @@ class PeasoupSearch:
                     d_local = max(
                         1, min(128, cells // max(1, padded)) // shrink
                     )
+                    # fewer, fuller dispatches beat conservative ones
+                    # (each wave pays fixed transfer round trips), so
+                    # on the first attempt try the whole bucket as ONE
+                    # chunk whenever an optimistic estimate fits — the
+                    # OOM shrink-retry is the safety net for the
+                    # workloads where the estimate is wrong. The
+                    # per-chip shape is the GLOBAL bucket size (not
+                    # divided by device count), preserving the bitwise
+                    # sharded == single-device invariant above
+                    one_shot = len(dm_indices)
+                    est = one_shot * padded * size_spec_b * 12
+                    if (
+                        shrink == 1
+                        and one_shot <= 128
+                        and est < 0.9 * self.TOTAL_HBM
+                        - (0 if spill else trials_bytes)
+                    ):
+                        d_local = max(d_local, one_shot)
                     # equalise: 59 trials at d_local=56 would pad a
                     # 3-trial tail chunk to 56 rows of device work;
                     # split evenly instead (30+29 -> 30+30). Derived
@@ -618,11 +636,14 @@ class PeasoupSearch:
                         # the oracle probe runs at a reduced shape; if
                         # the Pallas kernel still fails at the full
                         # production shape (e.g. SMEM accel-table
-                        # pressure), fall back to the jnp resample and
-                        # redo the wave rather than crash the search.
-                        # Device OOMs are NOT a Pallas failure: let the
-                        # outer shrink-retry handle them
-                        if _is_oom(exc) or self._cur_pallas_block == 0:
+                        # pressure — reported as RESOURCE_EXHAUSTED
+                        # like a plain HBM OOM), fall back to the jnp
+                        # resample and redo the wave. A true HBM OOM
+                        # repeats on the retry below, whose exception
+                        # is unwrapped and reaches the outer
+                        # shrink-retry; only with no Pallas active is
+                        # an error re-raised immediately
+                        if self._cur_pallas_block == 0:
                             raise
                         import warnings
 
