@@ -66,12 +66,15 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     out = args.outfilename or get_default_ffa_output_filename()
+    from .peasoup import apply_platform_env
+
+    apply_platform_env()
 
     from ..io import read_filterbank
     from ..io.masks import read_killfile
     from ..io.xml_writer import Element
     from ..ops.dedisperse import dedisperse, fil_to_device, output_scale
-    from ..ops.ffa import collapse_periods, ffa_search_series
+    from ..ops.ffa import ffa_search_block
     from ..plan.dm_plan import DMPlan
     from ..utils import ProgressBar
 
@@ -101,24 +104,20 @@ def main(argv=None) -> int:
     progress = ProgressBar() if args.progress_bar else None
     if progress:
         progress.start()
-    cands = []
-    for dm_idx, dm in enumerate(dm_plan.dm_list):
-        cands.extend(
-            ffa_search_series(
-                trials[dm_idx].astype(np.float32), fil.tsamp,
-                args.p_start, args.p_end, args.min_dc,
-                dm=float(dm), snr_min=args.min_snr,
-            )
-        )
-        if progress:
-            progress.update((dm_idx + 1) / dm_plan.ndm)
-        if args.verbose:
-            print(f"DM {dm:.3f}: {len(cands)} candidates so far")
+    # every octave folds the whole DM-trial block in a handful of
+    # batched dispatches (ops/ffa.py: ffa_search_block)
+    cands = ffa_search_block(
+        trials, fil.tsamp, args.p_start, args.p_end,
+        args.min_dc, dm_plan.dm_list, snr_min=args.min_snr,
+        progress=progress.update if progress else None,
+    )
     if progress:
         progress.stop()
+    if args.verbose:
+        print(f"{len(cands)} period-collapsed candidates")
 
-    # collapse duplicates across DM (keep strongest per period cluster)
-    unique = collapse_periods(cands)[: args.limit]
+    # ffa_search_block returns the cross-DM period-collapsed list
+    unique = cands[: args.limit]
 
     root = Element("ffa_search")
     params = root.append(Element("search_parameters"))

@@ -163,17 +163,24 @@ def duty_cycle_widths(min_dc: float, pmax: int = _PMAX) -> tuple[int, ...]:
 def _octave_fn(m_pad: int, widths: tuple[int, ...]):
     """One compiled program searches EVERY base period of an octave:
     vmap over the (P = PMAX - PMIN) p0 values of the fixed-shape
-    transform + matched filter."""
+    transform + matched filter. Input may be a single series (N,) or a
+    BLOCK of DM trials (D, N) — the whole block folds in one dispatch."""
 
     @jax.jit
     def run(x: jax.Array) -> FFAOctaveResult:
         p0s = jnp.arange(_PMIN, _PMAX, dtype=jnp.int32)
 
-        def one(p0):
-            prof = ffa_transform(x, p0, m_pad)
-            return boxcar_snr(prof, p0, widths)
+        def series(xi):
+            def one(p0):
+                prof = ffa_transform(xi, p0, m_pad)
+                return boxcar_snr(prof, p0, widths)
 
-        snr, w, ph = jax.vmap(one)(p0s)
+            return jax.vmap(one)(p0s)
+
+        if x.ndim == 2:
+            snr, w, ph = jax.vmap(series)(x)  # (D, P, m_pad)
+        else:
+            snr, w, ph = series(x)
         return FFAOctaveResult(snr=snr, width=w, phase=ph)
 
     return run
@@ -187,24 +194,59 @@ class FFACandidate(NamedTuple):
     dc: float  # duty cycle = width / period_bins
 
 
-def ffa_search_series(
-    x: np.ndarray,  # (N,) f32 dedispersed, whitened time series
+def _extract_octave(
+    snr: np.ndarray,  # (P, m_pad) per-(p0, row) best S/N
+    wid: np.ndarray,
+    n: int,
+    tcur: float,
+    p_start: float,
+    p_end: float,
+    snr_min: float,
+    dm: float,
+    m_pad: int,
+    out: list,
+) -> None:
+    for pi in range(snr.shape[0]):
+        p0 = _PMIN + pi
+        p_lo, p_hi = p0 * tcur, (p0 + 1) * tcur
+        if p_hi < p_start or p_lo > p_end:
+            continue
+        m = min(max(n // p0, 2), m_pad)
+        row = int(np.argmax(snr[pi, :m]))
+        s = float(snr[pi, row])
+        if s >= snr_min:
+            period = (p0 + row / max(m - 1, 1)) * tcur
+            if p_start <= period <= p_end:
+                out.append(
+                    FFACandidate(
+                        period=period,
+                        dm=dm,
+                        snr=s,
+                        width=int(wid[pi, row]),
+                        dc=float(wid[pi, row]) / p0,
+                    )
+                )
+
+
+def ffa_search_block(
+    trials: np.ndarray,  # (D, N) f32 dedispersed time series
     tsamp: float,
     p_start: float,
     p_end: float,
     min_dc: float,
-    dm: float = 0.0,
+    dms,  # (D,) DM values for candidate tagging
     snr_min: float = 6.0,
+    hbm_budget: int = 2_000_000_000,
+    progress=None,  # optional callable(fraction in [0, 1])
 ) -> list[FFACandidate]:
-    """Full staircase FFA search of one time series over [p_start,
-    p_end] seconds. Downsamples by 2 per octave so base periods stay
-    in the [PMIN, PMAX) bucket; each octave runs one compiled program.
-    """
-    x = np.asarray(x, dtype=np.float32)
-    x = x - x.mean()
-    # initial downsampling so p_start lands at >= PMIN bins
+    """Full staircase FFA search of a BLOCK of DM trials: each octave
+    folds every trial in as few compiled dispatches as the working set
+    allows (vs one dispatch per trial per octave). Downsamples by 2
+    per octave so base periods stay in the [PMIN, PMAX) bucket."""
+    X = np.asarray(trials, dtype=np.float32)
+    X = X - X.mean(axis=1, keepdims=True)
     ds = max(1, int(p_start / tsamp / _PMIN))
-    xd = x[: len(x) // ds * ds].reshape(-1, ds).sum(axis=1)
+    Xd = X[:, : X.shape[1] // ds * ds].reshape(X.shape[0], -1, ds).sum(axis=2)
     tcur = tsamp * ds
     if p_start < _PMIN * tcur:
         import warnings
@@ -215,34 +257,34 @@ def ffa_search_series(
             f"bins of the {tcur:.6f} s downsampled series"
         )
     cands: list[FFACandidate] = []
+    n_oct = max(
+        1, int(np.ceil(np.log2(max(2.0, p_end / (_PMIN * tcur)))))
+    )
+    oct_i = 0
     while _PMIN * tcur < p_end:
-        n = len(xd)
+        n = Xd.shape[1]
         m_pad = 1 << max(1, int(np.ceil(np.log2(max(2, n // _PMIN)))))
         widths = duty_cycle_widths(min_dc)
-        res = _octave_fn(m_pad, widths)(jnp.asarray(xd))
-        snr = np.asarray(res.snr)
-        wid = np.asarray(res.width)
-        for pi in range(snr.shape[0]):
-            p0 = _PMIN + pi
-            p_lo, p_hi = p0 * tcur, (p0 + 1) * tcur
-            if p_hi < p_start or p_lo > p_end:
-                continue
-            m = min(max(n // p0, 2), m_pad)
-            row = int(np.argmax(snr[pi, :m]))
-            s = float(snr[pi, row])
-            if s >= snr_min:
-                period = (p0 + row / max(m - 1, 1)) * tcur
-                if p_start <= period <= p_end:
-                    cands.append(
-                        FFACandidate(
-                            period=period,
-                            dm=dm,
-                            snr=s,
-                            width=int(wid[pi, row]),
-                            dc=float(wid[pi, row]) / p0,
-                        )
-                    )
-        if len(xd) < 4 * _PMAX:
+        # working set ~ (P, m_pad, PMAX) f32 profiles per trial
+        per_trial = (_PMAX - _PMIN) * m_pad * _PMAX * 4 * 3
+        d_blk = max(1, min(Xd.shape[0], hbm_budget // per_trial))
+        fn = _octave_fn(m_pad, widths)
+        for s0 in range(0, Xd.shape[0], d_blk):
+            blk = Xd[s0 : s0 + d_blk]
+            if blk.shape[0] < d_blk:  # fixed shape -> one compile
+                blk = np.pad(blk, ((0, d_blk - blk.shape[0]), (0, 0)))
+            res = fn(jnp.asarray(blk))
+            snr = np.asarray(res.snr)
+            wid = np.asarray(res.width)
+            for d in range(min(d_blk, Xd.shape[0] - s0)):
+                _extract_octave(
+                    snr[d], wid[d], n, tcur, p_start, p_end, snr_min,
+                    float(dms[s0 + d]), m_pad, cands,
+                )
+        oct_i += 1
+        if progress is not None:
+            progress(min(1.0, oct_i / n_oct))
+        if Xd.shape[1] < 4 * _PMAX:
             if 2 * _PMIN * tcur < p_end:
                 import warnings
 
@@ -252,9 +294,28 @@ def ffa_search_series(
                     f"longer periods meaningfully"
                 )
             break
-        xd = xd[: len(xd) // 2 * 2].reshape(-1, 2).sum(axis=1)
+        Xd = Xd[:, : Xd.shape[1] // 2 * 2].reshape(
+            Xd.shape[0], -1, 2
+        ).sum(axis=2)
         tcur *= 2
     return collapse_periods(cands)
+
+
+def ffa_search_series(
+    x: np.ndarray,  # (N,) f32 dedispersed, whitened time series
+    tsamp: float,
+    p_start: float,
+    p_end: float,
+    min_dc: float,
+    dm: float = 0.0,
+    snr_min: float = 6.0,
+) -> list[FFACandidate]:
+    """Full staircase FFA search of one time series over [p_start,
+    p_end] seconds (single-trial convenience over ffa_search_block)."""
+    return ffa_search_block(
+        np.asarray(x)[None, :], tsamp, p_start, p_end, min_dc,
+        [dm], snr_min=snr_min,
+    )
 
 
 def collapse_periods(
