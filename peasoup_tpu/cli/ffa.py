@@ -104,12 +104,18 @@ def main(argv=None) -> int:
     progress = ProgressBar() if args.progress_bar else None
     if progress:
         progress.start()
+    if progress:
+        on_progress = progress.update
+    elif args.verbose:
+        on_progress = lambda f: print(f"FFA octaves: {f * 100:5.1f}% done")
+    else:
+        on_progress = None
     # every octave folds the whole DM-trial block in a handful of
     # batched dispatches (ops/ffa.py: ffa_search_block)
     cands = ffa_search_block(
         trials, fil.tsamp, args.p_start, args.p_end,
         args.min_dc, dm_plan.dm_list, snr_min=args.min_snr,
-        progress=progress.update if progress else None,
+        progress=on_progress,
     )
     if progress:
         progress.stop()

@@ -89,8 +89,12 @@ def apply_platform_env() -> None:
         import jax
 
         jax.config.update("jax_compilation_cache_dir", cache)
-        # cache everything (default floor would skip fast compiles)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        # cache everything (default floor would skip fast compiles),
+        # unless the operator set their own floor via the env var
+        if "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS" not in os.environ:
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.0
+            )
     except Exception:
         pass  # read-only home etc.: run without the persistent cache
 

@@ -7,10 +7,10 @@ and adds only the odd-k/2^h gathers).
 
 TPU design. The reference's float index expression (int)(i*k/2^h + 0.5)
 is EXACT integer math: (i*k + 2^(h-1)) >> h (the double value is exactly
-representable, truncation == floor). Two implementations:
+representable, truncation == floor). Four implementations:
 
 * ``method="take"``: direct batched jnp.take gathers — the oracle.
-* ``method="mxu"`` (default): the gather index map is PERIODIC in the
+* ``method="mxu"``: the gather index map is PERIODIC in the
   output index: writing i = q*2^h + r, src(i) = q*k + c_r with
   c_r = (r*k + 2^(h-1)) >> h a compile-time constant <= k. So the
   whole level-h harmonic-k gather is
@@ -23,7 +23,40 @@ representable, truncation == floor). Two implementations:
   irregular gather becomes an MXU matmul. Because each C column is
   one-hot, the matmul result is the exact gather value (zeros add
   exactly), so "mxu" and "take" agree bitwise in f32 (tests assert
-  equality; Precision.HIGHEST keeps f32 exactness on the MXU).
+  equality).
+* ``method="conv"`` (default): every (h, k) gather is a STRIDED 1-D
+  CONVOLUTION. At output period P = 128 (one full lane vector),
+  i = q*P + r: src(i) = q*s + c_r with s = P*k >> h (integral for
+  h <= 7) and c_r = (r*k + 2^(h-1)) >> h <= s. So the gather is
+  conv_general_dilated(p[None, :, None], W, stride=s, VALID) with the
+  (s+1, 1, P) one-hot taps W[c_r, 0, r] = 1: conv windows overlap
+  natively (no materialized X, no edge-column hack), the MXU
+  contraction is the window (s+1 <= 121), and the (Q, P) output
+  merges to natural bin order for FREE because P is exactly the lane
+  width. Gathers are summed one `+` at a time in reference order, so
+  "conv" is bitwise-identical to "take"/"mxu" (tests assert it).
+  Measured 3.3x faster than "mxu" at production shapes on v5e.
+* ``method="fused"``: "mxu" wastes >85% of the 128-deep MXU
+  contraction (k+1 <= 16 per matmul, 15 matmuls for nharms=4). At the
+  coarser output period 2^H (H = nharms), EVERY (h, k) gather shares
+  one row decomposition: writing i = q*2^H + r (r < 2^H),
+  src(i) = q*s + c_r with stride s = k*2^(H-h) and
+  c_r = (r*k + 2^(h-1)) >> h <= s (the split is exact because
+  q*2^H*k is divisible by 2^h). Stacking the per-(h,k) windows
+  X_hk[q, c] = p[q*s + c] (c <= s) along the contraction axis and the
+  one-hot columns into a block-diagonal-ish constant C with one output
+  column group of width 2^H per LEVEL gives all nharms levels'
+  fresh-gather sums in ONE matmul with contraction
+  sum(s_hk + 1) (= 135 for nharms=4) — near-full MXU depth. A cumsum
+  over the tiny level axis then forms the reference's cumulative sums.
+  Per-level results differ from "take" only by f32 summation order
+  (each level's odd-k gathers are summed in the MXU accumulator
+  instead of one `+` at a time).
+
+Both matmul methods need Precision.HIGHEST: only the 3-term bf16
+operand split (24 mantissa bits) keeps products with the 0/1
+constants — and therefore the gathered values — exact; HIGH's 2-term
+split loses the low 8 mantissa bits (measured ~5e-6 rel error).
 """
 
 from __future__ import annotations
@@ -61,17 +94,97 @@ def _gather_mxu(p: jnp.ndarray, nbins_pad: int, k: int, h: int) -> jnp.ndarray:
     return out.reshape(*p.shape[:-1], nbins_pad)
 
 
+_CONV_P = 128  # conv output period = the f32 lane width
+
+
+@lru_cache(maxsize=None)
+def _conv_taps(k: int, h: int) -> np.ndarray:
+    """(s+1, 1, P) one-hot conv filter with W[c_r, 0, r] = 1,
+    c_r = (r*k + 2^(h-1)) >> h, s = P*k >> h."""
+    s = (_CONV_P * k) >> h
+    r = np.arange(_CONV_P)
+    c_r = (r * k + (1 << (h - 1))) >> h
+    W = np.zeros((s + 1, 1, _CONV_P), dtype=np.float32)
+    W[c_r, 0, r] = 1.0
+    return W
+
+
+def _gather_conv(x: jnp.ndarray, Q: int, k: int, h: int) -> jnp.ndarray:
+    """out[..., i] = p[..., (i*k + 2^(h-1)) >> h] for i < Q*P via one
+    strided conv. ``x`` is the padded spectrum as (rows, >=Q*s+1, 1)."""
+    s = (_CONV_P * k) >> h
+    g = jax.lax.conv_general_dilated(
+        x, jnp.asarray(_conv_taps(k, h)),
+        window_strides=(s,), padding="VALID",
+        dimension_numbers=("NWC", "WIO", "NWC"),
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    return g[:, :Q]  # (rows, Q, P)
+
+
+@lru_cache(maxsize=None)
+def _fused_blocks(nharms: int) -> tuple[tuple[tuple[int, int, int], ...], np.ndarray]:
+    """Contraction-block layout for the fused formulation.
+
+    Returns (blocks, C): blocks is a tuple of (h, k, s) with
+    s = k*2^(nharms-h) for every level h in 1..nharms and odd k < 2^h;
+    C is the (sum(s+1), nharms*2^nharms) f32 constant with
+    C[base_hk + c, (h-1)*2^nharms + r] = 1 iff (r*k + 2^(h-1)) >> h == c.
+    """
+    H = nharms
+    blocks = []
+    for h in range(1, H + 1):
+        for k in range(1, 1 << h, 2):
+            blocks.append((h, k, k << (H - h)))
+    K = sum(s + 1 for _, _, s in blocks)
+    C = np.zeros((K, H << H), dtype=np.float32)
+    base = 0
+    r = np.arange(1 << H)
+    for h, k, s in blocks:
+        c_r = (r * k + (1 << (h - 1))) >> h
+        C[base + c_r, ((h - 1) << H) + r] = 1.0
+        base += s + 1
+    return tuple(blocks), C
+
+
+def _fused_level_sums(p: jnp.ndarray, nharms: int) -> jnp.ndarray:
+    """(..., nharms, nbins_pad) per-LEVEL fresh-gather sums
+    sum_{k odd < 2^h} p[(i*k + 2^(h-1)) >> h] via one MXU matmul.
+    ``p`` must be padded so indices up to Q*max(s) are in range."""
+    blocks, C = _fused_blocks(nharms)
+    H = nharms
+    nbins_pad = (p.shape[-1] - 1) >> H << H  # caller pads to mult + 1
+    Q = nbins_pad >> H
+    cols = []
+    for _, _, s in blocks:
+        # window X_hk[q, c] = p[q*s + c], c in [0, s]: a contiguous
+        # reshape for c < s plus one strided slice for the edge c == s
+        cols.append(p[..., : Q * s].reshape(*p.shape[:-1], Q, s))
+        cols.append(p[..., s : s * Q + 1 : s][..., None])
+    x = jnp.concatenate(cols, axis=-1)  # (..., Q, K)
+    out = jnp.einsum(
+        "...qc,cr->...qr", x, jnp.asarray(C),
+        precision=jax.lax.Precision.HIGHEST,
+    )  # (..., Q, H*2^H)
+    out = out.reshape(*p.shape[:-1], Q, H, 1 << H)
+    out = jnp.moveaxis(out, -2, -3)  # (..., H, Q, 2^H)
+    return out.reshape(*p.shape[:-1], H, nbins_pad)
+
+
 @partial(jax.jit, static_argnames=("nharms", "method"))
 def harmonic_sums(
-    p: jnp.ndarray, *, nharms: int = 4, method: str = "mxu"
+    p: jnp.ndarray, *, nharms: int = 4, method: str = "conv"
 ) -> list[jnp.ndarray]:
     """Cumulative fractional-harmonic sums of a spectrum.
 
     Args:
       p: (..., nbins) float32 spectrum (normalised).
       nharms: number of fold levels (<= 5, like the unrolled kernel).
-      method: "mxu" (strided-reshape + one-hot matmul) or "take"
-        (direct gather); bitwise-identical results.
+      method: "conv" (one strided conv per (level, harmonic); fastest),
+        "mxu" (one one-hot matmul per (level, harmonic)), "take"
+        (direct gather) — all three bitwise-identical — or "fused"
+        (all levels in one near-full-depth MXU matmul; differs only
+        in f32 summation order).
 
     Returns a list of ``nharms`` arrays shaped like ``p``; entry h-1 is
     the 2^h-harmonic sum scaled by rsqrt(2^h).
@@ -79,6 +192,20 @@ def harmonic_sums(
     if not 0 < nharms <= 5:
         raise ValueError("nharms must be in 1..5")
     nbins = p.shape[-1]
+    if method == "conv":
+        P = _CONV_P
+        npad = -(-nbins // P) * P
+        Q = npad // P
+        # src indices for i < nbins stay < nbins, so zero pad is inert
+        pp = jnp.pad(p, [(0, 0)] * (p.ndim - 1) + [(0, npad + 1 - nbins)])
+        x = pp.reshape(-1, pp.shape[-1], 1)
+        out, val = [], p
+        for h in range(1, nharms + 1):
+            for k in range(1, 1 << h, 2):  # odd: new gathers this level
+                g = _gather_conv(x, Q, k, h)
+                val = val + g.reshape(*p.shape[:-1], Q * P)[..., :nbins]
+            out.append(val * jnp.float32(2.0 ** (-h / 2.0)))
+        return out
     if method == "take":
         i = jnp.arange(nbins, dtype=jnp.int32)
         out = []
@@ -90,14 +217,24 @@ def harmonic_sums(
                 val = val + jnp.take(p, src, axis=-1)
             out.append(val * jnp.float32(2.0 ** (-h / 2.0)))
         return out
-    if method != "mxu":
-        raise ValueError(f"unknown method {method!r}")
 
     align = 1 << nharms
     nbins_pad = (nbins + align - 1) // align * align
     # strided slices below reach at most nbins_pad + align source bins;
     # src indices for i < nbins stay < nbins, so the zero pad is inert
     pp = jnp.pad(p, [(0, 0)] * (p.ndim - 1) + [(0, nbins_pad + align - nbins)])
+
+    if method == "fused":
+        fresh = _fused_level_sums(pp, nharms)  # (..., H, nbins_pad)
+        cum = p[..., None, :] + jnp.cumsum(fresh[..., :nbins], axis=-2)
+        scales = jnp.asarray(
+            [2.0 ** (-h / 2.0) for h in range(1, nharms + 1)], jnp.float32
+        )
+        cum = cum * scales[:, None]
+        return [cum[..., h, :] for h in range(nharms)]
+    if method != "mxu":
+        raise ValueError(f"unknown method {method!r}")
+
     out = []
     val = p
     for h in range(1, nharms + 1):

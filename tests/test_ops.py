@@ -229,15 +229,30 @@ class TestHarmonics:
         np.testing.assert_allclose(np.asarray(outs[0][1]), np.asarray(single[0]))
 
     @pytest.mark.parametrize("nbins", [96, 256, 1000, 4097])
-    def test_mxu_matches_take_bitwise(self, rng, nbins):
-        """The one-hot-matmul formulation must reproduce the direct
-        gather EXACTLY (one-hot columns -> exact values; zero adds are
-        exact), on awkward non-multiple-of-32 sizes too."""
+    @pytest.mark.parametrize("method", ["mxu", "conv"])
+    def test_matmul_methods_match_take_bitwise(self, rng, nbins, method):
+        """The one-hot matmul/conv formulations must reproduce the
+        direct gather EXACTLY (one-hot taps -> exact values; zero adds
+        are exact; reference summation order preserved), on awkward
+        non-multiple-of-32 sizes too."""
         p = rng.normal(size=(2, nbins)).astype(np.float32)
-        mxu = harmonic_sums(jnp.asarray(p), nharms=5, method="mxu")
+        got = harmonic_sums(jnp.asarray(p), nharms=5, method=method)
         take = harmonic_sums(jnp.asarray(p), nharms=5, method="take")
-        for a, b in zip(mxu, take):
+        for a, b in zip(got, take):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    @pytest.mark.parametrize("nbins", [96, 1000, 4097])
+    def test_fused_matches_take_to_ulp(self, rng, nbins):
+        """"fused" sums each level's gathers in the MXU accumulator
+        instead of one at a time — equal to "take" up to f32
+        summation-order ULPs."""
+        p = rng.normal(size=(2, nbins)).astype(np.float32)
+        fused = harmonic_sums(jnp.asarray(p), nharms=5, method="fused")
+        take = harmonic_sums(jnp.asarray(p), nharms=5, method="take")
+        for a, b in zip(fused, take):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-6, atol=2e-6
+            )
 
 
 class TestPeaks:
