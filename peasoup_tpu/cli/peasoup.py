@@ -107,7 +107,7 @@ def main(argv: list[str] | None = None) -> int:
     # Heavy imports after arg parsing so --help stays fast
     from ..io.output import CandidateFileWriter, OutputFileWriter
     from ..io.sigproc import read_filterbank
-    from ..pipeline.search import PeasoupSearch, SearchConfig
+    from ..pipeline.search import SearchConfig
 
     cfg = SearchConfig(
         outdir=outdir,
@@ -143,8 +143,17 @@ def main(argv: list[str] | None = None) -> int:
     fil = read_filterbank(args.inputfile)
     reading = time.time() - t0
 
-    result = PeasoupSearch(cfg).run(fil)
+    # multi-host aware (JAX_COORDINATOR_ADDRESS & co.): each process
+    # searches its DM slice; single-process this is PeasoupSearch.run
+    from ..parallel.multihost import run_search
+
+    result = run_search(fil, cfg)
     result.timers["reading"] = reading
+
+    import jax
+
+    if jax.process_index() != 0:
+        return 0  # every process holds the identical result; rank 0 writes
 
     writer = CandidateFileWriter(outdir)
     writer.write_binary(result.candidates, "candidates.peasoup")

@@ -48,7 +48,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_BLOCK = 1024  # bins per grid step (128-lane multiple)
+_BLOCK = 4096  # bins per grid step (128-lane multiple); 4096 measured
+# best on v5e (fewer grid steps beats the larger per-step vector work:
+# 34 -> 29 ms per level-call at production shapes; 8192 regresses)
 _SUB = 8  # rows per stripe (f32 sublane quantum)
 _BIG = 1 << 30  # "no crossing" sentinel for the masked min reduction
 

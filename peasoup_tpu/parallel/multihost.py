@@ -90,6 +90,94 @@ def global_mesh(
     return make_mesh(axes, devices=devices)
 
 
+def dm_slice_for_process(
+    ndm: int, num_processes: int, process_id: int
+) -> tuple[int, int]:
+    """Contiguous, balanced [lo, hi) slice of the global DM-trial list
+    for one process (the multi-host analogue of the reference's
+    DMDispenser dealing trials to per-GPU workers,
+    pipeline_multi.cu:54-74 — static dealing keeps it deterministic)."""
+    base, extra = divmod(ndm, num_processes)
+    lo = process_id * base + min(process_id, extra)
+    return lo, lo + base + (1 if process_id < extra else 0)
+
+
+def _allgather_pickled(payload: bytes) -> list[bytes]:
+    """Exchange one pickled blob per process; returns every process's
+    blob in process order. Single-process: identity."""
+    if jax.process_count() == 1:
+        return [payload]
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    # fixed-size exchange: lengths first, then the padded byte arrays
+    n = np.frombuffer(payload, dtype=np.uint8)
+    lens = multihost_utils.process_allgather(
+        np.asarray([n.size], dtype=np.int64)
+    ).reshape(-1)
+    padded = np.zeros(int(lens.max()), dtype=np.uint8)
+    padded[: n.size] = n
+    blobs = multihost_utils.process_allgather(padded)
+    return [bytes(blobs[i, : int(lens[i])]) for i in range(len(lens))]
+
+
+def run_search(fil, config):
+    """Multi-host `peasoup` search: DM-trial data parallelism across
+    processes. Each process dedisperses + searches its contiguous slice
+    of the global DM list on its LOCAL chips (share-nothing, like the
+    reference's per-GPU workers), then per-DM candidates are allgathered
+    over DCN and every process runs the identical global
+    distill/score/fold finalize — folds are computed by the trial's
+    owner process and exchanged, so the final candidate list is
+    identical (and deterministic) on every process.
+
+    Single-process: exactly PeasoupSearch(config).run(fil).
+    """
+    import pickle
+
+    from ..pipeline.search import PartialSearchResult, PeasoupSearch
+
+    initialize()
+    search = PeasoupSearch(config)
+    nproc = jax.process_count()
+    if nproc == 1:
+        return search.run(fil)
+
+    plan = search.build_dm_plan(fil)
+    lo, hi = dm_slice_for_process(plan.ndm, nproc, jax.process_index())
+    part = search.run(fil, dm_slice=(lo, hi), finalize=False)
+
+    blobs = _allgather_pickled(
+        pickle.dumps((part.cands, part.n_accel_trials))
+    )
+    merged_cands, n_trials = [], 0
+    for blob in blobs:  # process order == ascending DM slices
+        cands, n = pickle.loads(blob)
+        merged_cands.extend(cands)
+        n_trials += n
+    merged = PartialSearchResult(
+        cands=merged_cands,
+        trials=part.trials,
+        trials_nsamps=part.trials_nsamps,
+        dm_offset=part.dm_offset,
+        dm_list=plan.dm_list,  # global
+        acc_list_dm0=part.acc_list_dm0,
+        timers=part.timers,
+        nsamps=part.nsamps,
+        size=part.size,
+        n_accel_trials=n_trials,
+        t_total_start=part.t_total_start,
+    )
+
+    def fold_exchange(outcomes: list[dict]) -> list[dict]:
+        out = []
+        for blob in _allgather_pickled(pickle.dumps(outcomes)):
+            out.extend(pickle.loads(blob))
+        return out
+
+    return search.finalize(fil, merged, fold_exchange=fold_exchange)
+
+
 def process_local_slice(mesh: Mesh, axis: str) -> tuple[int, int]:
     """The [start, stop) block of ``axis`` whose shards live on THIS
     process — the host-side work partition for feeding per-process

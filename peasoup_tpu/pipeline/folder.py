@@ -48,8 +48,13 @@ class MultiFolder:
         nints: int = 16,
         pos5_freq: float = 0.05,
         pos25_freq: float = 0.5,
+        dm_offset: int = 0,  # global dm_idx of trials[0] (multi-host
+        # slices hold only their own trial block; candidates outside
+        # [dm_offset, dm_offset + len(trials)) are folded by the owner
+        # process and merged via fold outcomes)
     ):
         self.trials = trials
+        self.dm_offset = dm_offset
         self.nsamps = prev_power_of_two(trials_nsamps)
         self.tsamp = tsamp
         self.tobs = self.nsamps * tsamp
@@ -61,12 +66,37 @@ class MultiFolder:
         self.optimiser = FoldOptimiser(nbins, nints)
 
     def fold_n(self, cands: List[Candidate], n: int) -> List[Candidate]:
+        outcomes = self.fold_outcomes(cands, n)
+        return self.apply_outcomes(cands, outcomes)
+
+    def apply_outcomes(
+        self, cands: List[Candidate], outcomes: list[dict]
+    ) -> List[Candidate]:
+        """Write fold outcomes (possibly gathered from several
+        processes) back onto the candidate list and re-sort by
+        max(snr, folded_snr) (folder.hpp:25-31,433)."""
+        for res in outcomes:
+            ci = res["cand_idx"]
+            cands[ci].folded_snr = res["opt_sn"]
+            cands[ci].opt_period = res["opt_period"]
+            cands[ci].fold = res["opt_fold"]
+        return sorted(cands, key=lambda c: -max(c.snr, c.folded_snr))
+
+    def fold_outcomes(self, cands: List[Candidate], n: int) -> list[dict]:
+        """Fold + optimise the foldable top-``n`` candidates whose DM
+        trial lives in this folder's trial block, returning one outcome
+        dict per candidate (keyed back by ``cand_idx``) instead of
+        mutating the list — the multi-host merge exchanges these."""
         count = min(n, len(cands))
+        ndm_local = len(self.trials)
         dm_map: dict[int, list[int]] = {}
         for ii in range(count):
             p = 1.0 / cands[ii].freq
-            if self.min_period < p < self.max_period:
-                dm_map.setdefault(cands[ii].dm_idx, []).append(ii)
+            if not self.min_period < p < self.max_period:
+                continue
+            local_dm = cands[ii].dm_idx - self.dm_offset
+            if 0 <= local_dm < ndm_local:
+                dm_map.setdefault(local_dm, []).append(ii)
 
         all_folds, all_periods, all_cand_idx = [], [], []
         for dm_idx, cand_ids in dm_map.items():
@@ -111,20 +141,24 @@ class MultiFolder:
             all_periods.extend(periods[:k])
             all_cand_idx.extend(cand_ids)
 
-        if all_cand_idx:
-            folds = np.concatenate(all_folds, axis=0)
-            k = folds.shape[0]
-            k_pad = int(np.ceil(k / self.fold_bucket) * self.fold_bucket)
-            if k_pad > k:  # fixed batch width -> one compiled optimiser
-                reps = int(np.ceil(k_pad / k))
-                folds = np.concatenate([folds] * reps, axis=0)[:k_pad]
-                all_periods = (list(all_periods) * reps)[:k_pad]
-            results = self.optimiser.optimise(
-                folds, np.asarray(all_periods), self.tobs
-            )[:k]
-            for ci, res in zip(all_cand_idx, results):
-                cands[ci].folded_snr = res["opt_sn"]
-                cands[ci].opt_period = res["opt_period"]
-                cands[ci].fold = res["opt_fold"]
-        # re-sort by max(snr, folded_snr) (folder.hpp:25-31,433)
-        return sorted(cands, key=lambda c: -max(c.snr, c.folded_snr))
+        if not all_cand_idx:
+            return []
+        folds = np.concatenate(all_folds, axis=0)
+        k = folds.shape[0]
+        k_pad = int(np.ceil(k / self.fold_bucket) * self.fold_bucket)
+        if k_pad > k:  # fixed batch width -> one compiled optimiser
+            reps = int(np.ceil(k_pad / k))
+            folds = np.concatenate([folds] * reps, axis=0)[:k_pad]
+            all_periods = (list(all_periods) * reps)[:k_pad]
+        results = self.optimiser.optimise(
+            folds, np.asarray(all_periods), self.tobs
+        )[:k]
+        return [
+            {
+                "cand_idx": ci,
+                "opt_sn": res["opt_sn"],
+                "opt_period": res["opt_period"],
+                "opt_fold": res["opt_fold"],
+            }
+            for ci, res in zip(all_cand_idx, results)
+        ]

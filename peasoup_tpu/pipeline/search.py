@@ -98,6 +98,41 @@ class SearchResult:
     n_accel_trials: int = 0  # total DM x accel trials actually searched
 
 
+@dataclass
+class PartialSearchResult:
+    """A search stopped after the per-DM distills (run(finalize=False)):
+    everything PeasoupSearch.finalize needs, per process slice. The
+    reference analogue is one Worker's dm_trial_cands before the join
+    merge (pipeline_multi.cu:356-359)."""
+
+    cands: list  # per-DM-trial candidates, dm_idx GLOBAL
+    trials: object  # this slice's dedispersed trials (device or host)
+    trials_nsamps: int
+    dm_offset: int  # global dm_idx of trials[0]
+    dm_list: np.ndarray  # slice dm values in a per-process partial;
+    # the GLOBAL list in a merged part (finalize copies it into
+    # SearchResult.dm_list, which rank 0 writes to overview.xml)
+    acc_list_dm0: np.ndarray
+    timers: dict
+    nsamps: int
+    size: int
+    n_accel_trials: int
+    t_total_start: float
+
+
+def _offset_dm_idx(cands: list, lo: int) -> None:
+    """Shift local dm_idx to global, through the assoc trees."""
+    seen: set[int] = set()
+    stack = list(cands)
+    while stack:
+        c = stack.pop()
+        if id(c) in seen:
+            continue
+        seen.add(id(c))
+        c.dm_idx += lo
+        stack.extend(c.assoc)
+
+
 def _level_windows(
     size: int, nharms: int, min_freq: float, max_freq: float, tsamp: float
 ) -> np.ndarray:
@@ -192,6 +227,28 @@ class PeasoupSearch:
             self.WAVE_BUDGET = max(int(limit) // 12, 250_000_000)
             self.TRIALS_DEVICE_LIMIT = int(limit) // 3
 
+    def build_dm_plan(self, fil: Filterbank) -> DMPlan:
+        """The GLOBAL dedispersion plan for this config (also used by
+        the multi-host driver to partition the trial list — single
+        construction site keeps the partitioning and the search in
+        sync)."""
+        cfg = self.config
+        killmask = None
+        if cfg.killfilename:
+            killmask = read_killfile(cfg.killfilename, fil.nchans)
+        return DMPlan.create(
+            nsamps=fil.nsamps,
+            nchans=fil.nchans,
+            tsamp=fil.tsamp,
+            fch1=fil.fch1,
+            foff=fil.foff,
+            dm_start=cfg.dm_start,
+            dm_end=cfg.dm_end,
+            pulse_width=cfg.dm_pulse_width,
+            tol=cfg.dm_tol,
+            killmask=killmask,
+        )
+
     def _pick_devices(self) -> list:
         """Devices to shard DM trials over. Auto mode mirrors the
         reference's one-worker-per-GPU-up-to--t policy
@@ -207,27 +264,54 @@ class PeasoupSearch:
             return devs[: min(len(devs), cfg.max_num_threads)]
         return devs[:1]
 
-    def run(self, fil: Filterbank) -> SearchResult:
+    def run(
+        self,
+        fil: Filterbank,
+        dm_slice: tuple[int, int] | None = None,
+        finalize: bool = True,
+    ) -> "SearchResult | PartialSearchResult":
+        """Full search. With ``dm_slice=(lo, hi)`` only that contiguous
+        block of the global DM-trial list is dedispersed and searched
+        (candidates come back with GLOBAL dm_idx); with
+        ``finalize=False`` the run stops after the per-DM distills and
+        returns a PartialSearchResult for the multi-host merge
+        (parallel/multihost.py:run_search)."""
         cfg = self.config
         timers: dict[str, float] = {}
         t_total = time.time()
 
         # --- dedispersion plan + execution ---------------------------------
-        killmask = None
-        if cfg.killfilename:
-            killmask = read_killfile(cfg.killfilename, fil.nchans)
-        dm_plan = DMPlan.create(
-            nsamps=fil.nsamps,
-            nchans=fil.nchans,
-            tsamp=fil.tsamp,
-            fch1=fil.fch1,
-            foff=fil.foff,
-            dm_start=cfg.dm_start,
-            dm_end=cfg.dm_end,
-            pulse_width=cfg.dm_pulse_width,
-            tol=cfg.dm_tol,
-            killmask=killmask,
-        )
+        dm_plan = self.build_dm_plan(fil)
+        dm_lo = 0
+        if dm_slice is not None:
+            dm_lo, dm_hi = dm_slice
+            dm_plan = dm_plan.subset(dm_lo, dm_hi)
+        if dm_plan.ndm == 0:
+            # empty multi-host slice (more processes than DM trials):
+            # contribute zero candidates without touching the device
+            size = choose_fft_size(fil.nsamps, cfg.size)
+            acc_plan = AccelerationPlan(
+                acc_lo=cfg.acc_start, acc_hi=cfg.acc_end, tol=cfg.acc_tol,
+                pulse_width=cfg.acc_pulse_width, nsamps=size,
+                tsamp=fil.tsamp, cfreq=fil.cfreq, bw=fil.foff,
+            )
+            part = PartialSearchResult(
+                cands=[],
+                trials=np.zeros((0, 1), dtype=np.uint8),
+                trials_nsamps=dm_plan.out_nsamps,
+                dm_offset=dm_lo,
+                dm_list=dm_plan.dm_list,
+                acc_list_dm0=acc_plan.generate_accel_list(0.0),
+                timers=dict.fromkeys(
+                    ("dedispersion", "search_device", "search_host",
+                     "searching"), 0.0
+                ),
+                nsamps=fil.nsamps,
+                size=size,
+                n_accel_trials=0,
+                t_total_start=t_total,
+            )
+            return part if not finalize else self.finalize(fil, part)
         t0 = time.time()
         # trials live on device (sliced there per chunk, no re-uploads)
         # unless the whole block would crowd out the search working set
@@ -385,8 +469,13 @@ class PeasoupSearch:
         ckpt = None
         per_dm_results: dict[int, tuple] = {}
         if cfg.checkpoint_file:
+            ckpt_file = cfg.checkpoint_file
+            if dm_slice is not None:
+                # one store per process slice: slices search disjoint
+                # trials and must not clobber each other's results
+                ckpt_file = f"{ckpt_file}.dm{dm_lo}-{dm_hi}"
             ckpt = SearchCheckpoint(
-                cfg.checkpoint_file,
+                ckpt_file,
                 SearchCheckpoint.make_key(cfg, fil, size, dm_plan.ndm),
             )
             per_dm_results = ckpt.load()
@@ -574,12 +663,43 @@ class PeasoupSearch:
         timers["search_host"] = time.time() - t_host
         timers["searching"] = time.time() - t0
 
-        # --- global distilling / scoring / folding --------------------------
+        if dm_lo:
+            _offset_dm_idx(dm_trial_cands.cands, dm_lo)
+        part = PartialSearchResult(
+            cands=dm_trial_cands.cands,
+            trials=trials,
+            trials_nsamps=trials_nsamps,
+            dm_offset=dm_lo,
+            dm_list=dm_plan.dm_list,
+            acc_list_dm0=acc_plan.generate_accel_list(0.0),
+            timers=timers,
+            nsamps=fil.nsamps,
+            size=size,
+            n_accel_trials=sum(len(a) for a in accel_lists),
+            t_total_start=t_total,
+        )
+        if not finalize:
+            return part
+        return self.finalize(fil, part)
+
+    def finalize(
+        self,
+        fil: Filterbank,
+        part: "PartialSearchResult",
+        fold_exchange=None,
+    ) -> SearchResult:
+        """Global distilling / scoring / folding over (possibly merged)
+        per-DM-trial candidates. ``fold_exchange`` is the multi-host
+        hook: callable(local fold outcomes) -> all processes' outcomes
+        (parallel/multihost.py wires an allgather; None = single
+        process)."""
+        cfg = self.config
+        timers = part.timers
         dm_still = DMDistiller(cfg.freq_tol, keep_related=True)
         harm_still = HarmonicDistiller(
             cfg.freq_tol, cfg.max_harm, keep_related=True, fractional_harms=False
         )
-        cands = dm_still.distill(dm_trial_cands.cands)
+        cands = dm_still.distill(part.cands)
         cands = harm_still.distill(cands)
 
         scorer = CandidateScorer(
@@ -590,23 +710,26 @@ class PeasoupSearch:
         t0 = time.time()
         if cfg.npdmp > 0:
             folder = MultiFolder(
-                trials, trials_nsamps, fil.tsamp,
+                part.trials, part.trials_nsamps, fil.tsamp,
                 pos5_freq=cfg.boundary_5_freq, pos25_freq=cfg.boundary_25_freq,
+                dm_offset=part.dm_offset,
             )
-            cands = folder.fold_n(cands, cfg.npdmp)
+            outcomes = folder.fold_outcomes(cands, cfg.npdmp)
+            if fold_exchange is not None:
+                outcomes = fold_exchange(outcomes)
+            cands = folder.apply_outcomes(cands, outcomes)
         timers["folding"] = time.time() - t0
 
         cands = cands[: cfg.limit]
-        timers["total"] = time.time() - t_total
-        acc_list_dm0 = acc_plan.generate_accel_list(0.0)
+        timers["total"] = time.time() - part.t_total_start
         return SearchResult(
             candidates=cands,
-            dm_list=dm_plan.dm_list,
-            acc_list_dm0=acc_list_dm0,
+            dm_list=part.dm_list,
+            acc_list_dm0=part.acc_list_dm0,
             timers=timers,
-            nsamps=fil.nsamps,
-            size=size,
-            n_accel_trials=sum(len(a) for a in accel_lists),
+            nsamps=part.nsamps,
+            size=part.size,
+            n_accel_trials=part.n_accel_trials,
         )
 
     def _run_waves(

@@ -136,6 +136,20 @@ class DMPlan:
     def ndm(self) -> int:
         return len(self.dm_list)
 
+    def subset(self, lo: int, hi: int) -> "DMPlan":
+        """The [lo, hi) slice of the trial list, keeping the GLOBAL
+        max_delay/out_nsamps so every slice's trials have identical
+        length — the multi-host driver deals contiguous slices to
+        processes and later merges their candidates (whose dm_idx are
+        re-offset to the global list)."""
+        return DMPlan(
+            dm_list=self.dm_list[lo:hi],
+            delays=self.delays,
+            killmask=self.killmask,
+            max_delay=self.max_delay,
+            out_nsamps=self.out_nsamps,
+        )
+
     def delay_samples(self) -> np.ndarray:
         """Integer delay (ndm, nchans) in samples, rounded to nearest."""
         d = np.rint(

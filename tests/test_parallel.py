@@ -149,3 +149,33 @@ class TestMultihost:
         mesh = multihost.global_mesh({"dm": -1})
         lo, hi = multihost.process_local_slice(mesh, "dm")
         assert (lo, hi) == (0, mesh.shape["dm"])  # single process
+
+    def test_dm_slice_for_process_partitions(self):
+        from peasoup_tpu.parallel.multihost import dm_slice_for_process
+
+        for ndm, nproc in [(59, 4), (8, 8), (7, 3), (100, 1), (3, 5)]:
+            slices = [dm_slice_for_process(ndm, nproc, p) for p in range(nproc)]
+            # contiguous, ordered, exactly covering [0, ndm)
+            assert slices[0][0] == 0 and slices[-1][1] == ndm
+            for (a, b), (c, d) in zip(slices, slices[1:]):
+                assert b == c and b - a >= d - c  # balanced, larger first
+            sizes = [b - a for a, b in slices]
+            assert max(sizes) - min(sizes) <= 1
+
+    def test_allgather_pickled_single_process(self):
+        from peasoup_tpu.parallel.multihost import _allgather_pickled
+
+        assert _allgather_pickled(b"payload") == [b"payload"]
+
+    def test_run_search_single_process_degrades(self, tmp_path):
+        """run_search with one process must be exactly the local
+        driver path."""
+        from peasoup_tpu.parallel.multihost import run_search
+        from peasoup_tpu.pipeline.search import SearchConfig
+        from tests.test_pipeline import make_synthetic_fil
+        from peasoup_tpu.io.sigproc import read_filterbank
+
+        path, _, _ = make_synthetic_fil(tmp_path, nsamps=1 << 13)
+        fil = read_filterbank(path)
+        res = run_search(fil, SearchConfig(dm_end=10.0, nharmonics=1, limit=5))
+        assert len(res.candidates) <= 5

@@ -83,6 +83,95 @@ class TestEndToEnd:
         assert len(res.dm_list) >= 1
         assert len(res.candidates) <= 10
 
+    def test_sliced_merge_matches_full_run(self, synthetic):
+        """The multi-host flow — each process searches a contiguous DM
+        slice, per-DM candidates are merged, every process finalizes
+        with fold-outcome exchange — must reproduce the single-host
+        candidate list exactly. Simulated here with two sequential
+        slice runs and an in-process 'allgather'."""
+        import pickle
+
+        from peasoup_tpu.parallel.multihost import dm_slice_for_process
+        from peasoup_tpu.pipeline.search import PartialSearchResult
+
+        path, _, _ = synthetic
+        fil = read_filterbank(path)
+        common = dict(dm_end=60.0, nharmonics=2, npdmp=4, limit=50)
+        full = PeasoupSearch(SearchConfig(**common)).run(fil)
+        ndm = len(full.dm_list)
+
+        parts = []
+        for pid in range(2):
+            lo, hi = dm_slice_for_process(ndm, 2, pid)
+            search = PeasoupSearch(SearchConfig(**common))
+            parts.append(
+                (search, search.run(fil, dm_slice=(lo, hi), finalize=False))
+            )
+        assert [len(p.dm_list) for _, p in parts] == [ndm - ndm // 2, ndm // 2]
+
+        # merge + finalize from each process's point of view. The real
+        # flow allgathers fold outcomes concurrently; sequentially we
+        # harvest each process's local outcomes in a first pass, then
+        # finalize for real with the pooled set (pickled like the real
+        # DCN allgather). distill mutates candidates, so every finalize
+        # gets a fresh deep copy of the merged list.
+        merged_cands = [c for _, p in parts for c in p.cands]
+
+        def make_merged(part):
+            return PartialSearchResult(
+                cands=pickle.loads(pickle.dumps(merged_cands)),
+                trials=part.trials,
+                trials_nsamps=part.trials_nsamps,
+                dm_offset=part.dm_offset,
+                dm_list=full.dm_list,
+                acc_list_dm0=part.acc_list_dm0,
+                timers=dict(part.timers),
+                nsamps=part.nsamps,
+                size=part.size,
+                n_accel_trials=sum(p.n_accel_trials for _, p in parts),
+                t_total_start=part.t_total_start,
+            )
+
+        harvested: list[list] = []
+        for search, part in parts:
+            search.finalize(
+                fil, make_merged(part),
+                fold_exchange=lambda o: harvested.append(
+                    pickle.loads(pickle.dumps(o))
+                ) or o,
+            )
+        pooled = [o for out in harvested for o in out]
+
+        results = [
+            search.finalize(
+                fil, make_merged(part), fold_exchange=lambda o: pooled
+            )
+            for search, part in parts
+        ]
+
+        assert full.n_accel_trials == results[0].n_accel_trials
+        for res in results:
+            assert len(res.candidates) == len(full.candidates) > 0
+            for a, b in zip(full.candidates, res.candidates):
+                assert a.freq == b.freq and a.snr == b.snr
+                assert a.dm == b.dm and a.dm_idx == b.dm_idx
+                assert a.folded_snr == b.folded_snr
+                assert a.opt_period == b.opt_period
+
+    def test_empty_dm_slice(self, synthetic):
+        """More processes than DM trials: an empty slice must yield an
+        empty partial (no device work, no crash) that finalizes to zero
+        candidates."""
+        path, _, _ = synthetic
+        fil = read_filterbank(path)
+        cfg = SearchConfig(dm_end=5.0, nharmonics=1, npdmp=2)
+        search = PeasoupSearch(cfg)
+        ndm = search.build_dm_plan(fil).ndm
+        part = search.run(fil, dm_slice=(ndm, ndm), finalize=False)
+        assert part.cands == [] and part.n_accel_trials == 0
+        res = search.finalize(fil, part)
+        assert res.candidates == []
+
     def test_sharded_search_matches_single_device(self, synthetic):
         """The full driver on an 8-chip 'dm' mesh must produce the same
         candidate list as the single-device path."""
