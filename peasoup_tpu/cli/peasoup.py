@@ -56,6 +56,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-v", "--verbose", action="store_true")
     p.add_argument("-p", "--progress_bar", action="store_true")
     p.add_argument(
+        "--subbands", type=int, default=0,
+        help="two-stage subband dedispersion with N subbands "
+        "(~sqrt(nchans)-fold less arithmetic at high channel counts; "
+        "0 = direct, exact)",
+    )
+    p.add_argument(
+        "--subband_smear", type=float, default=1.0,
+        help="max extra smear (samples) allowed per DM-trial group "
+        "when --subbands is set (0 = exact)",
+    )
+    p.add_argument(
         "--checkpoint", default="",
         help="Checkpoint file for resumable searches (TPU extension; "
         "the reference has no checkpointing)",
@@ -136,6 +147,8 @@ def main(argv: list[str] | None = None) -> int:
         verbose=args.verbose,
         progress_bar=args.progress_bar,
         checkpoint_file=args.checkpoint,
+        subbands=args.subbands,
+        subband_smear=args.subband_smear,
     )
     t0 = time.time()
     if args.progress_bar:

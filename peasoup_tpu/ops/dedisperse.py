@@ -140,6 +140,168 @@ def dedisperse_device(
     return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
 
 
+def subband_groups(
+    delay_table: np.ndarray,  # (D, C) int32 per-trial per-channel delays
+    nsub: int,
+    max_smear: float,
+) -> list[tuple[int, int]]:
+    """Greedy grouping of adjacent DM trials sharing one nominal DM for
+    two-stage subband dedispersion (the scheme of the dedisp library
+    the reference links, dedisperser.hpp:25-31 — there hidden inside
+    `dedisp_execute`). Trials join the group opened by trial ``lo``
+    while the worst-case intra-subband smear of substituting trial lo's
+    channel shape stays <= ``max_smear`` samples. ``max_smear=0`` gives
+    singleton groups (exact direct equality). Returns [lo, hi) spans.
+    """
+    D, C = delay_table.shape
+    w = -(-C // nsub)
+    groups = []
+    lo = 0
+    while lo < D:
+        hi = lo + 1
+        while hi < D:
+            # smear of trial hi under trial lo's intra-band shape:
+            # max_c |(d[hi,c]-d[hi,ref]) - (d[lo,c]-d[lo,ref])|
+            err = 0
+            for b in range(0, C, w):
+                dl = delay_table[lo, b : b + w]
+                dh = delay_table[hi, b : b + w]
+                # same min-reference convention as dedisperse_subband,
+                # so this bound is exactly the stage-2 index error
+                err = max(
+                    err, int(np.abs((dh - dh.min()) - (dl - dl.min())).max())
+                )
+                if err > max_smear:
+                    break
+            if err > max_smear:
+                break
+            hi += 1
+        groups.append((lo, hi))
+        lo = hi
+    return groups
+
+
+@partial(jax.jit, static_argnames=("t1",))
+def _subband_stage1(
+    x_swt: jax.Array,  # (S, w, T) u8/f32 filterbank grouped into subbands
+    kill_sw: jax.Array,  # (S, w) f32 killmask in the same grouping
+    d1: jax.Array,  # (S, w) int32 intra-band delays at the nominal DM
+    *,
+    t1: int,
+) -> jax.Array:
+    """Per-subband shift-and-sum at one nominal DM:
+    out[b, t] = sum_c kill[b, c] * x[b, c, t + d1[b, c]] — the same
+    scan-over-channels pattern as dedisperse_block, vmapped over
+    subbands. The f32 cast + killmask happen per scan step so the
+    resident grouped filterbank stays u8."""
+
+    def body(acc, cin):
+        rows, kcol, dcol = cin  # (S, T), (S,), (S,)
+        sl = jax.vmap(
+            lambda r, d: jax.lax.dynamic_slice_in_dim(r, d, t1)
+        )(rows, dcol)
+        return acc + sl.astype(jnp.float32) * kcol[:, None], None
+
+    acc0 = jnp.zeros((x_swt.shape[0], t1), jnp.float32)
+    out, _ = jax.lax.scan(
+        body, acc0, (jnp.swapaxes(x_swt, 0, 1), kill_sw.T, d1.T)
+    )
+    return out  # (S, t1)
+
+
+def dedisperse_subband(
+    fil_tc,  # (T, C) u8/f32 filterbank (numpy or device)
+    delay_table: np.ndarray,  # (D, C) int32 from DMPlan.delay_samples()
+    killmask: np.ndarray,
+    out_nsamps: int,
+    *,
+    nsub: int,
+    max_smear: float = 1.0,
+    quantize: bool = True,
+    scale: float = 1.0,
+    to_host: bool = False,
+):
+    """Two-stage subband dedispersion of ALL trials.
+
+    Stage 1 (once per nominal DM, the first trial of each group):
+    align channels WITHIN each of ``nsub`` subbands, giving (S, T1)
+    partial time series. Stage 2 (per trial): combine the nominal's
+    subbands with the trial's own reference-channel delays — which is
+    exactly :func:`dedisperse_block` treating subbands as channels.
+    Arithmetic per group of g trials: C*T + g*S*T instead of the direct
+    g*C*T — ~sqrt(C)-fold less at survey channel counts when
+    g ~ C/S ~ S. The approximation replaces each trial's intra-band
+    delay shape by its nominal's; grouping bounds that error to
+    ``max_smear`` samples (0 => bitwise equal to the direct path).
+
+    Returns (D, out_nsamps), device-resident (or numpy with
+    ``to_host``, for surveys whose trial block spills to host RAM).
+    """
+    delay_table = np.asarray(delay_table, dtype=np.int32)
+    D, C = delay_table.shape
+    # effective band count: ceil(C / w) bands of width w cover C for ANY
+    # requested nsub (e.g. nsub=5 over 16 chans -> w=4, 4 bands)
+    w = -(-C // max(1, min(nsub, C)))
+    nsub = -(-C // w)
+    cpad = w * nsub - C
+    groups = subband_groups(delay_table, nsub, max_smear)
+
+    # per-band reference = the band's MINIMUM delay (robust to either
+    # frequency ordering and to rint non-monotonicity): d1 >= 0 always
+    band_of = np.minimum(np.arange(C) // w, nsub - 1)
+    refdel = np.stack(
+        [delay_table[:, b : b + w].min(axis=1) for b in range(0, C, w)],
+        axis=1,
+    )  # (D, S)
+    d1_all = delay_table - refdel[:, band_of]
+    t1 = fil_tc.shape[0] - int(d1_all[[lo for lo, _ in groups]].max())
+    # rint rounding can leave t1 one or two samples short of what
+    # stage 2 addresses (interior-band rounded spans may exceed the
+    # last band's); pad the time axis with zeros to cover the deficit.
+    # For max_smear=0 the stage-2 index telescopes to t + d[d, c]
+    # < fil_tc.shape[0], so the pad is NEVER read (exactness holds);
+    # with smear it only touches the last <= smear samples per channel.
+    deficit = max(0, int(refdel.max()) + out_nsamps - t1)
+    t1 += deficit
+
+    # the grouped filterbank stays in its upload dtype (u8 for packed
+    # files) — stage 1 casts + killmasks per scan step, so HBM holds
+    # one extra u8 copy rather than two f32 ones
+    x = jnp.asarray(fil_tc)
+    if cpad or deficit:  # equal-width bands + stage-1 margin (inert zeros)
+        x = jnp.pad(x, ((0, deficit), (0, cpad)))
+    x_swt = x.T.reshape(nsub, w, -1)  # (S, w, T)
+    kill_sw = jnp.asarray(
+        np.pad(np.asarray(killmask, np.float32), (0, cpad)).reshape(nsub, w)
+    )
+    ones = jnp.ones(nsub, jnp.float32)
+
+    outs = []
+    for lo, hi in groups:
+        g = hi - lo
+        d1 = np.pad(d1_all[lo], (0, cpad)).reshape(nsub, w)
+        s1 = _subband_stage1(x_swt, kill_sw, jnp.asarray(d1), t1=t1)
+        rd = refdel[lo:hi]
+        # pad group height to a power of two: a handful of compiled
+        # stage-2 shapes, <2x padding waste (group sizes shrink with
+        # DM, so one global max would waste much more)
+        g_pad = 1 << (g - 1).bit_length() if g > 1 else 1
+        if g_pad > g:
+            rd = np.pad(rd, ((0, g_pad - g), (0, 0)))
+        res = dedisperse_block(
+            s1.T,  # (t1, S): subbands are stage-2 "channels"
+            jnp.asarray(rd, dtype=np.int32),
+            ones,
+            out_nsamps=out_nsamps,
+            quantize=quantize,
+            scale=scale,
+        )[:g]
+        outs.append(np.asarray(res) if to_host else res)
+    if to_host:
+        return np.concatenate(outs, axis=0)
+    return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
+
+
 def dedisperse(
     fil_tc: np.ndarray,
     delays: np.ndarray,

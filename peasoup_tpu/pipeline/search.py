@@ -76,6 +76,11 @@ class SearchConfig:
     # chunks whose raw crossing count overflows are re-dispatched at the
     # next power of two automatically)
     dedisp_block: int = 16  # DM trials per dedispersion launch
+    subbands: int = 0  # >0: two-stage subband dedispersion with this
+    # many subbands (~sqrt(C)-fold less arithmetic at survey channel
+    # counts; 0 = direct channel scan, the golden-exact default)
+    subband_smear: float = 1.0  # max extra smear (samples) a trial may
+    # suffer from sharing its group's nominal DM (0 = exact)
     accel_bucket: int = 16  # accel batch padded to a multiple of this
     dm_block: int = 0  # DM trials per device call; 0 = auto from HBM budget
     checkpoint_file: str = ""  # resumable per-DM-trial result store
@@ -320,15 +325,30 @@ class PeasoupSearch:
         trials_bytes = dm_plan.ndm * dm_plan.out_nsamps
         spill = trials_bytes > self.TRIALS_DEVICE_LIMIT
         with trace_span("Dedisperse"):  # NVTX parity: pipeline_multi.cu:318
-            dd = dedisperse if spill else dedisperse_device
-            trials = dd(
-                fil.data if spill else fil_to_device(fil),
-                dm_plan.delay_samples(),
-                dm_plan.killmask,
-                dm_plan.out_nsamps,
-                scale=output_scale(fil.nbits, int(dm_plan.killmask.sum())),
-                block=cfg.dedisp_block,
-            )
+            scale = output_scale(fil.nbits, int(dm_plan.killmask.sum()))
+            if cfg.subbands > 0:
+                from ..ops.dedisperse import dedisperse_subband
+
+                trials = dedisperse_subband(
+                    fil.data if spill else fil_to_device(fil),
+                    dm_plan.delay_samples(),
+                    dm_plan.killmask,
+                    dm_plan.out_nsamps,
+                    nsub=cfg.subbands,
+                    max_smear=cfg.subband_smear,
+                    scale=scale,
+                    to_host=spill,
+                )
+            else:
+                dd = dedisperse if spill else dedisperse_device
+                trials = dd(
+                    fil.data if spill else fil_to_device(fil),
+                    dm_plan.delay_samples(),
+                    dm_plan.killmask,
+                    dm_plan.out_nsamps,
+                    scale=scale,
+                    block=cfg.dedisp_block,
+                )
             if not spill:
                 # tiny sync so the phase timer means what it says
                 np.asarray(trials[-1, -1])

@@ -390,6 +390,100 @@ class TestDedisperse:
                 oracle[di] += fil[delays[di, ch] : delays[di, ch] + out_nsamps, ch]
         np.testing.assert_array_equal(got, np.clip(np.rint(oracle), 0, 255))
 
+    def _plan_delays(self, d=24, c=32, dm_max=80.0):
+        """A realistic monotone (D, C) delay table (cold-plasma law)."""
+        from peasoup_tpu.plan.dm_plan import delay_table
+
+        dms = np.linspace(0.0, dm_max, d).astype(np.float32)
+        k = np.abs(delay_table(1400.0, -8.0, c, 0.000256))
+        return np.rint(dms[:, None].astype(np.float64) * k[None, :]).astype(
+            np.int32
+        ), dms
+
+    def test_subband_exact_at_zero_smear(self, rng):
+        """max_smear=0 forces singleton groups, where the two-stage
+        decomposition telescopes: t + d[ref] + (d[c] - d[ref]) = t + d[c]
+        — bitwise equal to the direct path."""
+        from peasoup_tpu.ops.dedisperse import dedisperse_subband
+
+        delays, _ = self._plan_delays()
+        t = 2048 + int(delays.max())
+        c = delays.shape[1]
+        fil = rng.integers(0, 4, size=(t, c)).astype(np.uint8)
+        out_nsamps = t - int(delays.max())
+        direct = dedisperse(fil, delays, np.ones(c, np.int32), out_nsamps)
+        sub = dedisperse_subband(
+            fil, delays, np.ones(c, np.int32), out_nsamps,
+            nsub=8, max_smear=0.0, to_host=True,
+        )
+        np.testing.assert_array_equal(direct, sub)
+
+    def test_subband_grouping_bounds_smear(self, rng):
+        """Grouped trials may differ from direct, but only by shifts
+        bounded by max_smear: the dispersed impulse must still realign
+        to (near) full amplitude at every trial."""
+        from peasoup_tpu.ops.dedisperse import (
+            dedisperse_subband,
+            subband_groups,
+        )
+
+        delays, _ = self._plan_delays()
+        d, c = delays.shape
+        groups = subband_groups(delays, nsub=8, max_smear=2.0)
+        assert sum(hi - lo for lo, hi in groups) == d
+        assert len(groups) < d  # actually grouped something
+
+        # impulse dispersed at trial 13's exact delays
+        t = 2048 + int(delays.max())
+        fil = np.zeros((t, c), dtype=np.uint8)
+        t0, di = 700, 13
+        for ch in range(c):
+            fil[t0 + delays[di, ch], ch] = 3
+        out_nsamps = t - int(delays.max())
+        sub = np.asarray(
+            dedisperse_subband(
+                fil, delays, np.ones(c, np.int32), out_nsamps,
+                nsub=8, max_smear=2.0,
+            )
+        )
+        # energy conserved and concentrated within the smear window
+        window = sub[di, t0 - 3 : t0 + 4].astype(int)
+        assert window.sum() == 3 * c
+        assert sub[di].astype(int).sum() == 3 * c
+
+    def test_subband_awkward_nsub(self, rng):
+        """nsub values where ceil(C/ceil(C/nsub)) != nsub (e.g. 5 bands
+        over 16 channels -> width 4 -> only 4 bands) must reduce to the
+        effective band count, not crash."""
+        from peasoup_tpu.ops.dedisperse import dedisperse_subband
+
+        delays, _ = self._plan_delays(d=6, c=16)
+        t = 512 + int(delays.max())
+        fil = rng.integers(0, 4, size=(t, 16)).astype(np.uint8)
+        out_nsamps = t - int(delays.max())
+        direct = dedisperse(fil, delays, np.ones(16, np.int32), out_nsamps)
+        for nsub in (5, 7, 16, 40):
+            sub = dedisperse_subband(
+                fil, delays, np.ones(16, np.int32), out_nsamps,
+                nsub=nsub, max_smear=0.0, to_host=True,
+            )
+            np.testing.assert_array_equal(direct, sub)
+
+    def test_subband_killmask_and_scale(self, rng):
+        from peasoup_tpu.ops.dedisperse import dedisperse_subband
+
+        delays, _ = self._plan_delays(d=6, c=16)
+        t = 1024 + int(delays.max())
+        fil = rng.integers(0, 255, size=(t, 16)).astype(np.uint8)
+        kill = (rng.random(16) > 0.3).astype(np.int32)
+        out_nsamps = t - int(delays.max())
+        a = dedisperse(fil, delays, kill, out_nsamps, scale=0.1)
+        b = dedisperse_subband(
+            fil, delays, kill, out_nsamps, nsub=4, max_smear=0.0,
+            scale=0.1, to_host=True,
+        )
+        np.testing.assert_array_equal(a, b)
+
 
 class TestFold:
     def test_matches_np_oracle(self, rng):
