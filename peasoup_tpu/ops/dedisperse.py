@@ -14,11 +14,76 @@ channels raw channel sums fit u8 exactly).
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+def _shift_slice(row_b: jax.Array, delay: jax.Array, nb: int) -> jax.Array:
+    """row[delay : delay + nb*128] from a (T/128, 128) blocked channel
+    row, decomposed as delay = 128q + s.
+
+    An arbitrary-offset 1-D dynamic slice makes XLA rotate lanes the
+    slow way (measured 10x over a static slice); slicing the BLOCKED
+    row on its leading axis is pure addressing, and the s < 128
+    residual becomes one whole-array lane-roll plus a row-boundary
+    select — measured 2x faster end-to-end, bitwise identical.
+    """
+    q = delay // 128
+    s = delay % 128
+    v = jax.lax.dynamic_slice(row_b, (q, 0), (nb + 1, 128))
+    a = jnp.roll(v, -s, axis=1)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (nb, 128), 1)
+    return jnp.where(lane < 128 - s, a[:nb], a[1:]).reshape(-1)
+
+
+def _pad_blocks(x_tc: jax.Array) -> jax.Array:
+    """Zero-pad the time axis so every channel row reshapes to
+    (T/128, 128) blocks with one spare block for _shift_slice's
+    window (the pad is never read when delay + out_nsamps <= T)."""
+    t = x_tc.shape[0]
+    tpad = (-(-t // 128) + 2) * 128
+    return jnp.pad(x_tc, ((0, tpad - t), (0, 0)))
+
+
+def _dedisperse_core(
+    x_cb: jax.Array,  # (C, T/128, 128) blocked, masked, f32-summable rows
+    delays: jax.Array,  # (D, C) int32
+    *,
+    out_nsamps: int,
+    quantize: bool,
+    scale: float,
+) -> jax.Array:
+    """Channel-major shift-and-sum scan (the shared engine of the
+    direct path and both subband stages; channel-major input means no
+    transposes anywhere on the subband path)."""
+    nb = -(-out_nsamps // 128)
+
+    # accumulate channel by channel with a lax.scan: a (D, C, T_out)
+    # shifted tensor would not fit HBM at survey scale (XLA materialises
+    # vmapped dynamic slices before reducing), while the (D, T_out)
+    # carry is one trial block. Channel sums of <=8-bit samples are
+    # exact integers in f32, so the summation order cannot change the
+    # result.
+    def body(acc, cin):
+        row_b, dcol = cin  # (T/128, 128) blocked samples, (D,) delays
+        return (
+            acc
+            + jax.vmap(lambda d: _shift_slice(row_b, d, nb))(dcol)[
+                :, :out_nsamps
+            ],
+            None,
+        )
+
+    acc0 = jnp.zeros((delays.shape[0], out_nsamps), jnp.float32)
+    out, _ = jax.lax.scan(body, acc0, (x_cb, delays.T))  # (D, T_out)
+    if scale != 1.0:
+        out = out * jnp.float32(scale)
+    if quantize:
+        out = jnp.clip(jnp.rint(out), 0, 255).astype(jnp.uint8)
+    return out
 
 
 @partial(jax.jit, static_argnames=("out_nsamps", "quantize", "scale"))
@@ -38,28 +103,12 @@ def dedisperse_block(
     factor (1.0 for the 2-bit golden data, keeping raw-sum parity).
     Returns (D, out_nsamps) u8 (quantize=True) or f32.
     """
-    x_ct = fil_tc.astype(jnp.float32).T * killmask.astype(jnp.float32)[:, None]
-
-    # accumulate channel by channel with a lax.scan: a (D, C, T_out)
-    # shifted tensor would not fit HBM at survey scale (XLA materialises
-    # vmapped dynamic slices before reducing), while the (D, T_out)
-    # carry is one trial block. Channel sums of <=8-bit samples are
-    # exact integers in f32, so the summation order cannot change the
-    # result.
-    def one_channel(row: jax.Array, delay: jax.Array) -> jax.Array:
-        return jax.lax.dynamic_slice_in_dim(row, delay, out_nsamps)
-
-    def body(acc, cin):
-        row, dcol = cin  # (T,) samples, (D,) per-trial delays
-        return acc + jax.vmap(lambda d: one_channel(row, d))(dcol), None
-
-    acc0 = jnp.zeros((delays.shape[0], out_nsamps), jnp.float32)
-    out, _ = jax.lax.scan(body, acc0, (x_ct, delays.T))  # (D, T_out)
-    if scale != 1.0:
-        out = out * jnp.float32(scale)
-    if quantize:
-        out = jnp.clip(jnp.rint(out), 0, 255).astype(jnp.uint8)
-    return out
+    x_ct = _pad_blocks(fil_tc).astype(jnp.float32).T
+    x_ct = x_ct * killmask.astype(jnp.float32)[:, None]
+    x_cb = x_ct.reshape(x_ct.shape[0], -1, 128)  # (C, T/128, 128)
+    return _dedisperse_core(
+        x_cb, delays, out_nsamps=out_nsamps, quantize=quantize, scale=scale
+    )
 
 
 @partial(jax.jit, static_argnames=("nbits", "nsamps", "nchans"))
@@ -181,32 +230,61 @@ def subband_groups(
     return groups
 
 
-@partial(jax.jit, static_argnames=("t1",))
+@partial(jax.jit, static_argnames=("nb1",))
 def _subband_stage1(
     x_swt: jax.Array,  # (S, w, T) u8/f32 filterbank grouped into subbands
     kill_sw: jax.Array,  # (S, w) f32 killmask in the same grouping
     d1: jax.Array,  # (S, w) int32 intra-band delays at the nominal DM
     *,
-    t1: int,
+    nb1: int,  # output length in 128-blocks (ceil(t1/128) + 2 spare)
 ) -> jax.Array:
     """Per-subband shift-and-sum at one nominal DM:
     out[b, t] = sum_c kill[b, c] * x[b, c, t + d1[b, c]] — the same
-    scan-over-channels pattern as dedisperse_block, vmapped over
+    scan-over-channels pattern as the direct core, vmapped over
     subbands. The f32 cast + killmask happen per scan step so the
-    resident grouped filterbank stays u8."""
+    resident grouped filterbank stays u8. Output is the CHANNEL-MAJOR
+    BLOCKED (S, nb1, 128) form that stage 2's core consumes directly,
+    so the subband path has no transposes at all."""
+    s_count, _, t_tot = x_swt.shape
+    x_blk = x_swt.reshape(s_count, -1, t_tot // 128, 128)
 
     def body(acc, cin):
-        rows, kcol, dcol = cin  # (S, T), (S,), (S,)
-        sl = jax.vmap(
-            lambda r, d: jax.lax.dynamic_slice_in_dim(r, d, t1)
-        )(rows, dcol)
-        return acc + sl.astype(jnp.float32) * kcol[:, None], None
+        rows, kcol, dcol = cin  # (S, T/128, 128), (S,), (S,)
+        sl = jax.vmap(lambda r, d: _shift_slice(r, d, nb1))(rows, dcol)
+        if sl.dtype != jnp.float32:  # spill path keeps the rows u8
+            sl = sl.astype(jnp.float32)
+        return acc + sl * kcol[:, None], None
 
-    acc0 = jnp.zeros((x_swt.shape[0], t1), jnp.float32)
+    acc0 = jnp.zeros((s_count, nb1 * 128), jnp.float32)
     out, _ = jax.lax.scan(
-        body, acc0, (jnp.swapaxes(x_swt, 0, 1), kill_sw.T, d1.T)
+        body, acc0, (jnp.swapaxes(x_blk, 0, 1), kill_sw.T, d1.T)
     )
-    return out  # (S, t1)
+    return out.reshape(s_count, nb1, 128)
+
+
+@lru_cache(maxsize=None)
+def _stage1_batched(nb1: int):
+    """Jitted group-batched stage 1, cached so repeat calls (multi-file
+    surveys, resumed runs) reuse the compiled program."""
+    return jax.jit(
+        jax.vmap(partial(_subband_stage1, nb1=nb1), in_axes=(None, None, 0))
+    )
+
+
+@lru_cache(maxsize=None)
+def _stage2_batched(out_nsamps: int, quantize: bool, scale: float):
+    """Jitted group-batched stage 2 (the channel-major core over
+    subbands), cached like _stage1_batched."""
+    return jax.jit(
+        jax.vmap(
+            partial(
+                _dedisperse_core,
+                out_nsamps=out_nsamps,
+                quantize=quantize,
+                scale=scale,
+            ),
+        )
+    )
 
 
 def dedisperse_subband(
@@ -265,38 +343,60 @@ def dedisperse_subband(
     t1 += deficit
 
     # the grouped filterbank stays in its upload dtype (u8 for packed
-    # files) — stage 1 casts + killmasks per scan step, so HBM holds
-    # one extra u8 copy rather than two f32 ones
+    # files), and stage 1 upcasts after slicing: HBM holds one u8 copy
+    # instead of an f32 one (per-window upcasting before the roll was
+    # tried and regressed — extra f32 write per slice, see NOTES.md)
     x = jnp.asarray(fil_tc)
-    if cpad or deficit:  # equal-width bands + stage-1 margin (inert zeros)
-        x = jnp.pad(x, ((0, deficit), (0, cpad)))
+    # pad time to whole 128-blocks (+3 spare: stage 1 windows reach
+    # q1 + nb1 + 1 blocks with nb1 = ceil(t1/128) + 2) and pad channels
+    # to equal-width bands; all pad zeros are inert
+    nb1 = -(-t1 // 128) + 2
+    t_need = fil_tc.shape[0] + deficit
+    tpad = (-(-t_need // 128) + 3) * 128 - t_need
+    if cpad or deficit or tpad:
+        x = jnp.pad(x, ((0, deficit + tpad), (0, cpad)))
     x_swt = x.T.reshape(nsub, w, -1)  # (S, w, T)
     kill_sw = jnp.asarray(
         np.pad(np.asarray(killmask, np.float32), (0, cpad)).reshape(nsub, w)
     )
-    ones = jnp.ones(nsub, jnp.float32)
+
+    # process groups in vmapped batches: per-group dispatches (2 per
+    # group) would dominate at survey scale where groups hold only a
+    # few trials each. Batch size bounds the live (gb, S, nb1*128)
+    # stage-1 working set to ~1 GB.
+    gb = max(
+        1, min(len(groups), 1_000_000_000 // max(1, 4 * nsub * nb1 * 128))
+    )
+    stage1_b = _stage1_batched(nb1)
+    stage2_b = _stage2_batched(out_nsamps, quantize, scale)
 
     outs = []
-    for lo, hi in groups:
-        g = hi - lo
-        d1 = np.pad(d1_all[lo], (0, cpad)).reshape(nsub, w)
-        s1 = _subband_stage1(x_swt, kill_sw, jnp.asarray(d1), t1=t1)
-        rd = refdel[lo:hi]
-        # pad group height to a power of two: a handful of compiled
-        # stage-2 shapes, <2x padding waste (group sizes shrink with
-        # DM, so one global max would waste much more)
-        g_pad = 1 << (g - 1).bit_length() if g > 1 else 1
-        if g_pad > g:
-            rd = np.pad(rd, ((0, g_pad - g), (0, 0)))
-        res = dedisperse_block(
-            s1.T,  # (t1, S): subbands are stage-2 "channels"
-            jnp.asarray(rd, dtype=np.int32),
-            ones,
-            out_nsamps=out_nsamps,
-            quantize=quantize,
-            scale=scale,
-        )[:g]
-        outs.append(np.asarray(res) if to_host else res)
+    for b0 in range(0, len(groups), gb):
+        batch = groups[b0 : b0 + gb]
+        # pad the batch's group heights to ITS power-of-two bucket
+        # (group sizes shrink with DM; a global max would waste more)
+        gmax_b = max(hi - lo for lo, hi in batch)
+        g_pad = 1 << (gmax_b - 1).bit_length() if gmax_b > 1 else 1
+        if len(batch) < gb and len(outs):  # keep one compiled shape
+            batch = batch + [batch[-1]] * (gb - len(batch))
+        d1 = np.stack(
+            [
+                np.pad(d1_all[lo], (0, cpad)).reshape(nsub, w)
+                for lo, _ in batch
+            ]
+        )
+        rd = np.stack(
+            [
+                np.pad(refdel[lo:hi], ((0, g_pad - (hi - lo)), (0, 0)))
+                for lo, hi in batch
+            ]
+        )
+        s1 = stage1_b(x_swt, kill_sw, jnp.asarray(d1))  # (gb, S, nb1, 128)
+        res = stage2_b(s1, jnp.asarray(rd, dtype=np.int32))
+        if to_host:
+            res = np.asarray(res)  # ONE transfer per batch, not per group
+        for bi, (lo, hi) in enumerate(batch[: len(groups) - b0]):
+            outs.append(res[bi, : hi - lo])
     if to_host:
         return np.concatenate(outs, axis=0)
     return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)

@@ -24,6 +24,7 @@ from ..io.sigproc import Filterbank
 from ..ops.dedisperse import (
     dedisperse,
     dedisperse_device,
+    dedisperse_subband,
     fil_to_device,
     output_scale,
 )
@@ -327,8 +328,6 @@ class PeasoupSearch:
         with trace_span("Dedisperse"):  # NVTX parity: pipeline_multi.cu:318
             scale = output_scale(fil.nbits, int(dm_plan.killmask.sum()))
             if cfg.subbands > 0:
-                from ..ops.dedisperse import dedisperse_subband
-
                 trials = dedisperse_subband(
                     fil.data if spill else fil_to_device(fil),
                     dm_plan.delay_samples(),
