@@ -166,7 +166,49 @@ def dedisperse_device(
     reference instead keeps trials in host RAM and re-uploads each one,
     timeseries.hpp:335-344). Blocks bound peak HBM ((block+1) * T * 4
     bytes of working set).
+
+    On TPU backends where the probe passes, the whole trial set runs as
+    ONE Pallas dispatch (ops/pallas/dedisperse.py: VMEM-resident
+    accumulators, per-channel windows DMA'd at dynamic offsets) —
+    bitwise equal to the jnp scan below, ~1.5x faster at survey scale
+    and free of per-block dispatch overhead.
     """
+    from .pallas import probe_pallas_dedisperse
+
+    # probe first (cached, instant False off-TPU) so non-TPU backends
+    # skip the O(D*C) monotonicity scan entirely; the kernel also needs
+    # its full f32 output + padded f32 filterbank copy to fit HBM —
+    # bigger sets stay on the blocked scan, whose working set is one
+    # trial block
+    if probe_pallas_dedisperse() and np.all(
+        np.diff(np.asarray(delays), axis=0) >= 0
+    ):
+        from .pallas.dedisperse import dedisperse_pallas, pallas_hbm_bytes
+
+        need = pallas_hbm_bytes(
+            fil_tc.shape[0], delays.shape[1], delays.shape[0], out_nsamps
+        )
+        try:
+            limit = (
+                jax.local_devices()[0].memory_stats() or {}
+            ).get("bytes_limit", 0) or 12_000_000_000
+        except Exception:
+            limit = 12_000_000_000
+        if need < 0.6 * limit:
+            try:
+                return dedisperse_pallas(
+                    fil_tc, delays, killmask, out_nsamps,
+                    quantize=quantize, scale=scale,
+                )
+            except Exception as exc:
+                # the probe runs at one small shape; degrade instead of
+                # crashing if the production shape breaks Mosaic limits
+                import warnings
+
+                warnings.warn(
+                    "Pallas dedispersion failed at the production "
+                    f"shape; using the jnp scan: {exc!s:.200}"
+                )
     ndm = delays.shape[0]
     fil_dev = jnp.asarray(fil_tc)
     kill_dev = jnp.asarray(killmask)

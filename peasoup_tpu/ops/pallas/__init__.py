@@ -132,3 +132,56 @@ def probe_pallas_peaks(nbins: int, nlev: int, max_peaks: int) -> bool:
 
 
 from .resample import resample_block_pallas, resample_block  # noqa: E402
+
+
+@lru_cache(maxsize=None)
+def probe_pallas_dedisperse() -> bool:
+    """REAL compile+run probe of the dedispersion kernel (cached per
+    process). Small-shape oracle check: the features that vary by
+    toolchain (dynamic-offset 1-D DMA, dynamic pltpu.roll, SMEM scalar
+    reads) are shape-independent, so one small probe gates the kernel
+    for every production shape."""
+    if not backend_supports_pallas():
+        return False
+    try:
+        import numpy as np
+        import jax.numpy as jnp
+
+        from .dedisperse import dedisperse_pallas
+        from ..dedisperse import dedisperse_block
+
+        rng = np.random.default_rng(0)
+        t, c, d = 8192, 16, 8
+        fil = rng.integers(0, 4, size=(t, c)).astype(np.uint8)
+        # irregular delays exercise every rem/roll combination
+        delays = np.sort(
+            rng.integers(0, 3000, size=(d, c)).astype(np.int32), axis=0
+        )
+        kill = (rng.random(c) > 0.2).astype(np.int32)
+        out_nsamps = t - int(delays.max())
+        got = np.asarray(
+            dedisperse_pallas(fil, delays, kill, out_nsamps, scale=0.9)
+        )
+        ref = np.asarray(
+            dedisperse_block(
+                jnp.asarray(fil), jnp.asarray(delays), jnp.asarray(kill),
+                out_nsamps=out_nsamps, scale=0.9,
+            )
+        )
+        ok = bool(np.array_equal(got, ref))
+        if not ok:
+            import warnings
+
+            warnings.warn(
+                "Pallas dedispersion kernel FAILED the oracle check; "
+                "using the jnp path"
+            )
+        return ok
+    except Exception as exc:  # any Mosaic/compile failure -> jnp path
+        import warnings
+
+        warnings.warn(
+            f"Pallas dedispersion kernel unavailable; using jnp path: "
+            f"{type(exc).__name__}: {exc}"
+        )
+        return False

@@ -1,0 +1,230 @@
+"""Pallas TPU kernel for incoherent dedispersion.
+
+Reference: the shift-and-sum the external ``dedisp`` CUDA library does
+inside ``dedisp_execute`` (used at /root/reference/include/transforms/
+dedisperser.hpp:98-113): out[d, t] = sum_c x[t + delay[d, c], c].
+
+The jnp twin (ops/dedisperse.py:_dedisperse_core) scans channels with a
+(D, T_out) HBM-resident accumulator: every channel step re-reads and
+re-writes the whole accumulator, and every per-channel shift is a
+dynamic slice. This kernel removes both costs:
+
+  * the output block accumulates in VMEM scratch across the channel
+    grid axis (written to HBM once, at the last channel step);
+  * each channel window arrives by ONE dynamic-offset async DMA shared
+    by all 8 trials of the block — adjacent DM trials' delays differ by
+    at most SPREAD samples (computed from the actual delay table), so
+    one window [min-delay .. min-delay + B + SPREAD) covers the whole
+    trial chunk, and each trial's residual shift is one in-VMEM
+    pltpu.roll (dynamic lane rotate).
+
+Layout (same conventions as ops/pallas/resample.py, which established
+the Mosaic rules on this toolchain): the filterbank is passed as a FLAT
+1-D f32 array of 1024-aligned padded CHANNEL rows (killmask
+pre-multiplied); DMA starts are quantized down to 1024 lanes and the
+remainder absorbed by the roll.
+
+Summation order is channel-ascending per output element — identical to
+the jnp twin, and for <=8-bit inputs channel sums are exact integers in
+f32, so results are bitwise equal either way (tests assert equality).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_DT = 8  # DM trials per output block (f32 sublane quantum)
+_CC = 16  # channels per grid step (windows DMA'd per step)
+_QUANT = 1024  # 1-D tiling quantum (lanes): DMA starts/lengths
+
+
+def _window_len(b: int, spread: int) -> int:
+    # covers rem (<1024) + per-trial shift (<=spread) + B output lanes
+    return b + (-(-(spread + _QUANT + 1) // _QUANT)) * _QUANT
+
+
+def _row_stride(t_in: int, b: int, spread: int) -> int:
+    # window starts reach (t_out_pad - B) + max_delay <= t_in - B; add
+    # the window length and round to the 1024 quantum
+    return -(-(t_in + _window_len(b, spread) + 1) // _QUANT) * _QUANT
+
+
+def _kernel(
+    del_ref,  # SMEM (DT, C) i32 delays for this trial chunk (all channels)
+    x_ref,  # HBM flat padded channel rows
+    out_ref,  # VMEM (DT, B) f32 output block (accumulated across c)
+    acc_ref,  # VMEM scratch (DT, B) f32
+    win_ref,  # VMEM scratch (CC*W,) f32 channel windows, flat 1-D
+    # (single rows of a 2-D scratch are not sliceable: Mosaic requires
+    # 8-aligned slices on the sublane dim; 1-D refs tile in 1024-lane
+    # quanta and W is a 1024 multiple)
+    sems,  # DMA semaphores (CC,)
+    *,
+    b: int,
+    w: int,
+    stride: int,
+    cc_count: int,
+    interpret: bool,
+):
+    t = pl.program_id(1)
+    c = pl.program_id(2)
+    nc = pl.num_programs(2)
+    t0 = t * b
+
+    @pl.when(c == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    copies = []
+    for cc in range(cc_count):
+        chan = c * cc_count + cc
+        d0 = del_ref[0, chan]  # delays ascend with trial index
+        u = chan * stride + t0 + d0
+        q = pl.multiple_of((u // _QUANT) * _QUANT, _QUANT)
+        cp = pltpu.make_async_copy(
+            x_ref.at[pl.ds(q, w)],
+            win_ref.at[pl.ds(cc * w, w)],
+            sems.at[cc],
+        )
+        cp.start()
+        copies.append((cp, u - q, chan))
+
+    # per-trial row accumulators live as VALUES across the channel
+    # loop: one concatenate + one acc_ref add per grid step instead of
+    # one per channel
+    rows = [jnp.zeros((1, b), jnp.float32) for _ in range(_DT)]
+    for cc, (cp, rem, chan) in enumerate(copies):
+        cp.wait()
+        d0 = del_ref[0, chan]
+        chunk = win_ref[pl.ds(cc * w, w)].reshape(1, w)
+        for di in range(_DT):
+            shift = rem + (del_ref[di, chan] - d0)
+            if interpret:
+                arm = jax.lax.dynamic_slice(chunk, (0, shift), (1, b))
+            else:
+                arm = pltpu.roll(chunk, w - shift, axis=1)[:, :b]
+            rows[di] = rows[di] + arm
+    acc_ref[:] += jnp.concatenate(rows, axis=0)
+
+    @pl.when(c == nc - 1)
+    def _():
+        out_ref[:] = acc_ref[:]
+
+
+@lru_cache(maxsize=None)
+def _build(
+    d: int, t_out: int, c: int, b: int, spread: int, stride: int,
+    interpret: bool,
+):
+    w = _window_len(b, spread)
+    kernel = partial(
+        _kernel, b=b, w=w, stride=stride, cc_count=_CC, interpret=interpret
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(d // _DT, t_out // b, c // _CC),
+        in_specs=[
+            # full channel width per trial chunk (SMEM blocks must have
+            # their last dim equal to the array's); 8 x C x 4 B = 32 KB
+            # at 1024 channels
+            pl.BlockSpec(
+                (_DT, c), lambda dd, tt, cc: (dd, 0),
+                memory_space=pltpu.SMEM,
+            ),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+        ],
+        out_specs=pl.BlockSpec(
+            (_DT, b), lambda dd, tt, cc: (dd, tt), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((d, t_out), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((_DT, b), jnp.float32),
+            pltpu.VMEM((_CC * w,), jnp.float32),
+            pltpu.SemaphoreType.DMA((_CC,)),
+        ],
+        interpret=interpret,
+    )
+
+
+def plan_spread(delays: np.ndarray) -> int:
+    """Max in-chunk delay spread: max over channels and aligned _DT-trial
+    chunks of delay[last, c] - delay[first, c] (delays ascend with DM)."""
+    d = np.asarray(delays)
+    spread = 0
+    for lo in range(0, d.shape[0], _DT):
+        blk = d[lo : lo + _DT]
+        spread = max(spread, int((blk.max(axis=0) - blk.min(axis=0)).max()))
+    return spread
+
+
+def pallas_hbm_bytes(t_in: int, c: int, d: int, out_nsamps: int) -> int:
+    """Rough peak HBM need of dedisperse_pallas: the padded f32 flat
+    filterbank + the full f32 output (+ the caller-held input). Used by
+    dedisperse_device to keep near-limit trial sets on the blocked jnp
+    path, whose working set is one trial block."""
+    b = min(16384, max(_QUANT, -(-out_nsamps // _QUANT) * _QUANT))
+    t_out = -(-out_nsamps // b) * b
+    cpad = -(-c // _CC) * _CC
+    dpad = -(-d // _DT) * _DT
+    # stride needs the spread, unknown here; bound it with one block
+    stride = _row_stride(t_in, b, b)
+    return 4 * (cpad * stride + dpad * t_out) + t_in * c
+
+
+def dedisperse_pallas(
+    fil_tc,  # (T, C) u8/f32 filterbank (numpy or device array)
+    delays: np.ndarray,  # (D, C) int32
+    killmask: np.ndarray,  # (C,)
+    out_nsamps: int,
+    *,
+    quantize: bool = True,
+    scale: float = 1.0,
+    block: int = 16384,
+    interpret: bool = False,
+) -> jax.Array:
+    """All DM trials in ONE kernel dispatch, bitwise equal to the jnp
+    twin. Trials/channels pad to the (8, 16) grid quanta with repeated/
+    zero rows; output time pads to ``block`` lanes and is trimmed."""
+    delays = np.asarray(delays, dtype=np.int32)
+    d, c = delays.shape
+    t_in = fil_tc.shape[0]
+    # don't let a small search pay a full survey-sized block: the padded
+    # tail beyond out_nsamps is computed and trimmed (row padding keeps
+    # every window in range regardless — see _row_stride)
+    b = min(block, max(_QUANT, -(-out_nsamps // _QUANT) * _QUANT))
+    t_out = -(-out_nsamps // b) * b
+    spread = plan_spread(delays)
+    stride = _row_stride(t_in, b, spread)
+
+    dpad = -(-d // _DT) * _DT
+    cpad = -(-c // _CC) * _CC
+    if dpad > d:
+        # repeat the last trial: keeps delays ascending within chunks
+        delays = np.concatenate(
+            [delays, np.repeat(delays[-1:], dpad - d, axis=0)]
+        )
+    if cpad > c:
+        # extra channels: zero data rows at the max existing delay so
+        # windows stay in range and contribute exact zeros
+        delays = np.concatenate(
+            [delays, np.tile(delays[:, -1:], (1, cpad - c))], axis=1
+        )
+
+    x = jnp.asarray(fil_tc).astype(jnp.float32)
+    x = x * jnp.asarray(killmask, jnp.float32)[None, :]
+    # flat padded channel rows (tail zeros; never selected into output)
+    xp = jnp.pad(x.T, ((0, cpad - c), (0, stride - t_in))).reshape(-1)
+
+    fn = _build(dpad, t_out, cpad, b, spread, stride, interpret)
+    out = fn(jnp.asarray(delays), xp)[:d, :out_nsamps]
+    if scale != 1.0:
+        out = out * jnp.float32(scale)
+    if quantize:
+        out = jnp.clip(jnp.rint(out), 0, 255).astype(jnp.uint8)
+    return out

@@ -294,3 +294,68 @@ class TestPallasPeaks:
         np.testing.assert_array_equal(
             np.asarray(plain.ccounts), np.asarray(fused.ccounts)
         )
+
+
+class TestPallasDedisperse:
+    """Interpret-mode parity of the Pallas dedispersion kernel
+    (ops/pallas/dedisperse.py) against the jnp scan."""
+
+    def _delays(self, d, c, dm_max=60.0):
+        from peasoup_tpu.plan.dm_plan import delay_table
+
+        k = np.abs(delay_table(1400.0, -8.0, c, 0.000256))
+        dms = np.linspace(0.0, dm_max, d)
+        return np.rint(dms[:, None] * k[None, :]).astype(np.int32)
+
+    @pytest.mark.parametrize(
+        "d,c,t", [(6, 16, 4096), (24, 32, 8192), (8, 16, 1500), (9, 17, 3000)]
+    )
+    def test_matches_jnp_bitwise(self, rng, d, c, t):
+        from peasoup_tpu.ops.dedisperse import dedisperse
+        from peasoup_tpu.ops.pallas.dedisperse import dedisperse_pallas
+
+        delays = self._delays(d, c)
+        out_nsamps = t - int(delays.max())
+        fil = rng.integers(0, 4, size=(t, c)).astype(np.uint8)
+        kill = (rng.random(c) > 0.2).astype(np.int32)
+        ref = dedisperse(fil, delays, kill, out_nsamps, scale=0.7)
+        got = np.asarray(
+            dedisperse_pallas(
+                fil, delays, kill, out_nsamps, scale=0.7, interpret=True
+            )
+        )
+        np.testing.assert_array_equal(ref, got)
+
+    def test_unquantized_f32(self, rng):
+        from peasoup_tpu.ops.dedisperse import dedisperse_block
+        from peasoup_tpu.ops.pallas.dedisperse import dedisperse_pallas
+
+        delays = self._delays(8, 16)
+        t = 4096
+        out_nsamps = t - int(delays.max())
+        fil = rng.normal(10.0, 2.0, size=(t, 16)).astype(np.float32)
+        ref = np.asarray(
+            dedisperse_block(
+                jnp.asarray(fil), jnp.asarray(delays),
+                jnp.ones(16, jnp.float32), out_nsamps=out_nsamps,
+                quantize=False,
+            )
+        )
+        got = np.asarray(
+            dedisperse_pallas(
+                fil, delays, np.ones(16, np.int32), out_nsamps,
+                quantize=False, interpret=True,
+            )
+        )
+        np.testing.assert_array_equal(ref, got)
+
+    def test_plan_spread(self):
+        from peasoup_tpu.ops.pallas.dedisperse import _DT, plan_spread
+
+        delays = self._delays(3 * _DT + 2, 16)
+        s = plan_spread(delays)
+        assert s >= 0
+        # spread of any aligned chunk never exceeds the reported max
+        for lo in range(0, delays.shape[0], _DT):
+            blk = delays[lo : lo + _DT]
+            assert int((blk.max(0) - blk.min(0)).max()) <= s
