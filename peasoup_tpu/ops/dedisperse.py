@@ -157,6 +157,91 @@ def dedisperse_device(
     quantize: bool = True,
     scale: float = 1.0,
     block: int = 16,
+    chunk_bytes: int = 3_000_000_000,
+) -> jax.Array:
+    """Channel-chunking front end: both engines below materialise an
+    f32 copy of their input (C * T * 4 bytes), which at survey scale
+    (2^21 samples x 1024+ channels ~ 8.6 GB) crowds HBM and has been
+    seen to crash the XLA compile helper outright. Channels split into
+    chunks whose f32 copy stays under ``chunk_bytes``; f32 partial
+    sums accumulate in channel-ascending order (bitwise-identical for
+    the <=8-bit integer inputs the pipeline produces — channel sums
+    are exact in f32; pure-f32 filterbanks may differ by summation
+    association, i.e. 1 quantized LSB), and quantize/scale apply once
+    at the end. The DM axis also splits when the live f32 partials
+    (acc + part) would exceed the chunk budget."""
+    c = delays.shape[1]
+    t_in = fil_tc.shape[0]
+    cc = max(1, int(chunk_bytes // max(1, 4 * t_in)))
+    if cc >= c:
+        return _dedisperse_device_once(
+            fil_tc, delays, killmask, out_nsamps,
+            quantize=quantize, scale=scale, block=block,
+        )
+    delays = np.asarray(delays)
+    seg = -(-max(block, chunk_bytes // (out_nsamps * 8)) // block) * block
+    if seg < delays.shape[0]:
+        # bound the two live (D, out) f32 partials: recurse per DM
+        # segment (segments concatenate as quantized u8); when even one
+        # block-sized segment overshoots the budget, proceed anyway —
+        # a single block is the minimum unit of work
+        parts = [
+            dedisperse_device(
+                fil_tc, delays[s0 : s0 + seg], killmask, out_nsamps,
+                quantize=quantize, scale=scale, block=block,
+                chunk_bytes=chunk_bytes,
+            )
+            for s0 in range(0, delays.shape[0], seg)
+        ]
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+    killmask = np.asarray(killmask)
+    # pad the tail chunk (repeated delay column, zero killmask — inert)
+    # so every chunk reuses ONE compiled shape
+    cpad = -(-c // cc) * cc
+    if cpad > c:
+        delays = np.concatenate(
+            [delays, np.tile(delays[:, -1:], (1, cpad - c))], axis=1
+        )
+        killmask = np.concatenate(
+            [killmask, np.zeros(cpad - c, killmask.dtype)]
+        )
+        pad_cols = np.zeros(
+            (t_in, cpad - c), dtype=np.asarray(fil_tc[:1, :1]).dtype
+        )
+    acc = None
+    for lo in range(0, cpad, cc):
+        if lo + cc <= c:
+            fil_chunk = fil_tc[:, lo : lo + cc]
+        else:
+            fil_chunk = jnp.concatenate(
+                [jnp.asarray(fil_tc[:, lo:c]), jnp.asarray(pad_cols)], axis=1
+            )
+        part = _dedisperse_device_once(
+            fil_chunk,
+            delays[:, lo : lo + cc],
+            killmask[lo : lo + cc],
+            out_nsamps,
+            quantize=False,
+            scale=1.0,
+            block=block,
+        )
+        acc = part if acc is None else acc + part
+    if scale != 1.0:
+        acc = acc * jnp.float32(scale)
+    if quantize:
+        acc = jnp.clip(jnp.rint(acc), 0, 255).astype(jnp.uint8)
+    return acc
+
+
+def _dedisperse_device_once(
+    fil_tc: np.ndarray,
+    delays: np.ndarray,
+    killmask: np.ndarray,
+    out_nsamps: int,
+    *,
+    quantize: bool = True,
+    scale: float = 1.0,
+    block: int = 16,
 ) -> jax.Array:
     """Dedisperse all DM trials in device-sized blocks, keeping the
     (ndm, out_nsamps) result RESIDENT on device.
@@ -454,25 +539,22 @@ def dedisperse(
     scale: float = 1.0,
     block: int = 16,
 ) -> np.ndarray:
-    """Host-resident variant: trials are fetched per device block, so
-    HBM never holds more than one block (for surveys whose full trial
-    set would crowd the chip; cf. reference host-RAM trials,
-    dedisperser.hpp:101-103)."""
+    """Host-resident variant: trials land in host RAM segment by
+    segment, so HBM never holds more than one DM segment's outputs
+    (for surveys whose full trial set would crowd the chip; cf.
+    reference host-RAM trials, dedisperser.hpp:101-103). The u8
+    filterbank stages on device ONCE and every segment routes through
+    dedisperse_device, inheriting its Pallas dispatch and
+    channel-chunking (the f32-input-copy bound applies here too)."""
     ndm = delays.shape[0]
+    delays = np.asarray(delays)
     fil_dev = jnp.asarray(fil_tc)
-    kill_dev = jnp.asarray(killmask)
+    seg = -(-max(block, 1_000_000_000 // max(1, out_nsamps)) // block) * block
     outs = []
-    for start in range(0, ndm, block):
-        d = np.asarray(delays[start : start + block], dtype=np.int32)
-        pad = 0
-        if len(d) < block:
-            pad = block - len(d)
-            d = np.pad(d, ((0, pad), (0, 0)))
-        res = np.asarray(
-            dedisperse_block(
-                fil_dev, jnp.asarray(d), kill_dev,
-                out_nsamps=out_nsamps, quantize=quantize, scale=scale,
-            )
+    for start in range(0, ndm, seg):
+        res = dedisperse_device(
+            fil_dev, delays[start : start + seg], killmask, out_nsamps,
+            quantize=quantize, scale=scale, block=block,
         )
-        outs.append(res[: block - pad] if pad else res)
+        outs.append(np.asarray(res))
     return np.concatenate(outs, axis=0)

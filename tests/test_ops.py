@@ -469,6 +469,47 @@ class TestDedisperse:
             )
             np.testing.assert_array_equal(direct, sub)
 
+    def test_channel_chunked_device_wrapper(self, rng):
+        """Tiny chunk_bytes forces channel chunking (with a padded tail
+        chunk) AND the DM-segment recursion; results must equal the
+        unchunked path exactly for integer inputs."""
+        from peasoup_tpu.ops.dedisperse import dedisperse_device
+
+        t, c, d = 2048, 23, 21  # awkward: c % cc != 0, d % seg != 0
+        fil = rng.integers(0, 4, size=(t, c)).astype(np.uint8)
+        delays = np.sort(
+            rng.integers(0, 99, size=(d, c)).astype(np.int32), axis=0
+        )
+        kill = (rng.random(c) > 0.2).astype(np.int32)
+        out_nsamps = t - int(delays.max())
+        ref = np.asarray(
+            dedisperse_device(fil, delays, kill, out_nsamps, scale=0.5)
+        )
+        got = np.asarray(
+            dedisperse_device(
+                fil, delays, kill, out_nsamps, scale=0.5,
+                chunk_bytes=t * 4 * 5,  # 5 channels per chunk
+                block=4,
+            )
+        )
+        np.testing.assert_array_equal(ref, got)
+
+    def test_spill_segments_match_device(self, rng):
+        from peasoup_tpu.ops.dedisperse import dedisperse, dedisperse_device
+
+        t, c, d = 1024, 8, 11
+        fil = rng.integers(0, 4, size=(t, c)).astype(np.uint8)
+        delays = np.sort(
+            rng.integers(0, 64, size=(d, c)).astype(np.int32), axis=0
+        )
+        out_nsamps = t - int(delays.max())
+        ref = np.asarray(
+            dedisperse_device(fil, delays, np.ones(c, np.int32), out_nsamps)
+        )
+        got = dedisperse(fil, delays, np.ones(c, np.int32), out_nsamps,
+                         block=4)
+        np.testing.assert_array_equal(ref, got)
+
     def test_subband_killmask_and_scale(self, rng):
         from peasoup_tpu.ops.dedisperse import dedisperse_subband
 
