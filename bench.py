@@ -55,6 +55,45 @@ def bench_fft(n: int = 1 << 23, iters: int = 50) -> int:
     return 0
 
 
+def bench_recall() -> int:
+    """Golden end-to-end recall vs the reference CUDA run (BASELINE.md's
+    headline correctness metric): run tutorial.fil with the golden run's
+    exact flags and match candidates against
+    /root/reference/example_output/overview.xml.  vs_baseline is recall
+    itself (1.0 = full parity with the CUDA candidate list)."""
+    import tempfile
+
+    from peasoup_tpu.cli.peasoup import main as peasoup_main
+    from peasoup_tpu.tools.recall import match_golden
+
+    fil_path = os.environ.get(
+        "PEASOUP_BENCH_FIL", "/root/reference/example_data/tutorial.fil"
+    )
+    with tempfile.TemporaryDirectory() as outdir:
+        rc = peasoup_main(
+            [
+                "-i", fil_path, "-o", outdir,
+                "--dm_end", "250", "--acc_start", "-5", "--acc_end", "5",
+                "--npdmp", "10",
+            ]
+        )
+        if rc != 0:
+            return rc
+        rep = match_golden(os.path.join(outdir, "overview.xml"))
+    print(rep.summary(), file=sys.stderr)
+    print(
+        json.dumps(
+            {
+                "metric": "golden_candidate_recall",
+                "value": round(rep.recall, 4),
+                "unit": "fraction of 10 golden candidates",
+                "vs_baseline": round(rep.recall, 4),
+            }
+        )
+    )
+    return 0
+
+
 def main() -> int:
     from peasoup_tpu.io import read_filterbank
     from peasoup_tpu.pipeline import PeasoupSearch, SearchConfig
@@ -107,4 +146,6 @@ def main() -> int:
 if __name__ == "__main__":
     if "--fft" in sys.argv:
         sys.exit(bench_fft())
+    if "--recall" in sys.argv:
+        sys.exit(bench_recall())
     sys.exit(main())

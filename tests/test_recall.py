@@ -1,0 +1,114 @@
+"""Golden end-to-end recall gate vs the reference CUDA run.
+
+The reference ships tutorial.fil plus the outputs of `peasoup -i
+tutorial.fil --dm_end 250 --acc_start -5 --acc_end 5 --npdmp 10 -p`
+(SURVEY.md section 4: example_output/{overview.xml,candidates.peasoup},
+10 candidates, top one P=249.94 ms at DM=19.76 with S/N 87).  This test
+runs our full pipeline with the same flags through the real CLI (so the
+output writers are exercised too) and gates on 100% recall of the 10
+golden candidates via peasoup_tpu.tools.recall.
+
+Known, accepted deltas vs the golden list (documented here per VERDICT
+round 1 item 2):
+- acc: on 4 of the 10, the reference's acceleration distiller crowned a
+  member of the association cluster at acc=+-5 m/s^2 while ours crowns
+  acc=0 (or vice versa).  tutorial.fil's pulsar is not accelerated, so
+  the +-5 entries are statistical ties; frequency/DM/nh/S/N all agree.
+- snr: within 0.6% relative on every candidate (float accumulation
+  order differs on TPU/XLA).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from peasoup_tpu.tools.recall import GOLDEN_OVERVIEW, match_golden
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(GOLDEN_OVERVIEW), reason="golden outputs not available"
+)
+
+
+@pytest.fixture(scope="session")
+def golden_run_outdir(tutorial_fil, tmp_path_factory):
+    """One full golden-flags CLI run per test session (~100 s on CPU)."""
+    from peasoup_tpu.cli.peasoup import main
+
+    outdir = str(tmp_path_factory.mktemp("golden_run"))
+    rc = main(
+        [
+            "-i", tutorial_fil,
+            "-o", outdir,
+            "--dm_end", "250",
+            "--acc_start", "-5",
+            "--acc_end", "5",
+            "--npdmp", "10",
+        ]
+    )
+    assert rc == 0
+    return outdir
+
+
+def test_golden_recall_100pct(golden_run_outdir):
+    rep = match_golden(os.path.join(golden_run_outdir, "overview.xml"))
+    print("\n" + rep.summary())
+    assert rep.n_golden == 10
+    assert rep.recall == 1.0, rep.summary()
+    # Every matched candidate's S/N within 25% (measured: within 0.6%).
+    assert rep.snr_ok_frac == 1.0, rep.summary()
+
+
+def test_golden_matches_are_tight(golden_run_outdir):
+    """Beyond recall: frequency to ~1e-7 rel, DM exact, nh exact, and the
+    ten golden candidates occupy the top ten ranks of our list."""
+    rep = match_golden(os.path.join(golden_run_outdir, "overview.xml"))
+    for m in rep.matches:
+        assert m.matched
+        assert m.dfreq_rel < 1e-6, m
+        assert abs(m.ddm) < 1e-3, m
+        assert m.dnh == 0, m
+        assert abs(m.dsnr_rel) < 0.01, m
+    assert sorted(m.our_rank for m in rep.matches) == list(range(10)), [
+        m.our_rank for m in rep.matches
+    ]
+
+
+def test_golden_binary_parses(golden_run_outdir):
+    """Our candidates.peasoup is byte-offset addressable like the
+    reference's (output_stats.hpp:221-270) and FOLD blocks exist for the
+    npdmp=10 folded candidates."""
+    from peasoup_tpu.tools.parsers import CandidateFileParser, OverviewFile
+
+    o = OverviewFile(os.path.join(golden_run_outdir, "overview.xml"))
+    with CandidateFileParser(
+        os.path.join(golden_run_outdir, "candidates.peasoup")
+    ) as p:
+        n_folds = 0
+        for row in o.candidates:
+            rec = p.read_candidate(int(row["byte_offset"]))
+            assert len(rec["hits"]) >= 1
+            if rec["fold"] is not None:
+                n_folds += 1
+                assert np.isfinite(rec["fold"]).all()
+    assert n_folds >= 10
+
+
+# ---- fast unit tests of the matcher itself (no pipeline run) ----------
+
+
+def test_matcher_self_match():
+    rep = match_golden(GOLDEN_OVERVIEW, GOLDEN_OVERVIEW)
+    assert rep.recall == 1.0
+    for m in rep.matches:
+        assert m.dfreq_rel == 0.0 and m.ddm == 0.0 and m.dnh == 0
+
+
+def test_matcher_rejects_unrelated():
+    from peasoup_tpu.tools.parsers import OverviewFile
+
+    g = OverviewFile(GOLDEN_OVERVIEW).candidates
+    shifted = g.copy()
+    shifted["period"] = shifted["period"] * 1.5  # off-tolerance everywhere
+    rep = match_golden(shifted, g)
+    assert rep.recall == 0.0
