@@ -113,13 +113,32 @@ static inline int harmonic_hits(double fundi, double freq, int32_t nh,
                                 double lo, double hi, int32_t max_harm,
                                 int32_t fractional, bool early_exit) {
   const int32_t max_denom = fractional ? (int32_t{1} << nh) : int32_t{1};
+  if (early_exit) {
+    // Existence check only.  For fixed jj the ratio kk*freq/(jj*fundi)
+    // is strictly increasing in kk, so at most a couple of kk values
+    // can land inside (lo, hi): locate the window with one divide and
+    // verify those candidates with the EXACT original predicate (the
+    // located bounds are approximate in double, the decision is not).
+    for (int32_t jj = 1; jj <= max_harm; ++jj) {
+      const double denom = jj * fundi;
+      const double k0 = lo * denom / freq;  // ratio(kk) > lo ~ kk > k0
+      int32_t kk = static_cast<int32_t>(k0);  // trunc; candidates k0 +- 1
+      if (kk < 1) kk = 1;
+      const int32_t kk_end = kk + 2 < max_denom ? kk + 2 : max_denom;
+      for (; kk <= kk_end; ++kk) {
+        const double ratio = kk * freq / denom;
+        if (ratio > lo && ratio < hi) return 1;
+        if (ratio >= hi) break;  // increasing in kk: no later hit
+      }
+    }
+    return 0;
+  }
   int hits = 0;
   for (int32_t jj = 1; jj <= max_harm; ++jj) {
     for (int32_t kk = 1; kk <= max_denom; ++kk) {
       const double ratio = kk * freq / (jj * fundi);
       if (ratio > lo && ratio < hi) {
         ++hits;
-        if (early_exit) return hits;
       }
     }
   }

@@ -218,6 +218,11 @@ class PeasoupSearch:
         # every later wave at the learned size so steady state
         # dispatches each chunk exactly once
         self._learned_max_peaks = 0
+        # speculative ragged-fetch size: each wave's peak stream is
+        # compacted at this pow2 size and shipped WITH the counts in one
+        # transfer; chunks whose true total exceeds it pay a second
+        # exact-size fetch and raise the speculation for later waves
+        self._learned_total_pad = 4096
         # size budgets from the real chip when it tells us (memory_stats
         # is absent on some backends, e.g. the CPU mesh in tests)
         import jax
@@ -325,9 +330,43 @@ class PeasoupSearch:
         # (dedisperser.hpp:101-103) and pay a per-chunk upload instead
         trials_bytes = dm_plan.ndm * dm_plan.out_nsamps
         spill = trials_bytes > self.TRIALS_DEVICE_LIMIT
+        # --- device selection: shard DM trials over local chips --------
+        # (the reference's analogue: one worker per GPU up to -t,
+        # pipeline_multi.cu:276-277). Selected BEFORE dedispersion so the
+        # trial set is produced already sharded over the mesh — the
+        # reference likewise dedisperses across all GPUs
+        # (dedisp_create_plan_multi, dedisperser.hpp:25-31)
+        devices = self._pick_devices()
+        mesh = None
+        if len(devices) > 1:
+            from ..parallel.mesh import make_mesh
+
+            mesh = make_mesh({"dm": len(devices)}, devices=devices)
         with trace_span("Dedisperse"):  # NVTX parity: pipeline_multi.cu:318
             scale = output_scale(fil.nbits, int(dm_plan.killmask.sum()))
-            if cfg.subbands > 0:
+            # sharded dedispersion wants the whole masked f32 filterbank
+            # replicated per chip; bigger inputs fall back to the
+            # channel-chunked single-device engines
+            shard_dd = (
+                mesh is not None
+                and not spill
+                and cfg.subbands == 0
+                and 4 * fil.nsamps * fil.nchans < 3_000_000_000
+            )
+            self._trials_sharded = shard_dd
+            if shard_dd:
+                from ..parallel.sharded_dedisperse import dedisperse_sharded
+
+                trials = dedisperse_sharded(
+                    fil_to_device(fil),
+                    dm_plan.delay_samples(),
+                    dm_plan.killmask,
+                    dm_plan.out_nsamps,
+                    mesh,
+                    scale=scale,
+                    block=cfg.dedisp_block,
+                )
+            elif cfg.subbands > 0:
                 trials = dedisperse_subband(
                     fil.data if spill else fil_to_device(fil),
                     dm_plan.delay_samples(),
@@ -449,17 +488,11 @@ class PeasoupSearch:
         self._peaks_probe_nlev = cfg.nharmonics + 1
         self._peaks_probe_nbins = size_spec
 
-        # --- device selection: shard DM trials over local chips --------
-        # (the reference's analogue: one worker per GPU up to -t,
-        # pipeline_multi.cu:276-277)
-        devices = self._pick_devices()
-        if len(devices) > 1:
-            from ..parallel.mesh import make_mesh
+        # --- search-side mesh wiring (mesh chosen before dedispersion) --
+        if mesh is not None:
             from ..parallel.sharded_search import make_sharded_search_fn
 
             from jax.sharding import NamedSharding, PartitionSpec
-
-            mesh = make_mesh({"dm": len(devices)}, devices=devices)
 
             def build_search(pb: int, pp: bool = pallas_peaks):
                 return make_sharded_search_fn(
@@ -470,6 +503,7 @@ class PeasoupSearch:
 
             # stage blocks directly onto the mesh (no hop through chip 0)
             self._dm_sharding = NamedSharding(mesh, PartitionSpec("dm"))
+            self._mesh = mesh
         else:
 
             def build_search(pb: int, pp: bool = pallas_peaks):
@@ -479,6 +513,7 @@ class PeasoupSearch:
                 )
 
             self._dm_sharding = None
+            self._mesh = None
         search_block = build_search(pallas_block)
         self._build_search = build_search
         self._cur_pallas_block = pallas_block
@@ -686,7 +721,10 @@ class PeasoupSearch:
             _offset_dm_idx(dm_trial_cands.cands, dm_lo)
         part = PartialSearchResult(
             cands=dm_trial_cands.cands,
-            trials=trials,
+            # drop dedisperse_sharded's row padding: the folder derives
+            # its owned dm_idx range from len(trials) (folder.py:91) and
+            # padded rows would overlap the next multi-host slice
+            trials=trials[: dm_plan.ndm],
             trials_nsamps=trials_nsamps,
             dm_offset=dm_lo,
             dm_list=dm_plan.dm_list,
@@ -936,22 +974,39 @@ class PeasoupSearch:
             )
         import jax
 
+        idx = np.asarray(block_idx, dtype=np.int32)
         if isinstance(trials, np.ndarray):
-            # spilled trials: slice on host, upload the chunk
-            rows = jnp.asarray(trials[block_idx, :tim_len])
+            # spilled trials: slice on host, upload the chunk (sharded
+            # straight onto the mesh when one is active)
+            rows = trials[idx, :tim_len]
+            tims_dev = (
+                jax.device_put(rows, self._dm_sharding)
+                if self._dm_sharding is not None
+                else jnp.asarray(rows)
+            )
+        elif self._mesh is not None and getattr(self, "_trials_sharded", False):
+            # trials live SHARDED on the mesh (dedisperse_sharded):
+            # regroup the chunk's rows on-device — XLA moves only the
+            # needed u8 rows chip-to-chip over ICI, no host hop
+            from ..parallel.sharded_dedisperse import make_row_gather
+
+            gather = make_row_gather(self._mesh, "dm", tim_len)
+            tims_dev = gather(trials, jnp.asarray(idx))
         else:
-            # trial rows are sliced ON DEVICE (trials never left the chip)
-            rows = jnp.take(
-                trials,
-                jnp.asarray(np.asarray(block_idx, dtype=np.int32)),
-                axis=0,
-            )[:, :tim_len]
-        if self._dm_sharding is not None:
-            tims_dev = jax.device_put(rows, self._dm_sharding)
-            afs_dev = jax.device_put(afs, self._dm_sharding)
-        else:
-            tims_dev = rows
-            afs_dev = jnp.asarray(afs)
+            # single-device trials: trial rows are sliced ON DEVICE,
+            # then (with a mesh active but unsharded trials, e.g. the
+            # subband path) staged onto the mesh
+            rows = jnp.take(trials, jnp.asarray(idx), axis=0)[:, :tim_len]
+            tims_dev = (
+                jax.device_put(rows, self._dm_sharding)
+                if self._dm_sharding is not None
+                else rows
+            )
+        afs_dev = (
+            jax.device_put(afs, self._dm_sharding)
+            if self._dm_sharding is not None
+            else jnp.asarray(afs)
+        )
         peaks = search_block(
             tims_dev,
             afs_dev,
@@ -971,8 +1026,14 @@ class PeasoupSearch:
         search_block, per_dm_results, *, size, nsamps_valid, pos5, pos25,
         tsamp,
     ) -> None:
-        """Dispatch every chunk of the wave, then fetch results with two
-        packed D2H transfers (counts, then count-trimmed peaks)."""
+        """Dispatch every chunk of the wave, then fetch results with ONE
+        packed D2H transfer: counts, cluster counts, AND the ragged peak
+        stream compacted at a learned speculative size ride together.
+        The link's per-transfer latency dwarfs the payload, so a second
+        round trip only happens when the speculation was too small (the
+        first-ever wave) or a chunk's compaction overflowed."""
+        from ..ops.peaks import compact_peaks_device
+
         cfg = self.config
         nlev = cfg.nharmonics + 1
         disp = dict(
@@ -983,7 +1044,9 @@ class PeasoupSearch:
                 search_block)
 
         mp0 = max(cfg.max_peaks, self._learned_max_peaks)
+        spec_pad = self._learned_total_pad
         pend = []
+        spec_pieces = []
         for chunk in wave:
             peaks, padded = self._dispatch_chunk(chunk, *args, mp0, **disp)
             # record which peaks mode produced this chunk: a mid-wave
@@ -993,22 +1056,30 @@ class PeasoupSearch:
                 [chunk, mp0, peaks, padded,
                  getattr(self, "_pallas_peaks", False)]
             )
-
-        # ONE packed counts transfer (raw crossing counts for overflow
-        # detection + cluster counts for fetch trimming) for the whole
-        # wave; chunks whose static compaction overflowed are
-        # re-dispatched with the next power-of-two size (the reference
-        # sizes for 100000 up front, peakfinder.hpp:61) -- rare, and
-        # only they pay extra round trips
-        counts_flat = np.asarray(
-            jnp.concatenate(
-                [p.counts.reshape(-1) for _, _, p, _, _ in pend]
-                + [p.ccounts.reshape(-1) for _, _, p, _, _ in pend]
+            spec_pieces.append(
+                compact_peaks_device(
+                    peaks.idxs, peaks.snrs, peaks.ccounts,
+                    total_pad=spec_pad,
+                )
             )
-        )
+
+        # ONE packed transfer for the whole wave: raw crossing counts
+        # (overflow detection), cluster counts (fetch trimming), and the
+        # speculatively compacted peak streams. Chunks whose static
+        # compaction overflowed are re-dispatched with the next
+        # power-of-two size (the reference sizes for 100000 up front,
+        # peakfinder.hpp:61) -- rare, and only they pay extra round trips
+        count_vec = [p.counts.reshape(-1) for _, _, p, _, _ in pend] + [
+            p.ccounts.reshape(-1) for _, _, p, _, _ in pend
+        ]
+        ncounts = sum(int(v.shape[0]) for v in count_vec)
+        packed_all = np.asarray(jnp.concatenate(count_vec + spec_pieces))
+        counts_flat = packed_all[:ncounts]
+        spec_flat = packed_all[ncounts:]
         half = counts_flat.size // 2
         counts_list = []
         ccounts_list = []
+        redispatched = []
         off = 0
         for entry in pend:
             chunk, max_peaks, peaks, padded, fused = entry
@@ -1018,6 +1089,7 @@ class PeasoupSearch:
                 -1, nlev, padded
             )
             off += n
+            redisp = False
             # overflow: raw crossings outgrew the compaction (jnp
             # path) or clusters outgrew it (fused-kernel path)
             ov = ccounts if fused else counts
@@ -1051,43 +1123,42 @@ class PeasoupSearch:
                 ccounts = np.asarray(peaks.ccounts)
                 ov = ccounts if fused else counts
                 entry[1:] = [max_peaks, peaks, padded, fused]
+                redisp = True
             counts_list.append(counts)
             ccounts_list.append(ccounts)
+            redispatched.append(redisp)
 
-        # ONE ragged packed peak transfer: the host already knows every
-        # cell's cluster count, so the device gathers EXACTLY the valid
-        # (idx, snr) slots (pow2-padded total to bound recompiles) —
-        # the slot arrays are mostly padding and the link is slow
-        from ..ops.peaks import compact_peaks_device
-
-        totals, pieces = [], []
-        for (chunk, max_peaks, peaks, padded, _), ccounts in zip(
-            pend, ccounts_list
+        # Unpack each chunk's ragged peak stream. The speculative piece
+        # that rode the counts transfer serves whenever the chunk was
+        # not re-dispatched and its true total fits spec_pad; otherwise
+        # (first-ever wave, busier data, or escalation) compact at the
+        # exact pow2-padded size and pay one extra transfer — and learn
+        # the size so the next wave's speculation covers it.
+        for i, ((chunk, max_peaks, peaks, padded, _), ccounts) in enumerate(
+            zip(pend, ccounts_list)
         ):
-            cc = np.minimum(ccounts, max_peaks)
-            total = int(cc.sum())
+            cc0 = np.minimum(ccounts, max_peaks)
+            total = int(cc0.sum())
             total_pad = 1 << max(6, int(np.ceil(np.log2(max(1, total)))))
-            totals.append(total_pad)
-            pieces.append(
-                compact_peaks_device(
-                    peaks.idxs, peaks.snrs, peaks.ccounts,
-                    total_pad=total_pad,
+            # learn upward, but cap the speculation: one RFI-storm chunk
+            # must not permanently inflate every later chunk's payload
+            # beyond what the saved round trip is worth (~512 KiB)
+            self._learned_total_pad = min(
+                max(self._learned_total_pad, total_pad), 1 << 16
+            )
+            if not redispatched[i] and total <= spec_pad:
+                piece = spec_flat[2 * spec_pad * i : 2 * spec_pad * (i + 1)]
+                total_pad = spec_pad
+            else:
+                piece = np.asarray(
+                    compact_peaks_device(
+                        peaks.idxs, peaks.snrs, peaks.ccounts,
+                        total_pad=total_pad,
+                    )
                 )
-            )
-        packed = np.asarray(
-            pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
-        )
-
-        off = 0
-        for (chunk, max_peaks, peaks, padded, _), ccounts, total_pad in zip(
-            pend, ccounts_list, totals
-        ):
-            vi = packed[off : off + total_pad]
-            vs = packed[off + total_pad : off + 2 * total_pad].view(
-                np.float32
-            )
-            off += 2 * total_pad
-            cc = np.minimum(ccounts, max_peaks)  # (d, nlev, padded)
+            vi = piece[:total_pad]
+            vs = piece[total_pad : 2 * total_pad].view(np.float32)
+            cc = cc0  # (d, nlev, padded)
             # per-row entry ranges within the chunk's ragged stream
             row_ends = np.cumsum(cc.reshape(cc.shape[0], -1).sum(axis=1))
             dm_indices = chunk[0]

@@ -215,6 +215,24 @@ class TestEndToEnd:
             assert a.freq == b.freq and a.snr == b.snr
             assert a.dm == b.dm and a.acc == b.acc and a.nh == b.nh
 
+    def test_sharded_search_with_unsharded_trials(self, synthetic):
+        """Mesh active but trials from a single-device engine (the
+        subband path bypasses dedisperse_sharded): the chunk dispatch
+        must stage rows onto the mesh, not assume mesh-sharded trials."""
+        if len(jax.devices()) < 8:
+            pytest.skip("need 8 devices")
+        path, _, _ = synthetic
+        fil = read_filterbank(path)
+        common = dict(dm_end=40.0, nharmonics=2, npdmp=0, limit=100,
+                      subbands=8, subband_smear=0.0)
+        single = PeasoupSearch(SearchConfig(**common)).run(fil)
+        sharded = PeasoupSearch(
+            SearchConfig(shard_devices=8, **common)
+        ).run(fil)
+        assert len(single.candidates) == len(sharded.candidates) > 0
+        for a, b in zip(single.candidates, sharded.candidates):
+            assert a.freq == b.freq and a.snr == b.snr
+
 
 class TestDistillers:
     def test_harmonic_distiller_absorbs(self):
