@@ -281,10 +281,16 @@ def _dedisperse_device_once(
             limit = 12_000_000_000
         if need < 0.6 * limit:
             try:
-                return dedisperse_pallas(
+                res = dedisperse_pallas(
                     fil_tc, delays, killmask, out_nsamps,
                     quantize=quantize, scale=scale,
                 )
+                # force execution INSIDE the try: TPU runtime failures
+                # that surface asynchronously (e.g. allocation at a
+                # later sync) must also degrade to the jnp path, not
+                # crash the search (ADVICE r1)
+                jax.block_until_ready(res)
+                return res
             except Exception as exc:
                 # the probe runs at one small shape; degrade instead of
                 # crashing if the production shape breaks Mosaic limits

@@ -171,9 +171,10 @@ def _fused_level_sums(p: jnp.ndarray, nharms: int) -> jnp.ndarray:
     return out.reshape(*p.shape[:-1], H, nbins_pad)
 
 
-@partial(jax.jit, static_argnames=("nharms", "method"))
+@partial(jax.jit, static_argnames=("nharms", "method", "scaled"))
 def harmonic_sums(
-    p: jnp.ndarray, *, nharms: int = 4, method: str = "conv"
+    p: jnp.ndarray, *, nharms: int = 4, method: str = "conv",
+    scaled: bool = True,
 ) -> list[jnp.ndarray]:
     """Cumulative fractional-harmonic sums of a spectrum.
 
@@ -185,13 +186,20 @@ def harmonic_sums(
         (direct gather) — all three bitwise-identical — or "fused"
         (all levels in one near-full-depth MXU matmul; differs only
         in f32 summation order).
+      scaled: apply the reference's rsqrt(2^h) per-level factor here.
+        False skips it (one full HBM pass per level) for consumers that
+        scale downstream, e.g. the Pallas peaks kernel scaling in VMEM.
 
     Returns a list of ``nharms`` arrays shaped like ``p``; entry h-1 is
-    the 2^h-harmonic sum scaled by rsqrt(2^h).
+    the 2^h-harmonic sum, scaled by rsqrt(2^h) unless ``scaled=False``.
     """
     if not 0 < nharms <= 5:
         raise ValueError("nharms must be in 1..5")
     nbins = p.shape[-1]
+
+    def lvl_out(val, h):
+        return val * jnp.float32(2.0 ** (-h / 2.0)) if scaled else val
+
     if method == "conv":
         P = _CONV_P
         npad = -(-nbins // P) * P
@@ -204,7 +212,7 @@ def harmonic_sums(
             for k in range(1, 1 << h, 2):  # odd: new gathers this level
                 g = _gather_conv(x, Q, k, h)
                 val = val + g.reshape(*p.shape[:-1], Q * P)[..., :nbins]
-            out.append(val * jnp.float32(2.0 ** (-h / 2.0)))
+            out.append(lvl_out(val, h))
         return out
     if method == "take":
         i = jnp.arange(nbins, dtype=jnp.int32)
@@ -215,7 +223,7 @@ def harmonic_sums(
             for k in range(1, 1 << h, 2):  # odd: new gathers this level
                 src = (i * k + half) >> h
                 val = val + jnp.take(p, src, axis=-1)
-            out.append(val * jnp.float32(2.0 ** (-h / 2.0)))
+            out.append(lvl_out(val, h))
         return out
 
     align = 1 << nharms
@@ -227,10 +235,12 @@ def harmonic_sums(
     if method == "fused":
         fresh = _fused_level_sums(pp, nharms)  # (..., H, nbins_pad)
         cum = p[..., None, :] + jnp.cumsum(fresh[..., :nbins], axis=-2)
-        scales = jnp.asarray(
-            [2.0 ** (-h / 2.0) for h in range(1, nharms + 1)], jnp.float32
-        )
-        cum = cum * scales[:, None]
+        if scaled:
+            scales = jnp.asarray(
+                [2.0 ** (-h / 2.0) for h in range(1, nharms + 1)],
+                jnp.float32,
+            )
+            cum = cum * scales[:, None]
         return [cum[..., h, :] for h in range(nharms)]
     if method != "mxu":
         raise ValueError(f"unknown method {method!r}")
@@ -240,5 +250,5 @@ def harmonic_sums(
     for h in range(1, nharms + 1):
         for k in range(1, 1 << h, 2):
             val = val + _gather_mxu(pp, nbins_pad, k, h)[..., :nbins]
-        out.append(val * jnp.float32(2.0 ** (-h / 2.0)))
+        out.append(lvl_out(val, h))
     return out

@@ -77,7 +77,7 @@ def probe_pallas_peaks(nbins: int, nlev: int, max_peaks: int) -> bool:
         import numpy as np
         import jax.numpy as jnp
 
-        from .peaks import find_cluster_peaks_pallas
+        from .peaks import find_cluster_peaks_multi
         from ..peaks import cluster_peaks_device, find_peaks_device
 
         rng = np.random.default_rng(0)
@@ -94,25 +94,38 @@ def probe_pallas_peaks(nbins: int, nlev: int, max_peaks: int) -> bool:
             np.asarray([[lo, hi]], np.int32), (nlev, 1)
         )
         sp = jnp.asarray(s)
-        ci, cs, rc, cc = find_cluster_peaks_pallas(
-            sp, jnp.asarray(windows), min(1, nlev - 1),
-            threshold=9.0, max_peaks=max_peaks,
+        # probe the MULTI-level kernel (the production path): every
+        # level gets a scaled view of the same data, in-kernel scales
+        # matching the jnp oracle's pre-scaled inputs bitwise
+        scales = tuple(
+            1.0 if lv == 0 else 2.0 ** (-lv / 2.0) for lv in range(nlev)
         )
-        i_, s_, c_ = find_peaks_device(
-            sp, jnp.float32(9.0), jnp.int32(lo), jnp.int32(hi),
-            max_peaks=1 << 14,
+        ci, cs, rc, cc = find_cluster_peaks_multi(
+            [sp] * nlev, jnp.asarray(windows),
+            threshold=9.0, max_peaks=max_peaks, scales=scales,
         )
-        ji, js, jc = cluster_peaks_device(i_, s_, jnp.int32(nbins))
         ci, cs, rc, cc = map(np.asarray, (ci, cs, rc, cc))
-        ji, js, jc, c_ = map(np.asarray, (ji, js, jc, c_))
-        ok = np.array_equal(rc, c_) and np.array_equal(cc, jc)
-        for r in range(s.shape[0]):
+        ok = True
+        for lv in range(nlev):
             if not ok:
                 break
-            k = min(int(jc[r]), max_peaks)
-            ok = np.array_equal(ci[r, :k], ji[r, :k]) and np.array_equal(
-                cs[r, :k], js[r, :k]
+            sc = jnp.asarray(sp * jnp.float32(scales[lv]))
+            i_, s_, c_ = find_peaks_device(
+                sc, jnp.float32(9.0), jnp.int32(lo), jnp.int32(hi),
+                max_peaks=1 << 14,
             )
+            ji, js, jc = cluster_peaks_device(i_, s_, jnp.int32(nbins))
+            ji, js, jc, c_ = map(np.asarray, (ji, js, jc, c_))
+            ok = np.array_equal(rc[:, lv], c_) and np.array_equal(
+                cc[:, lv], jc
+            )
+            for r in range(s.shape[0]):
+                if not ok:
+                    break
+                k = min(int(jc[r]), max_peaks)
+                ok = np.array_equal(
+                    ci[r, lv, :k], ji[r, :k]
+                ) and np.array_equal(cs[r, lv, :k], js[r, :k])
         if not ok:
             import warnings
 

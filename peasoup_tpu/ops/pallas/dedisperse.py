@@ -216,15 +216,35 @@ def dedisperse_pallas(
             [delays, np.tile(delays[:, -1:], (1, cpad - c))], axis=1
         )
 
-    x = jnp.asarray(fil_tc).astype(jnp.float32)
-    x = x * jnp.asarray(killmask, jnp.float32)[None, :]
-    # flat padded channel rows (tail zeros; never selected into output)
-    xp = jnp.pad(x.T, ((0, cpad - c), (0, stride - t_in))).reshape(-1)
+    run = _jit_full(
+        dpad, t_out, cpad, b, spread, stride, d, c, t_in, out_nsamps,
+        quantize, float(scale), interpret,
+    )
+    return run(jnp.asarray(fil_tc), jnp.asarray(delays),
+               jnp.asarray(np.asarray(killmask)))
 
+
+@lru_cache(maxsize=None)
+def _jit_full(
+    dpad, t_out, cpad, b, spread, stride, d, c, t_in, out_nsamps,
+    quantize, scale, interpret,
+):
+    """Prep (mask, f32, pad/transpose/flatten), the kernel, and the
+    trim/scale/quantize tail as ONE jitted program: each eager op is a
+    separately dispatched executable, and on a high-latency link the
+    half-dozen dispatches cost more than the kernel itself."""
     fn = _build(dpad, t_out, cpad, b, spread, stride, interpret)
-    out = fn(jnp.asarray(delays), xp)[:d, :out_nsamps]
-    if scale != 1.0:
-        out = out * jnp.float32(scale)
-    if quantize:
-        out = jnp.clip(jnp.rint(out), 0, 255).astype(jnp.uint8)
-    return out
+
+    @jax.jit
+    def run(fil_tc, delays, killmask):
+        x = fil_tc.astype(jnp.float32) * killmask.astype(jnp.float32)[None, :]
+        # flat padded channel rows (tail zeros; never selected)
+        xp = jnp.pad(x.T, ((0, cpad - c), (0, stride - t_in))).reshape(-1)
+        out = fn(delays, xp)[:d, :out_nsamps]
+        if scale != 1.0:
+            out = out * jnp.float32(scale)
+        if quantize:
+            out = jnp.clip(jnp.rint(out), 0, 255).astype(jnp.uint8)
+        return out
+
+    return run

@@ -55,122 +55,126 @@ _SUB = 8  # rows per stripe (f32 sublane quantum)
 _BIG = 1 << 30  # "no crossing" sentinel for the masked min reduction
 
 
-def _kernel(
-    win_ref,  # SMEM (nlev, 2) i32 [start, limit) rows
-    s_ref,  # VMEM (SUB, B) f32 spectrum stripe block
-    idx_ref,  # VMEM (SUB, mx) i32 out, stripe-resident
-    snr_ref,  # VMEM (SUB, mx) f32 out, stripe-resident
-    cnt_ref,  # VMEM (SUB, 2) i32 out (raw, clusters)
-    istate,  # VMEM scratch (SUB, 128) i32: cursor/raw/open/cpeakidx/lastidx
-    fstate,  # VMEM scratch (SUB, 128) f32: cpeak
-    mstate,  # VMEM scratch (SUB, B) i32: crossing mask being consumed
-    *,
-    lvl: int,
-    mx: int,
-    nbins: int,
-    threshold: float,
-    min_gap: int,
-):
+def _kernel_multi(*refs, nlev, mx, nbins, threshold, min_gap, scales):
+    """All nlev levels' threshold+cluster machines in ONE grid walk:
+    each (stripe, block) step streams every level's block and runs nlev
+    independent identify_unique_peaks machines, state packed per level
+    in shared VMEM scratch (columns [lvl*8, lvl*8+5)). One kernel
+    dispatch and one fifth the grid steps of the per-level version —
+    the per-step DMA latency was the dominant cost, not the bytes."""
+    win_ref = refs[0]
+    s_refs = refs[1 : 1 + nlev]
+    idx_ref, snr_ref, cnt_ref = refs[1 + nlev : 4 + nlev]
+    istate, fstate, mstate = refs[4 + nlev : 7 + nlev]
     b = pl.program_id(1)
     nb = pl.num_programs(1)
 
     @pl.when(b == 0)
     def _():
-        istate[:, :5] = jnp.zeros((_SUB, 5), jnp.int32)
-        fstate[:, :1] = jnp.zeros((_SUB, 1), jnp.float32)
-        idx_ref[:] = jnp.full((_SUB, mx), nbins, jnp.int32)
-        snr_ref[:] = jnp.zeros((_SUB, mx), jnp.float32)
+        istate[:] = jnp.zeros((_SUB, 128), jnp.int32)
+        fstate[:] = jnp.zeros((_SUB, 128), jnp.float32)
+        idx_ref[:] = jnp.full((_SUB, nlev * mx), nbins, jnp.int32)
+        snr_ref[:] = jnp.zeros((_SUB, nlev * mx), jnp.float32)
 
-    lo = win_ref[lvl, 0]
-    hi = win_ref[lvl, 1]
-    s = s_ref[:]
     gidx = b * _BLOCK + jax.lax.broadcasted_iota(jnp.int32, (_SUB, _BLOCK), 1)
-    mask = (gidx >= lo) & (gidx < hi) & (s > jnp.float32(threshold))
-    cnt = jnp.sum(mask.astype(jnp.int32), axis=1, keepdims=True)  # (SUB, 1)
-    istate[:, 1:2] = istate[:, 1:2] + cnt
-
     slot = jax.lax.broadcasted_iota(jnp.int32, (_SUB, mx), 1)
 
-    def emit(do, cursor, cpeakidx, cpeak):
-        # one-hot write of each emitting lane's cluster peak
-        hot = do & (slot == cursor) & (cursor < mx)
-        idx_ref[:] = jnp.where(hot, cpeakidx, idx_ref[:])
-        snr_ref[:] = jnp.where(hot, cpeak, snr_ref[:])
+    for lvl in range(nlev):
+        c0 = lvl * 8  # this level's state column base
+        o0, o1 = lvl * mx, (lvl + 1) * mx
+        lo = win_ref[lvl, 0]
+        hi = win_ref[lvl, 1]
+        scale = scales[lvl]
+        s = (
+            s_refs[lvl][:]
+            if scale == 1.0
+            else s_refs[lvl][:] * jnp.float32(scale)
+        )
+        mask = (gidx >= lo) & (gidx < hi) & (s > jnp.float32(threshold))
+        cnt = jnp.sum(mask.astype(jnp.int32), axis=1, keepdims=True)
+        istate[:, c0 + 1 : c0 + 2] = istate[:, c0 + 1 : c0 + 2] + cnt
 
-    @pl.when(jnp.max(cnt) > 0)
-    def _():
-        # Mosaic's loop regions only legalize scalar carries: the loop
-        # counts down the worst row lane's crossings while ALL mutable
-        # state (remaining-crossings mask + cluster machine) lives in
-        # VMEM scratch refs.
-        mstate[:] = mask.astype(jnp.int32)
+        def emit(do, cursor, cpeakidx, cpeak):
+            hot = do & (slot == cursor) & (cursor < mx)
+            idx_ref[:, o0:o1] = jnp.where(hot, cpeakidx, idx_ref[:, o0:o1])
+            snr_ref[:, o0:o1] = jnp.where(hot, cpeak, snr_ref[:, o0:o1])
 
-        def body(it):
-            m = mstate[:] > 0
-            cursor = istate[:, 0:1]
-            open_ = istate[:, 2:3]
-            cpeakidx = istate[:, 3:4]
-            lastidx = istate[:, 4:5]
-            cpeak = fstate[:, 0:1]
-            idx = jnp.min(
-                jnp.where(m, gidx, jnp.int32(_BIG)), axis=1, keepdims=True
+        @pl.when(jnp.max(cnt) > 0)
+        def _(mask=mask, cnt=cnt, s=s, emit=emit, c0=c0):
+            mstate[:] = mask.astype(jnp.int32)
+
+            def body(it):
+                m = mstate[:] > 0
+                cursor = istate[:, c0 : c0 + 1]
+                open_ = istate[:, c0 + 2 : c0 + 3]
+                cpeakidx = istate[:, c0 + 3 : c0 + 4]
+                lastidx = istate[:, c0 + 4 : c0 + 5]
+                cpeak = fstate[:, c0 : c0 + 1]
+                idx = jnp.min(
+                    jnp.where(m, gidx, jnp.int32(_BIG)), axis=1,
+                    keepdims=True,
+                )
+                act = idx < jnp.int32(_BIG)
+                snr = jnp.max(
+                    jnp.where(m & (gidx == idx), s, -jnp.inf),
+                    axis=1,
+                    keepdims=True,
+                )
+                close = act & (open_ == 1) & (idx - lastidx >= min_gap)
+                emit(close, cursor, cpeakidx, cpeak)
+                cursor = jnp.where(close, cursor + 1, cursor)
+                start = act & ((open_ == 0) | close)
+                take = start | (act & (snr > cpeak))
+                mstate[:] = jnp.where(gidx == idx, 0, mstate[:])
+                istate[:, c0 : c0 + 1] = cursor
+                istate[:, c0 + 2 : c0 + 3] = jnp.where(act, 1, open_)
+                istate[:, c0 + 3 : c0 + 4] = jnp.where(take, idx, cpeakidx)
+                istate[:, c0 + 4 : c0 + 5] = jnp.where(take, idx, lastidx)
+                fstate[:, c0 : c0 + 1] = jnp.where(take, snr, cpeak)
+                return it - 1
+
+            jax.lax.while_loop(lambda it: it > 0, body, jnp.max(cnt))
+
+        @pl.when(b == nb - 1)
+        def _(emit=emit, c0=c0, lvl=lvl):
+            open_ = istate[:, c0 + 2 : c0 + 3]
+            emit(
+                open_ == 1, istate[:, c0 : c0 + 1],
+                istate[:, c0 + 3 : c0 + 4], fstate[:, c0 : c0 + 1],
             )
-            act = idx < jnp.int32(_BIG)  # lanes with a crossing left
-            snr = jnp.max(
-                jnp.where(m & (gidx == idx), s, -jnp.inf),
-                axis=1,
-                keepdims=True,
+            cnt_ref[:, 2 * lvl : 2 * lvl + 1] = istate[:, c0 + 1 : c0 + 2]
+            cnt_ref[:, 2 * lvl + 1 : 2 * lvl + 2] = (
+                istate[:, c0 : c0 + 1] + open_
             )
-            close = act & (open_ == 1) & (idx - lastidx >= min_gap)
-            emit(close, cursor, cpeakidx, cpeak)
-            cursor = jnp.where(close, cursor + 1, cursor)
-            start = act & ((open_ == 0) | close)
-            take = start | (act & (snr > cpeak))
-            mstate[:] = jnp.where(gidx == idx, 0, mstate[:])
-            istate[:, 0:1] = cursor
-            istate[:, 2:3] = jnp.where(act, 1, open_)
-            istate[:, 3:4] = jnp.where(take, idx, cpeakidx)
-            istate[:, 4:5] = jnp.where(take, idx, lastidx)
-            fstate[:, 0:1] = jnp.where(take, snr, cpeak)
-            return it - 1
-
-        jax.lax.while_loop(lambda it: it > 0, body, jnp.max(cnt))
-
-    @pl.when(b == nb - 1)
-    def _():
-        # flush the final open cluster of each row lane
-        open_ = istate[:, 2:3]
-        emit(open_ == 1, istate[:, 0:1], istate[:, 3:4], fstate[:, 0:1])
-        cnt_ref[:, 0:1] = istate[:, 1:2]
-        cnt_ref[:, 1:2] = istate[:, 0:1] + open_
 
 
 @lru_cache(maxsize=None)
-def _build(
-    rows: int, npad: int, nlev: int, lvl: int, mx: int, nbins: int,
-    threshold: float, min_gap: int, interpret: bool,
+def _build_multi(
+    rows: int, npad: int, nlev: int, mx: int, nbins: int,
+    threshold: float, min_gap: int, scales: tuple, interpret: bool,
 ):
     kernel = partial(
-        _kernel, lvl=lvl, mx=mx, nbins=nbins, threshold=threshold,
-        min_gap=min_gap,
+        _kernel_multi, nlev=nlev, mx=mx, nbins=nbins, threshold=threshold,
+        min_gap=min_gap, scales=scales,
     )
     nblk = npad // _BLOCK
     return pl.pallas_call(
         kernel,
         grid=(rows // _SUB, nblk),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),  # windows table
-            pl.BlockSpec((_SUB, _BLOCK), lambda r, b: (r, b)),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+        + [
+            pl.BlockSpec((_SUB, _BLOCK), lambda r, b: (r, b))
+            for _ in range(nlev)
         ],
         out_specs=[
-            pl.BlockSpec((_SUB, mx), lambda r, b: (r, 0)),
-            pl.BlockSpec((_SUB, mx), lambda r, b: (r, 0)),
-            pl.BlockSpec((_SUB, 2), lambda r, b: (r, 0)),
+            pl.BlockSpec((_SUB, nlev * mx), lambda r, b: (r, 0)),
+            pl.BlockSpec((_SUB, nlev * mx), lambda r, b: (r, 0)),
+            pl.BlockSpec((_SUB, nlev * 2), lambda r, b: (r, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((rows, mx), jnp.int32),
-            jax.ShapeDtypeStruct((rows, mx), jnp.float32),
-            jax.ShapeDtypeStruct((rows, 2), jnp.int32),
+            jax.ShapeDtypeStruct((rows, nlev * mx), jnp.int32),
+            jax.ShapeDtypeStruct((rows, nlev * mx), jnp.float32),
+            jax.ShapeDtypeStruct((rows, nlev * 2), jnp.int32),
         ],
         scratch_shapes=[
             pltpu.VMEM((_SUB, 128), jnp.int32),
@@ -179,6 +183,44 @@ def _build(
         ],
         interpret=interpret,
     )
+
+
+def find_cluster_peaks_multi(
+    levels,  # sequence of nlev (..., nbins) f32 spectra (level 0 = base)
+    windows: jnp.ndarray,  # (nlev, 2) i32 [start, limit) per level
+    *,
+    threshold: float,
+    max_peaks: int,
+    scales: tuple,  # per-level in-VMEM factors (1.0 for pre-scaled)
+    min_gap: int = 30,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One-dispatch equivalent of nlev find_cluster_peaks_pallas calls.
+    Returns (idxs (..., nlev, max_peaks), snrs, raw counts (..., nlev),
+    cluster counts (..., nlev))."""
+    nlev = len(levels)
+    nbins = levels[0].shape[-1]
+    batch = levels[0].shape[:-1]
+    rows = 1
+    for d in batch:
+        rows *= d
+    npad = -(-nbins // _BLOCK) * _BLOCK
+    rpad = -(-rows // _SUB) * _SUB
+    flats = []
+    for s in levels:
+        flat = s.reshape(rows, nbins)
+        if npad != nbins or rpad != rows:
+            flat = jnp.pad(flat, ((0, rpad - rows), (0, npad - nbins)))
+        flats.append(flat)
+    fn = _build_multi(
+        rpad, npad, nlev, max_peaks, nbins, float(threshold), min_gap,
+        tuple(float(x) for x in scales), interpret,
+    )
+    cidx, csnr, counts = fn(windows.astype(jnp.int32), *flats)
+    cidx = cidx[:rows].reshape(*batch, nlev, max_peaks)
+    csnr = csnr[:rows].reshape(*batch, nlev, max_peaks)
+    counts = counts[:rows].reshape(*batch, nlev, 2)
+    return cidx, csnr, counts[..., 0], counts[..., 1]
 
 
 def find_cluster_peaks_pallas(
@@ -190,30 +232,23 @@ def find_cluster_peaks_pallas(
     max_peaks: int,
     min_gap: int = 30,
     interpret: bool = False,
+    scale: float = 1.0,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Fused equivalent of find_peaks_device + cluster_peaks_device for
-    one harmonic level. Returns (cluster idxs (..., max_peaks), cluster
-    snrs, raw count (...,), cluster count (...,))."""
-    nbins = spec.shape[-1]
-    batch = spec.shape[:-1]
-    rows = 1
-    for d in batch:
-        rows *= d
-    flat = spec.reshape(rows, nbins)
-    npad = -(-nbins // _BLOCK) * _BLOCK
-    rpad = -(-rows // _SUB) * _SUB
-    if npad != nbins or rpad != rows:
-        # pad bins/rows never cross: pad gidx >= nbins >= window limit,
-        # and pad-row values 0 <= threshold
-        flat = jnp.pad(flat, ((0, rpad - rows), (0, npad - nbins)))
-    fn = _build(
-        rpad, npad, int(windows.shape[0]), lvl, max_peaks, nbins,
-        float(threshold), min_gap, interpret,
+    one harmonic level: a thin nlev=1 wrapper over the multi-level
+    kernel so the cluster state machine exists in exactly one place.
+    Returns (cluster idxs (..., max_peaks), cluster snrs, raw count
+    (...,), cluster count (...,)). With ``scale`` != 1 the spectrum is
+    multiplied by it in VMEM before thresholding (for unscaled
+    cumulative harmonic sums)."""
+    cidx, csnr, counts, ccounts = find_cluster_peaks_multi(
+        [spec], windows[lvl : lvl + 1],
+        threshold=threshold, max_peaks=max_peaks, scales=(scale,),
+        min_gap=min_gap, interpret=interpret,
     )
-    cidx, csnr, counts = fn(windows.astype(jnp.int32), flat)
     return (
-        cidx[:rows].reshape(*batch, max_peaks),
-        csnr[:rows].reshape(*batch, max_peaks),
-        counts[:rows, 0].reshape(batch),
-        counts[:rows, 1].reshape(batch),
+        cidx[..., 0, :],
+        csnr[..., 0, :],
+        counts[..., 0],
+        ccounts[..., 0],
     )

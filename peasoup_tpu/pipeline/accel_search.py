@@ -86,33 +86,48 @@ def _spectra_and_peaks(
     fr = jnp.fft.rfft(xr, axis=-1)
     s = form_interpolated(fr)
     s = normalise(s, mean, std)
-    sums = harmonic_sums(s, nharms=nharms)
+    # the fused kernel applies the per-level rsqrt(2^h) factor in VMEM
+    # (one fewer full HBM pass per level); the jnp path scales here
+    kernel_scales = pallas_peaks and cluster
+    sums = harmonic_sums(s, nharms=nharms, scaled=not kernel_scales)
     levels = [s] + sums
     nbins = s.shape[-1]
 
+    if pallas_peaks and cluster:
+        # ONE kernel dispatch walks every level's threshold+cluster
+        # machine together (ops/pallas/peaks.py:find_cluster_peaks_multi)
+        from ..ops.pallas.peaks import find_cluster_peaks_multi
+
+        scales = (1.0,) + tuple(
+            2.0 ** (-h / 2.0) for h in range(1, nharms + 1)
+        )
+        i_, s_, c_, cc_ = find_cluster_peaks_multi(
+            levels, windows, threshold=threshold, max_peaks=max_peaks,
+            scales=scales,
+        )
+        # kernel emits (..., nlev, ...); the NamedTuple wants the level
+        # axis at stack_axis
+        nb = len(levels[0].shape) - 1  # batch rank
+        return AccelSearchPeaks(
+            idxs=jnp.moveaxis(i_, nb, stack_axis),
+            snrs=jnp.moveaxis(s_, nb, stack_axis),
+            counts=jnp.moveaxis(c_, nb, stack_axis),
+            ccounts=jnp.moveaxis(cc_, nb, stack_axis),
+        )
+
     idxs, snrs, counts, ccounts = [], [], [], []
     for lvl, spec in enumerate(levels):
-        # the fused kernel always clusters; honour cluster=False via
-        # the jnp path rather than silently returning cluster peaks
-        if pallas_peaks and cluster:
-            from ..ops.pallas.peaks import find_cluster_peaks_pallas
-
-            i_, s_, c_, cc_ = find_cluster_peaks_pallas(
-                spec, windows, lvl,
-                threshold=threshold, max_peaks=max_peaks,
-            )
+        i_, s_, c_ = find_peaks_device(
+            spec,
+            jnp.float32(threshold),
+            windows[lvl, 0],
+            windows[lvl, 1],
+            max_peaks=max_peaks,
+        )
+        if cluster:
+            i_, s_, cc_ = cluster_peaks_device(i_, s_, jnp.int32(nbins))
         else:
-            i_, s_, c_ = find_peaks_device(
-                spec,
-                jnp.float32(threshold),
-                windows[lvl, 0],
-                windows[lvl, 1],
-                max_peaks=max_peaks,
-            )
-            if cluster:
-                i_, s_, cc_ = cluster_peaks_device(i_, s_, jnp.int32(nbins))
-            else:
-                cc_ = c_
+            cc_ = c_
         idxs.append(i_)
         snrs.append(s_)
         counts.append(c_)

@@ -15,6 +15,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -225,7 +226,6 @@ class PeasoupSearch:
         self._learned_total_pad = 4096
         # size budgets from the real chip when it tells us (memory_stats
         # is absent on some backends, e.g. the CPU mesh in tests)
-        import jax
 
         devs = jax.local_devices()
         try:
@@ -265,7 +265,6 @@ class PeasoupSearch:
         reference's one-worker-per-GPU-up-to--t policy
         (pipeline_multi.cu:276-277) on TPU backends; elsewhere it stays
         single-device unless shard_devices forces a mesh (tests)."""
-        import jax
 
         devs = jax.local_devices()
         cfg = self.config
@@ -324,12 +323,6 @@ class PeasoupSearch:
             )
             return part if not finalize else self.finalize(fil, part)
         t0 = time.time()
-        # trials live on device (sliced there per chunk, no re-uploads)
-        # unless the whole block would crowd out the search working set
-        # — huge surveys spill to host RAM like the reference
-        # (dedisperser.hpp:101-103) and pay a per-chunk upload instead
-        trials_bytes = dm_plan.ndm * dm_plan.out_nsamps
-        spill = trials_bytes > self.TRIALS_DEVICE_LIMIT
         # --- device selection: shard DM trials over local chips --------
         # (the reference's analogue: one worker per GPU up to -t,
         # pipeline_multi.cu:276-277). Selected BEFORE dedispersion so the
@@ -342,17 +335,26 @@ class PeasoupSearch:
             from ..parallel.mesh import make_mesh
 
             mesh = make_mesh({"dm": len(devices)}, devices=devices)
+        # trials live on device (sliced there per chunk, no re-uploads)
+        # unless the whole block would crowd out the search working set
+        # — huge surveys spill to host RAM like the reference
+        # (dedisperser.hpp:101-103) and pay a per-chunk upload instead.
+        # When the mesh can hold the trials SHARDED (one 1/N slice per
+        # chip), the spill threshold scales with the chip count.
+        trials_bytes = dm_plan.ndm * dm_plan.out_nsamps
+        shardable = (
+            mesh is not None
+            and cfg.subbands == 0
+            and 4 * fil.nsamps * fil.nchans < 3_000_000_000
+        )
+        n_shard = len(devices) if shardable else 1
+        spill = trials_bytes > self.TRIALS_DEVICE_LIMIT * n_shard
         with trace_span("Dedisperse"):  # NVTX parity: pipeline_multi.cu:318
             scale = output_scale(fil.nbits, int(dm_plan.killmask.sum()))
             # sharded dedispersion wants the whole masked f32 filterbank
             # replicated per chip; bigger inputs fall back to the
             # channel-chunked single-device engines
-            shard_dd = (
-                mesh is not None
-                and not spill
-                and cfg.subbands == 0
-                and 4 * fil.nsamps * fil.nchans < 3_000_000_000
-            )
+            shard_dd = shardable and not spill
             self._trials_sharded = shard_dd
             if shard_dd:
                 from ..parallel.sharded_dedisperse import dedisperse_sharded
@@ -388,8 +390,9 @@ class PeasoupSearch:
                     block=cfg.dedisp_block,
                 )
             if not spill:
-                # tiny sync so the phase timer means what it says
-                np.asarray(trials[-1, -1])
+                # sync so the phase timer means what it says — await
+                # completion only, no D2H round trip
+                jax.block_until_ready(trials)
         timers["dedispersion"] = time.time() - t0
 
         # --- search setup ---------------------------------------------------
@@ -547,13 +550,15 @@ class PeasoupSearch:
         # identical (same XLA program per chip), mirroring the
         # reference's share-nothing per-GPU workers.
         size_spec_b = (size // 2 + 1) * 4
-        # spectra budget: what's left of HBM after the device-resident
-        # trials and the queued wave outputs
+        # spectra budget: what's left of PER-CHIP HBM after that chip's
+        # share of the device-resident trials (1/N when sharded) and the
+        # queued wave outputs
+        trials_res = 0 if spill else trials_bytes // (
+            len(devices) if self._trials_sharded else 1
+        )
         mem_budget = min(
             self.MEM_BUDGET,
-            self.TOTAL_HBM
-            - (0 if spill else trials_bytes)
-            - self.WAVE_BUDGET,
+            self.TOTAL_HBM - trials_res - self.WAVE_BUDGET,
         )
         mem_budget = max(mem_budget, 500_000_000)
 
@@ -583,8 +588,7 @@ class PeasoupSearch:
                     if (
                         shrink == 1
                         and one_shot <= 128
-                        and est < 0.9 * self.TOTAL_HBM
-                        - (0 if spill else trials_bytes)
+                        and est < 0.9 * self.TOTAL_HBM - trials_res
                     ):
                         d_local = max(d_local, one_shot)
                     # equalise: 59 trials at d_local=56 would pad a
@@ -972,7 +976,6 @@ class PeasoupSearch:
             afs[row, : len(accs)] = accel_factor(accs, tsamp).astype(
                 np.float32
             )
-        import jax
 
         idx = np.asarray(block_idx, dtype=np.int32)
         if isinstance(trials, np.ndarray):
@@ -1032,7 +1035,7 @@ class PeasoupSearch:
         The link's per-transfer latency dwarfs the payload, so a second
         round trip only happens when the speculation was too small (the
         first-ever wave) or a chunk's compaction overflowed."""
-        from ..ops.peaks import compact_peaks_device
+        from ..ops.peaks import compact_peaks_device, pack_chunk_results
 
         cfg = self.config
         nlev = cfg.nharmonics + 1
@@ -1043,10 +1046,11 @@ class PeasoupSearch:
         args = (accel_lists, trials, tim_len, zapmask_dev, windows,
                 search_block)
 
+
         mp0 = max(cfg.max_peaks, self._learned_max_peaks)
         spec_pad = self._learned_total_pad
         pend = []
-        spec_pieces = []
+        packs = []
         for chunk in wave:
             peaks, padded = self._dispatch_chunk(chunk, *args, mp0, **disp)
             # record which peaks mode produced this chunk: a mid-wave
@@ -1056,39 +1060,38 @@ class PeasoupSearch:
                 [chunk, mp0, peaks, padded,
                  getattr(self, "_pallas_peaks", False)]
             )
-            spec_pieces.append(
-                compact_peaks_device(
-                    peaks.idxs, peaks.snrs, peaks.ccounts,
+            packs.append(
+                pack_chunk_results(
+                    peaks.idxs, peaks.snrs, peaks.counts, peaks.ccounts,
                     total_pad=spec_pad,
                 )
             )
 
-        # ONE packed transfer for the whole wave: raw crossing counts
-        # (overflow detection), cluster counts (fetch trimming), and the
-        # speculatively compacted peak streams. Chunks whose static
+        # ONE packed transfer for the whole wave: each chunk contributes
+        # [raw counts | cluster counts | speculatively compacted peak
+        # stream] from a single jitted pack. Chunks whose static
         # compaction overflowed are re-dispatched with the next
         # power-of-two size (the reference sizes for 100000 up front,
         # peakfinder.hpp:61) -- rare, and only they pay extra round trips
-        count_vec = [p.counts.reshape(-1) for _, _, p, _, _ in pend] + [
-            p.ccounts.reshape(-1) for _, _, p, _, _ in pend
-        ]
-        ncounts = sum(int(v.shape[0]) for v in count_vec)
-        packed_all = np.asarray(jnp.concatenate(count_vec + spec_pieces))
-        counts_flat = packed_all[:ncounts]
-        spec_flat = packed_all[ncounts:]
-        half = counts_flat.size // 2
+        packed_all = np.asarray(
+            packs[0] if len(packs) == 1 else jnp.concatenate(packs)
+        )
         counts_list = []
         ccounts_list = []
+        spec_pieces = []
         redispatched = []
         off = 0
         for entry in pend:
             chunk, max_peaks, peaks, padded, fused = entry
             n = peaks.counts.shape[0] * nlev * padded
-            counts = counts_flat[off : off + n].reshape(-1, nlev, padded)
-            ccounts = counts_flat[half + off : half + off + n].reshape(
+            counts = packed_all[off : off + n].reshape(-1, nlev, padded)
+            ccounts = packed_all[off + n : off + 2 * n].reshape(
                 -1, nlev, padded
             )
-            off += n
+            spec_pieces.append(
+                packed_all[off + 2 * n : off + 2 * n + 2 * spec_pad]
+            )
+            off += 2 * n + 2 * spec_pad
             redisp = False
             # overflow: raw crossings outgrew the compaction (jnp
             # path) or clusters outgrew it (fused-kernel path)
@@ -1147,7 +1150,7 @@ class PeasoupSearch:
                 max(self._learned_total_pad, total_pad), 1 << 16
             )
             if not redispatched[i] and total <= spec_pad:
-                piece = spec_flat[2 * spec_pad * i : 2 * spec_pad * (i + 1)]
+                piece = spec_pieces[i]
                 total_pad = spec_pad
             else:
                 piece = np.asarray(

@@ -268,20 +268,21 @@ class TestPallasPeaks:
         )
         plain = search_block_core(tims, afs, zap, windows, **kw)
         # route the kernel through interpret mode for the CPU test
-        orig = ppk._build.__wrapped__
+        # (production now uses the merged multi-level kernel)
+        orig = ppk._build_multi.__wrapped__
 
         def interp_build(*args):
             return orig(*args[:-1], True)
 
-        ppk._build.cache_clear()
-        ppk._build = interp_build
+        ppk._build_multi.cache_clear()
+        ppk._build_multi = interp_build
         try:
             fused = search_block_core(
                 tims, afs, zap, windows, **kw, pallas_peaks=True
             )
         finally:
             import functools
-            ppk._build = functools.lru_cache(maxsize=None)(orig)
+            ppk._build_multi = functools.lru_cache(maxsize=None)(orig)
         np.testing.assert_array_equal(
             np.asarray(plain.idxs), np.asarray(fused.idxs)
         )
