@@ -1,0 +1,70 @@
+"""Worker process for the real 2-process multi-host test.
+
+Launched by tests/test_multihost.py with JAX_COORDINATOR_ADDRESS /
+JAX_NUM_PROCESSES / JAX_PROCESS_ID in the env: initialises
+jax.distributed over CPU (4 virtual devices per process), runs the
+multi-host search driver (parallel/multihost.py:run_search) on the
+given filterbank, and dumps the finalized candidate list so the parent
+can compare it bitwise against a single-process run.
+
+Usage: python multihost_worker.py <fil_path> <out_pickle> [npdmp]
+"""
+
+import os
+import pickle
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=4"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    cache = os.path.join(
+        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+        "peasoup_tpu", "jax-tests",
+    )
+    os.makedirs(cache, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+except Exception:
+    pass
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> int:
+    fil_path, out_path = sys.argv[1], sys.argv[2]
+    npdmp = int(sys.argv[3]) if len(sys.argv) > 3 else 0
+
+    from peasoup_tpu.io import read_filterbank
+    from peasoup_tpu.parallel import multihost
+    from peasoup_tpu.pipeline import SearchConfig
+
+    fil = read_filterbank(fil_path)
+    cfg = SearchConfig(dm_end=40.0, nharmonics=2, npdmp=npdmp, limit=100)
+    res = multihost.run_search(fil, cfg)
+    rows = [
+        (c.freq, c.snr, c.dm, c.acc, c.nh, c.folded_snr, c.opt_period)
+        for c in res.candidates
+    ]
+    with open(out_path, "wb") as f:
+        pickle.dump(
+            {
+                "rank": jax.process_index(),
+                "nproc": jax.process_count(),
+                "rows": rows,
+                "n_accel_trials": res.n_accel_trials,
+            },
+            f,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
