@@ -268,10 +268,15 @@ def _dedisperse_device_once(
     if probe_pallas_dedisperse() and np.all(
         np.diff(np.asarray(delays), axis=0) >= 0
     ):
-        from .pallas.dedisperse import dedisperse_pallas, pallas_hbm_bytes
+        from .pallas.dedisperse import (
+            dedisperse_pallas,
+            pallas_hbm_bytes,
+            plan_spread,
+        )
 
         need = pallas_hbm_bytes(
-            fil_tc.shape[0], delays.shape[1], delays.shape[0], out_nsamps
+            fil_tc.shape[0], delays.shape[1], delays.shape[0], out_nsamps,
+            spread=plan_spread(delays),
         )
         try:
             limit = (
@@ -495,41 +500,52 @@ def dedisperse_subband(
 
     # process groups in vmapped batches: per-group dispatches (2 per
     # group) would dominate at survey scale where groups hold only a
-    # few trials each. Batch size bounds the live (gb, S, nb1*128)
-    # stage-1 working set to ~1 GB.
-    gb = max(
-        1, min(len(groups), 1_000_000_000 // max(1, 4 * nsub * nb1 * 128))
-    )
+    # few trials each. Group heights shrink with DM, so first bucket
+    # the (DM-ordered) groups into contiguous runs sharing a
+    # power-of-two padded height, then size each bucket's batches from
+    # ITS height so the live working set — the (gb, S, nb1*128) stage-1
+    # partials PLUS the (gb, g_pad, out_nsamps) stage-2 f32 output
+    # (ADVICE r1: the output term dominates for tall groups) — stays
+    # ~1 GB without one tall low-DM bucket collapsing the batching of
+    # the small-group tail. Compiled shapes: one per (gb, g_pad) bucket.
     stage1_b = _stage1_batched(nb1)
     stage2_b = _stage2_batched(out_nsamps, quantize, scale)
 
+    def g_pad_of(lo, hi):
+        return 1 << (hi - lo - 1).bit_length() if hi - lo > 1 else 1
+
     outs = []
-    for b0 in range(0, len(groups), gb):
-        batch = groups[b0 : b0 + gb]
-        # pad the batch's group heights to ITS power-of-two bucket
-        # (group sizes shrink with DM; a global max would waste more)
-        gmax_b = max(hi - lo for lo, hi in batch)
-        g_pad = 1 << (gmax_b - 1).bit_length() if gmax_b > 1 else 1
-        if len(batch) < gb and len(outs):  # keep one compiled shape
-            batch = batch + [batch[-1]] * (gb - len(batch))
-        d1 = np.stack(
-            [
-                np.pad(d1_all[lo], (0, cpad)).reshape(nsub, w)
-                for lo, _ in batch
-            ]
-        )
-        rd = np.stack(
-            [
-                np.pad(refdel[lo:hi], ((0, g_pad - (hi - lo)), (0, 0)))
-                for lo, hi in batch
-            ]
-        )
-        s1 = stage1_b(x_swt, kill_sw, jnp.asarray(d1))  # (gb, S, nb1, 128)
-        res = stage2_b(s1, jnp.asarray(rd, dtype=np.int32))
-        if to_host:
-            res = np.asarray(res)  # ONE transfer per batch, not per group
-        for bi, (lo, hi) in enumerate(batch[: len(groups) - b0]):
-            outs.append(res[bi, : hi - lo])
+    i = 0
+    while i < len(groups):
+        g_pad = g_pad_of(*groups[i])
+        j = i
+        while j < len(groups) and g_pad_of(*groups[j]) == g_pad:
+            j += 1
+        per_group = 4 * nsub * nb1 * 128 + 4 * g_pad * out_nsamps
+        gb = max(1, min(j - i, 1_000_000_000 // max(1, per_group)))
+        for b0 in range(i, j, gb):
+            batch = groups[b0 : min(b0 + gb, j)]
+            if len(batch) < gb and b0 > i:  # pad: keep one shape per bucket
+                batch = batch + [batch[-1]] * (gb - len(batch))
+            d1 = np.stack(
+                [
+                    np.pad(d1_all[lo], (0, cpad)).reshape(nsub, w)
+                    for lo, _ in batch
+                ]
+            )
+            rd = np.stack(
+                [
+                    np.pad(refdel[lo:hi], ((0, g_pad - (hi - lo)), (0, 0)))
+                    for lo, hi in batch
+                ]
+            )
+            s1 = stage1_b(x_swt, kill_sw, jnp.asarray(d1))  # (gb,S,nb1,128)
+            res = stage2_b(s1, jnp.asarray(rd, dtype=np.int32))
+            if to_host:
+                res = np.asarray(res)  # ONE transfer per batch
+            for bi, (lo, hi) in enumerate(batch[: min(b0 + gb, j) - b0]):
+                outs.append(res[bi, : hi - lo])
+        i = j
     if to_host:
         return np.concatenate(outs, axis=0)
     return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)

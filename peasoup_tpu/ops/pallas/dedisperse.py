@@ -163,17 +163,21 @@ def plan_spread(delays: np.ndarray) -> int:
     return spread
 
 
-def pallas_hbm_bytes(t_in: int, c: int, d: int, out_nsamps: int) -> int:
+def pallas_hbm_bytes(
+    t_in: int, c: int, d: int, out_nsamps: int, spread: int | None = None
+) -> int:
     """Rough peak HBM need of dedisperse_pallas: the padded f32 flat
     filterbank + the full f32 output (+ the caller-held input). Used by
     dedisperse_device to keep near-limit trial sets on the blocked jnp
-    path, whose working set is one trial block."""
+    path, whose working set is one trial block. Pass the REAL delay
+    ``spread`` (plan_spread(delays)) when the caller holds the table —
+    the one-block fallback bound undercounts when coarse high-DM steps
+    spread further than one block (ADVICE r1)."""
     b = min(16384, max(_QUANT, -(-out_nsamps // _QUANT) * _QUANT))
     t_out = -(-out_nsamps // b) * b
     cpad = -(-c // _CC) * _CC
     dpad = -(-d // _DT) * _DT
-    # stride needs the spread, unknown here; bound it with one block
-    stride = _row_stride(t_in, b, b)
+    stride = _row_stride(t_in, b, max(spread, b) if spread else b)
     return 4 * (cpad * stride + dpad * t_out) + t_in * c
 
 

@@ -83,13 +83,18 @@ def _spectra_and_peaks(
     peaks sized ``max_peaks`` while raw crossings are only counted —
     overflow then means ccounts > max_peaks, not counts. ``xr`` is
     (..., A, size); mean/std broadcast against (..., A)."""
-    fr = jnp.fft.rfft(xr, axis=-1)
-    s = form_interpolated(fr)
-    s = normalise(s, mean, std)
+    # named scopes mirror the reference's NVTX ranges inside the jitted
+    # program (pipeline_multi.cu:207, harmonicfolder.hpp:28): ops carry
+    # the scope in their metadata, so profiler traces group them
+    with jax.named_scope("Acceleration-Loop"):
+        fr = jnp.fft.rfft(xr, axis=-1)
+        s = form_interpolated(fr)
+        s = normalise(s, mean, std)
     # the fused kernel applies the per-level rsqrt(2^h) factor in VMEM
     # (one fewer full HBM pass per level); the jnp path scales here
     kernel_scales = pallas_peaks and cluster
-    sums = harmonic_sums(s, nharms=nharms, scaled=not kernel_scales)
+    with jax.named_scope("Harmonic summing"):
+        sums = harmonic_sums(s, nharms=nharms, scaled=not kernel_scales)
     levels = [s] + sums
     nbins = s.shape[-1]
 

@@ -529,7 +529,12 @@ class PeasoupSearch:
             ckpt_file = cfg.checkpoint_file
             if dm_slice is not None:
                 # one store per process slice: slices search disjoint
-                # trials and must not clobber each other's results
+                # trials and must not clobber each other's results.
+                # LIMITATION (documented, ADVICE r1): the suffix embeds
+                # the slice bounds, so resuming a multi-host search with
+                # a DIFFERENT process count gets fresh stores and
+                # re-searches from scratch — resume with the same
+                # process count to reuse prior progress
                 ckpt_file = f"{ckpt_file}.dm{dm_lo}-{dm_hi}"
             ckpt = SearchCheckpoint(
                 ckpt_file,

@@ -1,18 +1,151 @@
-"""Candidate diagnostic plotting (reference: tools/peasoup_tools.py:167-383
-CandidatePlotter). Requires matplotlib; import-guarded so headless
-installs work without it."""
+"""Candidate diagnostic plotting.
+
+Full diagnostic-sheet parity with the reference's CandidatePlotter
+(reference: tools/peasoup_tools.py:167-383): pulse profile over two
+phase turns, folded subintegrations image with a per-subint statistics
+side panel, a parameter table, per-harmonic DM-S/N and acc-S/N
+scatters, the DM-acceleration plane sized by S/N, and an all-candidate
+period-DM overview with a crosshair on the plotted candidate.
+Requires matplotlib; import-guarded so headless installs work
+without it (tests render with the Agg backend).
+"""
 
 from __future__ import annotations
 
 import numpy as np
 
+_HARM_COLORS = ("#1f3d7a", "#7aa6d9", "#2e8b57", "#e08a2e", "#8b1a1a")
+
+
+def _radec_str(v: float, hours: bool) -> str:
+    """Sigproc packed ddmmss.s / hhmmss.s float to a display string."""
+    sign = "-" if v < 0 else ""
+    v = abs(v)
+    d = int(v // 10000)
+    m = int((v - d * 10000) // 100)
+    s = v - d * 10000 - m * 100
+    unit = "h" if hours else "d"
+    return f"{sign}{d:02d}{unit}{m:02d}m{s:05.2f}s"
+
 
 class CandidatePlotter:
-    """Plot profile / subints / DM-acc scatter for one candidate."""
+    """Render one candidate's full diagnostic sheet from an
+    overview.xml + candidates.peasoup pair."""
 
     def __init__(self, overview, cand_file_parser):
         self.overview = overview
         self.parser = cand_file_parser
+
+    # ---- panel painters -------------------------------------------------
+
+    def _profile(self, ax, fold):
+        prof = fold.sum(axis=0)
+        ax.plot(np.r_[prof, prof], color="#1f3d7a", lw=1.2)
+        ax.axvline(len(prof) - 0.5, color="0.8", lw=0.8)
+        ax.set_title("Profile (2 turns)")
+        ax.set_xlim(0, 2 * len(prof) - 1)
+        ax.tick_params(labelbottom=False, labelleft=False)
+
+    def _subints(self, ax, fold):
+        ax.imshow(
+            np.r_[fold.T, fold.T].T, aspect="auto", origin="lower",
+            interpolation="nearest", cmap="viridis",
+        )
+        ax.set_xlabel("Phase bin (2 turns)")
+        ax.set_ylabel("Subintegration")
+
+    def _subint_stats(self, ax, fold):
+        y = np.arange(fold.shape[0])
+        mean = fold.mean(axis=1)
+        std = fold.std(axis=1)
+        ax.fill_betweenx(
+            y, mean - 3 * std, mean + 3 * std, alpha=0.4,
+            color="#7aa6d9", label="±3σ",
+        )
+        ax.plot(mean, y, color="#1f3d7a", lw=1.5, label="mean")
+        ax.plot(fold.max(axis=1), y, color="#8b1a1a", lw=1.0, label="max")
+        ax.invert_xaxis()
+        ax.set_ylim(-0.5, fold.shape[0] - 0.5)
+        ax.set_title("Subint stats", fontsize=9)
+        ax.legend(fontsize=6, loc="upper left")
+        ax.tick_params(labelbottom=False)
+
+    def _table(self, ax, cand):
+        hdr = self.overview.header
+        rows = [
+            ("R.A.", _radec_str(float(hdr.get("src_raj", 0) or 0), True)),
+            ("Decl.", _radec_str(float(hdr.get("src_dej", 0) or 0), False)),
+            ("P0 (s)", f"{cand['period']:.9f}"),
+            ("Opt P0 (s)", f"{cand['opt_period']:.9f}"),
+            ("DM", f"{cand['dm']:.2f}"),
+            ("Acc (m/s²)", f"{cand['acc']:.2f}"),
+            ("Harmonic", str(int(cand["nh"]))),
+            ("Spec S/N", f"{cand['snr']:.1f}"),
+            ("Fold S/N", f"{cand['folded_snr']:.1f}"),
+            ("Adjacent?", str(bool(cand["is_adjacent"]))),
+            ("Physical?", str(bool(cand["is_physical"]))),
+            ("DDM count ratio", f"{cand['ddm_count_ratio']:.3f}"),
+            ("DDM S/N ratio", f"{cand['ddm_snr_ratio']:.3f}"),
+            ("N assoc", str(int(cand["nassoc"]))),
+        ]
+        ax.axis("off")
+        tab = ax.table(
+            cellText=rows, cellLoc="left", loc="center",
+            colWidths=[0.62, 0.55],
+        )
+        tab.auto_set_font_size(False)
+        tab.set_fontsize(9)
+        tab.scale(1.0, 1.4)
+        for cell in tab.get_celld().values():
+            cell.set_linewidth(0)
+
+    def _by_harm(self, ax, hits, xfield, yfield, flip=False):
+        for i, nh in enumerate(np.unique(hits["nh"])):
+            sub = hits[hits["nh"] == nh]
+            ax.scatter(
+                sub[xfield], sub[yfield], s=10,
+                color=_HARM_COLORS[int(nh) % len(_HARM_COLORS)],
+                label=f"harm {int(nh)}", edgecolors="none",
+            )
+        if flip:
+            ax.yaxis.tick_right()
+            ax.yaxis.set_label_position("right")
+        ax.set_xlabel(xfield)
+        ax.set_ylabel(yfield)
+        ax.legend(fontsize=6)
+
+    def _dm_acc_plane(self, ax, hits):
+        snr = hits["snr"].astype(float)
+        span = snr.max() - snr.min()
+        sizes = 5 + 120 * (snr - snr.min()) / (span if span else 1.0)
+        for i, nh in enumerate(np.unique(hits["nh"])):
+            m = hits["nh"] == nh
+            ax.scatter(
+                hits["dm"][m], hits["acc"][m], s=sizes[m],
+                color=_HARM_COLORS[int(nh) % len(_HARM_COLORS)],
+                alpha=0.7, edgecolors="none",
+            )
+        ax.set_xlabel("DM (pc cm$^{-3}$)")
+        ax.set_ylabel("Acc (m/s²)")
+        ax.set_title("DM-acc plane (size ∝ S/N)", fontsize=9)
+
+    def _all_cands(self, ax, cand):
+        """Period-DM overview of the WHOLE candidate list with a
+        crosshair on the plotted candidate."""
+        c = self.overview.candidates
+        ax.set_xscale("log")
+        ax.scatter(
+            c["period"], c["dm"], s=np.clip(c["snr"], 5, 120),
+            c=[_HARM_COLORS[int(n) % len(_HARM_COLORS)] for n in c["nh"]],
+            alpha=0.7, edgecolors="none",
+        )
+        ax.axvline(float(cand["period"]), color="0.3", lw=0.8)
+        ax.axhline(float(cand["dm"]), color="0.3", lw=0.8)
+        ax.set_xlabel("Period (s)")
+        ax.set_ylabel("DM (pc cm$^{-3}$)")
+        ax.set_title("All candidates (crosshair = this one)", fontsize=9)
+
+    # ---- entry point ----------------------------------------------------
 
     def plot(self, idx: int, outfile: str | None = None):
         import matplotlib
@@ -20,28 +153,55 @@ class CandidatePlotter:
         if outfile:
             matplotlib.use("Agg")
         import matplotlib.pyplot as plt
+        from matplotlib import gridspec
 
         cand = self.overview.candidates[idx]
         rec = self.parser.read_candidate(int(cand["byte_offset"]))
-        fig, axes = plt.subplots(2, 2, figsize=(10, 8))
-        fig.suptitle(
-            f"cand {idx}: P={cand['period']:.6f}s DM={cand['dm']:.2f} "
-            f"acc={cand['acc']:.2f} snr={cand['snr']:.1f}"
+
+        fig = plt.figure(figsize=(14, 12))
+        gs = gridspec.GridSpec(
+            4, 6, figure=fig, hspace=0.55, wspace=0.65,
+            height_ratios=[1.0, 1.2, 1.2, 1.6],
         )
-        if rec["fold"] is not None:
-            prof = rec["fold"].mean(axis=0)
-            axes[0, 0].plot(np.r_[prof, prof])
-            axes[0, 0].set_title("profile (x2 phase)")
-            axes[0, 1].imshow(rec["fold"], aspect="auto", origin="lower")
-            axes[0, 1].set_title("subints")
+        fig.suptitle(
+            f"{self.overview.header.get('source_name', 'unknown')} — "
+            f"candidate {idx}: P={cand['period']:.6f} s  "
+            f"DM={cand['dm']:.2f}  acc={cand['acc']:.2f}  "
+            f"S/N={cand['snr']:.1f}",
+            fontsize=13,
+        )
+
+        ax_prof = fig.add_subplot(gs[0, 1:3])
+        ax_fold = fig.add_subplot(gs[1:3, 1:3])
+        ax_stats = fig.add_subplot(gs[1:3, 0])
+        ax_table = fig.add_subplot(gs[0:3, 3])
+        ax_dm = fig.add_subplot(gs[0, 4:6])
+        ax_dmacc = fig.add_subplot(gs[1:3, 4])
+        ax_acc = fig.add_subplot(gs[1:3, 5])
+        ax_all = fig.add_subplot(gs[3, :])
+
+        fold = rec["fold"]
+        if fold is not None and fold.size:
+            f = fold.astype(float)
+            span = f.max() - f.min()
+            f = (f - f.min()) / (span if span else 1.0)
+            self._profile(ax_prof, f)
+            self._subints(ax_fold, f)
+            self._subint_stats(ax_stats, f)
+        else:
+            for ax in (ax_prof, ax_fold, ax_stats):
+                ax.text(0.5, 0.5, "no fold", ha="center", va="center")
+                ax.axis("off")
+
+        self._table(ax_table, cand)
+
         hits = rec["hits"]
         if len(hits):
-            axes[1, 0].scatter(hits["dm"], hits["snr"], s=8)
-            axes[1, 0].set_xlabel("DM")
-            axes[1, 0].set_ylabel("S/N")
-            axes[1, 1].scatter(hits["acc"], hits["snr"], s=8)
-            axes[1, 1].set_xlabel("acc")
-            axes[1, 1].set_ylabel("S/N")
+            self._by_harm(ax_dm, hits, "dm", "snr", flip=True)
+            self._by_harm(ax_acc, hits, "snr", "acc", flip=True)
+            self._dm_acc_plane(ax_dmacc, hits)
+        self._all_cands(ax_all, cand)
+
         if outfile:
             fig.savefig(outfile, dpi=100, bbox_inches="tight")
             plt.close(fig)

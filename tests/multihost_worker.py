@@ -7,9 +7,12 @@ multi-host search driver (parallel/multihost.py:run_search) on the
 given filterbank, and dumps the finalized candidate list so the parent
 can compare it bitwise against a single-process run.
 
-Usage: python multihost_worker.py <fil_path> <out_pickle> [npdmp]
+Usage: python multihost_worker.py <fil_path> <out_pickle> <cfg_json>
+(cfg_json = JSON dict of SearchConfig fields — single source of truth
+lives in the launching test)
 """
 
+import json
 import os
 import pickle
 import sys
@@ -40,14 +43,14 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 def main() -> int:
     fil_path, out_path = sys.argv[1], sys.argv[2]
-    npdmp = int(sys.argv[3]) if len(sys.argv) > 3 else 0
+    cfg_fields = json.loads(sys.argv[3]) if len(sys.argv) > 3 else {}
 
     from peasoup_tpu.io import read_filterbank
     from peasoup_tpu.parallel import multihost
     from peasoup_tpu.pipeline import SearchConfig
 
     fil = read_filterbank(fil_path)
-    cfg = SearchConfig(dm_end=40.0, nharmonics=2, npdmp=npdmp, limit=100)
+    cfg = SearchConfig(**cfg_fields)
     res = multihost.run_search(fil, cfg)
     rows = [
         (c.freq, c.snr, c.dm, c.acc, c.nh, c.folded_snr, c.opt_period)

@@ -35,7 +35,9 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _launch(rank: int, nproc: int, port: int, fil: str, out: str, npdmp: int):
+def _launch(rank, nproc, port, fil, out, cfg_fields):
+    import json
+
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
@@ -43,7 +45,7 @@ def _launch(rank: int, nproc: int, port: int, fil: str, out: str, npdmp: int):
     env["JAX_NUM_PROCESSES"] = str(nproc)
     env["JAX_PROCESS_ID"] = str(rank)
     return subprocess.Popen(
-        [sys.executable, WORKER, fil, out, str(npdmp)],
+        [sys.executable, WORKER, fil, out, json.dumps(cfg_fields)],
         env=env,
         stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT,
@@ -51,30 +53,44 @@ def _launch(rank: int, nproc: int, port: int, fil: str, out: str, npdmp: int):
     )
 
 
+def _run_workers(tmp_path, fil_path, cfg_fields, attempts=2):
+    """Launch the 2-process job; retry once with a fresh port if it
+    fails (the free-port probe is racy on a busy host)."""
+    last = None
+    for _ in range(attempts):
+        port = _free_port()
+        outs = [str(tmp_path / f"rank{r}.pkl") for r in range(2)]
+        procs = [
+            _launch(r, 2, port, fil_path, outs[r], cfg_fields)
+            for r in range(2)
+        ]
+        logs = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=900)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                pytest.fail("multi-host worker timed out")
+            logs.append(out)
+        if all(p.returncode == 0 for p in procs):
+            return outs
+        last = "\n".join(
+            f"rank{r} rc={p.returncode}\n{log[-2000:]}"
+            for r, (p, log) in enumerate(zip(procs, logs))
+        )
+    pytest.fail(f"multi-host workers failed after {attempts} attempts:\n{last}")
+
+
 @pytest.mark.parametrize("npdmp", [4])
 def test_two_process_run_matches_single(tmp_path, npdmp):
     path, _, _ = make_synthetic_fil(tmp_path)
     fil = read_filterbank(str(path))
-    cfg = SearchConfig(dm_end=40.0, nharmonics=2, npdmp=npdmp, limit=100)
-    single = PeasoupSearch(cfg).run(fil)
+    cfg_fields = dict(dm_end=40.0, nharmonics=2, npdmp=npdmp, limit=100)
+    single = PeasoupSearch(SearchConfig(**cfg_fields)).run(fil)
     assert len(single.candidates) > 0
 
-    port = _free_port()
-    outs = [str(tmp_path / f"rank{r}.pkl") for r in range(2)]
-    procs = [
-        _launch(r, 2, port, str(path), outs[r], npdmp) for r in range(2)
-    ]
-    logs = []
-    for p in procs:
-        try:
-            out, _ = p.communicate(timeout=900)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail("multi-host worker timed out")
-        logs.append(out)
-    for p, log in zip(procs, logs):
-        assert p.returncode == 0, f"worker rc={p.returncode}\n{log[-4000:]}"
+    outs = _run_workers(tmp_path, str(path), cfg_fields)
 
     results = []
     for o in outs:
