@@ -274,9 +274,10 @@ def _dedisperse_device_once(
             plan_spread,
         )
 
+        spread = plan_spread(delays)
         need = pallas_hbm_bytes(
             fil_tc.shape[0], delays.shape[1], delays.shape[0], out_nsamps,
-            spread=plan_spread(delays),
+            spread=spread,
         )
         try:
             limit = (
@@ -288,7 +289,7 @@ def _dedisperse_device_once(
             try:
                 res = dedisperse_pallas(
                     fil_tc, delays, killmask, out_nsamps,
-                    quantize=quantize, scale=scale,
+                    quantize=quantize, scale=scale, spread=spread,
                 )
                 # force execution INSIDE the try: TPU runtime failures
                 # that surface asynchronously (e.g. allocation at a

@@ -18,11 +18,13 @@ dynamic slice. This kernel removes both costs:
     trial chunk, and each trial's residual shift is one in-VMEM
     pltpu.roll (dynamic lane rotate).
 
-Layout (same conventions as ops/pallas/resample.py, which established
-the Mosaic rules on this toolchain): the filterbank is passed as a FLAT
-1-D f32 array of 1024-aligned padded CHANNEL rows (killmask
-pre-multiplied); DMA starts are quantized down to 1024 lanes and the
-remainder absorbed by the roll.
+Layout (round 2, blocked-roll rewrite): the filterbank is passed as a
+(C, TR, 128) BLOCKED array of padded channel rows (killmask
+pre-multiplied); window DMA starts are quantized down to 128-sample
+row boundaries, and each trial's residual alignment decomposes into a
+row offset (select among statically row-rolled window versions) plus a
+lane shift (one dynamic lane roll + row-boundary select), so every
+vector op runs at full (8, 128) vreg width.
 
 Summation order is channel-ascending per output element — identical to
 the jnp twin, and for <=8-bit inputs channel sums are exact integers in
@@ -41,76 +43,93 @@ from jax.experimental.pallas import tpu as pltpu
 
 _DT = 8  # DM trials per output block (f32 sublane quantum)
 _CC = 16  # channels per grid step (windows DMA'd per step)
-_QUANT = 1024  # 1-D tiling quantum (lanes): DMA starts/lengths
+_QUANT = 1024  # output block-size quantum (keeps t_out a lane multiple)
 
 
-def _window_len(b: int, spread: int) -> int:
-    # covers rem (<1024) + per-trial shift (<=spread) + B output lanes
-    return b + (-(-(spread + _QUANT + 1) // _QUANT)) * _QUANT
+def _nbw(nb: int, k_max: int) -> int:
+    # window rows: nb output rows + k_max per-trial row offset + 1 for
+    # the lane-boundary next-row, rounded to the sublane quantum
+    return -(-(nb + k_max + 1) // 8) * 8
 
 
-def _row_stride(t_in: int, b: int, spread: int) -> int:
-    # window starts reach (t_out_pad - B) + max_delay <= t_in - B; add
-    # the window length and round to the 1024 quantum
-    return -(-(t_in + _window_len(b, spread) + 1) // _QUANT) * _QUANT
+def _tr_rows(t_in: int, nb: int, k_max: int) -> int:
+    # blocked channel row count: data rows + window slack (zero rows)
+    return -(-t_in // 128) + _nbw(nb, k_max) + 1
 
 
 def _kernel(
     del_ref,  # SMEM (DT, C) i32 delays for this trial chunk (all channels)
-    x_ref,  # HBM flat padded channel rows
-    out_ref,  # VMEM (DT, B) f32 output block (accumulated across c)
-    acc_ref,  # VMEM scratch (DT, B) f32
-    win_ref,  # VMEM scratch (CC*W,) f32 channel windows, flat 1-D
-    # (single rows of a 2-D scratch are not sliceable: Mosaic requires
-    # 8-aligned slices on the sublane dim; 1-D refs tile in 1024-lane
-    # quanta and W is a 1024 multiple)
+    x_ref,  # HBM (C, TR, 128) blocked padded channel rows
+    out_ref,  # VMEM (DT, nb, 128) output block (accumulated across c)
+    acc_ref,  # VMEM scratch (DT, nb, 128) f32
+    win_ref,  # VMEM scratch (CC, NBW, 128) f32 channel windows
     sems,  # DMA semaphores (CC,)
     *,
-    b: int,
-    w: int,
-    stride: int,
+    nb: int,
+    nbw: int,
+    k_max: int,
     cc_count: int,
     interpret: bool,
 ):
+    """Blocked shift-and-sum: one shared (NBW, 128) window per channel
+    per 8-trial chunk, per-trial alignment resolved as
+    (row offset k_i, lane shift s_i) with k_i handled by selecting
+    among k_max+1 statically row-rolled window versions (computed once
+    per channel) and s_i by one dynamic lane roll + row-boundary
+    select — every vector op runs at full (8, 128) vreg width, unlike
+    the round-1 kernel's (1, W) single-sublane rolls (measured ~5x).
+    Channel sums accumulate ascending per trial, so results stay
+    bitwise equal to the jnp twin for integer inputs."""
     t = pl.program_id(1)
     c = pl.program_id(2)
     nc = pl.num_programs(2)
-    t0 = t * b
+    t0 = t * (nb * 128)
 
     @pl.when(c == 0)
     def _():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
+    def roll(x, shift, axis):
+        if interpret:
+            return jnp.roll(x, shift, axis=axis)
+        return pltpu.roll(x, shift, axis=axis)
+
     copies = []
     for cc in range(cc_count):
         chan = c * cc_count + cc
-        d0 = del_ref[0, chan]  # delays ascend with trial index
-        u = chan * stride + t0 + d0
-        q = pl.multiple_of((u // _QUANT) * _QUANT, _QUANT)
+        d0 = del_ref[0, chan]  # chunk-min delay (delays ascend with trial)
+        u0 = t0 + d0
+        q0 = u0 // 128
         cp = pltpu.make_async_copy(
-            x_ref.at[pl.ds(q, w)],
-            win_ref.at[pl.ds(cc * w, w)],
+            x_ref.at[chan, pl.ds(q0, nbw)],
+            win_ref.at[cc],
             sems.at[cc],
         )
         cp.start()
-        copies.append((cp, u - q, chan))
+        copies.append((cp, u0 - q0 * 128, chan))
 
-    # per-trial row accumulators live as VALUES across the channel
-    # loop: one concatenate + one acc_ref add per grid step instead of
-    # one per channel
-    rows = [jnp.zeros((1, b), jnp.float32) for _ in range(_DT)]
-    for cc, (cp, rem, chan) in enumerate(copies):
+    lane = jax.lax.broadcasted_iota(jnp.int32, (nb, 128), 1)
+    for cc, (cp, base, chan) in enumerate(copies):
         cp.wait()
+        wnd = win_ref[cc]  # (NBW, 128)
         d0 = del_ref[0, chan]
-        chunk = win_ref[pl.ds(cc * w, w)].reshape(1, w)
+        # versions[k][r] = wnd[r + k]: static sublane rolls, shared by
+        # all 8 trials of the chunk
+        versions = [
+            wnd if k == 0 else roll(wnd, nbw - k, axis=0)
+            for k in range(k_max + 1)
+        ]
         for di in range(_DT):
-            shift = rem + (del_ref[di, chan] - d0)
-            if interpret:
-                arm = jax.lax.dynamic_slice(chunk, (0, shift), (1, b))
-            else:
-                arm = pltpu.roll(chunk, w - shift, axis=1)[:, :b]
-            rows[di] = rows[di] + arm
-    acc_ref[:] += jnp.concatenate(rows, axis=0)
+            rel = base + (del_ref[di, chan] - d0)  # in [0, 127 + spread]
+            k_i = rel // 128
+            s_i = rel % 128
+            sel = versions[0]
+            for k in range(1, k_max + 1):
+                sel = jnp.where(k_i == k, versions[k], sel)
+            a = roll(sel, 128 - s_i, axis=1)  # a[r, l] = sel[r, l+s mod 128]
+            nxt = roll(a, nbw - 1, axis=0)  # nxt[r] = a[r + 1]
+            arm = jnp.where(lane < 128 - s_i, a[:nb], nxt[:nb])
+            acc_ref[di] += arm
 
     @pl.when(c == nc - 1)
     def _():
@@ -119,16 +138,19 @@ def _kernel(
 
 @lru_cache(maxsize=None)
 def _build(
-    d: int, t_out: int, c: int, b: int, spread: int, stride: int,
-    interpret: bool,
+    d: int, t_out: int, c: int, b: int, spread: int, interpret: bool,
 ):
-    w = _window_len(b, spread)
+    nb = b // 128
+    k_max = (127 + spread) // 128
+    nbw = _nbw(nb, k_max)
     kernel = partial(
-        _kernel, b=b, w=w, stride=stride, cc_count=_CC, interpret=interpret
+        _kernel, nb=nb, nbw=nbw, k_max=k_max, cc_count=_CC,
+        interpret=interpret,
     )
+    tb = t_out // 128
     return pl.pallas_call(
         kernel,
-        grid=(d // _DT, t_out // b, c // _CC),
+        grid=(d // _DT, tb // nb, c // _CC),
         in_specs=[
             # full channel width per trial chunk (SMEM blocks must have
             # their last dim equal to the array's); 8 x C x 4 B = 32 KB
@@ -140,12 +162,13 @@ def _build(
             pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
         ],
         out_specs=pl.BlockSpec(
-            (_DT, b), lambda dd, tt, cc: (dd, tt), memory_space=pltpu.VMEM
+            (_DT, nb, 128), lambda dd, tt, cc: (dd, tt, 0),
+            memory_space=pltpu.VMEM,
         ),
-        out_shape=jax.ShapeDtypeStruct((d, t_out), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((d, tb, 128), jnp.float32),
         scratch_shapes=[
-            pltpu.VMEM((_DT, b), jnp.float32),
-            pltpu.VMEM((_CC * w,), jnp.float32),
+            pltpu.VMEM((_DT, nb, 128), jnp.float32),
+            pltpu.VMEM((_CC, nbw, 128), jnp.float32),
             pltpu.SemaphoreType.DMA((_CC,)),
         ],
         interpret=interpret,
@@ -177,8 +200,9 @@ def pallas_hbm_bytes(
     t_out = -(-out_nsamps // b) * b
     cpad = -(-c // _CC) * _CC
     dpad = -(-d // _DT) * _DT
-    stride = _row_stride(t_in, b, max(spread, b) if spread else b)
-    return 4 * (cpad * stride + dpad * t_out) + t_in * c
+    sp = spread if spread is not None else _QUANT
+    tr = _tr_rows(t_in, b // 128, (127 + sp) // 128)
+    return 4 * (cpad * tr * 128 + dpad * t_out) + t_in * c
 
 
 def dedisperse_pallas(
@@ -191,20 +215,23 @@ def dedisperse_pallas(
     scale: float = 1.0,
     block: int = 16384,
     interpret: bool = False,
+    spread: int | None = None,
 ) -> jax.Array:
     """All DM trials in ONE kernel dispatch, bitwise equal to the jnp
     twin. Trials/channels pad to the (8, 16) grid quanta with repeated/
-    zero rows; output time pads to ``block`` lanes and is trimmed."""
+    zero rows; output time pads to ``block`` lanes and is trimmed.
+    Pass ``spread`` (plan_spread(delays)) when the caller already
+    computed it — the O(D*C) host scan is not free at survey scale."""
     delays = np.asarray(delays, dtype=np.int32)
     d, c = delays.shape
     t_in = fil_tc.shape[0]
     # don't let a small search pay a full survey-sized block: the padded
-    # tail beyond out_nsamps is computed and trimmed (row padding keeps
-    # every window in range regardless — see _row_stride)
+    # tail beyond out_nsamps is computed and trimmed (window slack rows
+    # keep every DMA in range regardless)
     b = min(block, max(_QUANT, -(-out_nsamps // _QUANT) * _QUANT))
     t_out = -(-out_nsamps // b) * b
-    spread = plan_spread(delays)
-    stride = _row_stride(t_in, b, spread)
+    if spread is None:
+        spread = plan_spread(delays)
 
     dpad = -(-d // _DT) * _DT
     cpad = -(-c // _CC) * _CC
@@ -221,7 +248,7 @@ def dedisperse_pallas(
         )
 
     run = _jit_full(
-        dpad, t_out, cpad, b, spread, stride, d, c, t_in, out_nsamps,
+        dpad, t_out, cpad, b, spread, d, c, t_in, out_nsamps,
         quantize, float(scale), interpret,
     )
     return run(jnp.asarray(fil_tc), jnp.asarray(delays),
@@ -230,21 +257,26 @@ def dedisperse_pallas(
 
 @lru_cache(maxsize=None)
 def _jit_full(
-    dpad, t_out, cpad, b, spread, stride, d, c, t_in, out_nsamps,
+    dpad, t_out, cpad, b, spread, d, c, t_in, out_nsamps,
     quantize, scale, interpret,
 ):
-    """Prep (mask, f32, pad/transpose/flatten), the kernel, and the
+    """Prep (mask, f32, pad/transpose/block), the kernel, and the
     trim/scale/quantize tail as ONE jitted program: each eager op is a
     separately dispatched executable, and on a high-latency link the
     half-dozen dispatches cost more than the kernel itself."""
-    fn = _build(dpad, t_out, cpad, b, spread, stride, interpret)
+    fn = _build(dpad, t_out, cpad, b, spread, interpret)
+    k_max = (127 + spread) // 128
+    tr = _tr_rows(t_in, b // 128, k_max)
 
     @jax.jit
     def run(fil_tc, delays, killmask):
         x = fil_tc.astype(jnp.float32) * killmask.astype(jnp.float32)[None, :]
-        # flat padded channel rows (tail zeros; never selected)
-        xp = jnp.pad(x.T, ((0, cpad - c), (0, stride - t_in))).reshape(-1)
-        out = fn(delays, xp)[:d, :out_nsamps]
+        # (C, TR, 128) blocked channel rows (tail zero rows = window
+        # slack; never selected into real output samples)
+        xp = jnp.pad(
+            x.T, ((0, cpad - c), (0, tr * 128 - t_in))
+        ).reshape(cpad, tr, 128)
+        out = fn(delays, xp).reshape(dpad, t_out)[:d, :out_nsamps]
         if scale != 1.0:
             out = out * jnp.float32(scale)
         if quantize:

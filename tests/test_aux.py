@@ -236,8 +236,11 @@ class TestOOMContract:
 
         from peasoup_tpu.pipeline.search import _is_oom
 
+        # beyond the 48-bit virtual address space: fails unconditionally
+        # at allocation on every host (a merely-huge size can mmap fine
+        # under overcommit and get the process OOM-killed instead)
         with pytest.raises(Exception) as ei:
-            jnp.zeros((1 << 46,), jnp.float32).block_until_ready()
+            jnp.zeros((1 << 55,), jnp.float32).block_until_ready()
         assert _is_oom(ei.value), (
             "JAX's real OOM exception no longer matches _is_oom: "
             f"{type(ei.value).__name__}: {str(ei.value)[:200]}"
