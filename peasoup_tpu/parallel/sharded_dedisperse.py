@@ -29,6 +29,23 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops.dedisperse import _dedisperse_core, _pad_blocks
 
 
+def _shard_map_nocheck(local_fn, mesh, in_specs, out_specs):
+    # check_vma off: the local bodies are collective-free, and values
+    # created inside (scan carries, iotas) start unvarying while the
+    # delays are device-varying — the check would demand pvary casts
+    # inside shared single-device code
+    try:
+        return jax.shard_map(
+            local_fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    except TypeError:  # older jax spells it check_rep
+        return jax.shard_map(
+            local_fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
+
+
 @lru_cache(maxsize=None)
 def _make_sharded_dd(
     mesh: Mesh,
@@ -52,27 +69,48 @@ def _make_sharded_dd(
         ]
         return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
 
-    # check_vma off: the local body is collective-free, and the scan
-    # carry inside _dedisperse_core starts unvarying (created from
-    # jnp.zeros) while the delays are device-varying — the check would
-    # demand a pvary cast inside shared single-device code
-    try:
-        fn = jax.shard_map(
-            local_fn,
-            mesh=mesh,
-            in_specs=(P(), P(axis, None)),
-            out_specs=P(axis, None),
-            check_vma=False,
+    return jax.jit(
+        _shard_map_nocheck(
+            local_fn, mesh, (P(), P(axis, None)), P(axis, None)
         )
-    except TypeError:  # older jax spells it check_rep
-        fn = jax.shard_map(
-            local_fn,
-            mesh=mesh,
-            in_specs=(P(), P(axis, None)),
-            out_specs=P(axis, None),
-            check_rep=False,
+    )
+
+
+@lru_cache(maxsize=None)
+def _make_sharded_dd_pallas(
+    mesh: Mesh,
+    axis: str,
+    t_out: int,
+    cpad: int,
+    b: int,
+    spread: int,
+    quantize: bool,
+    scale: float,
+    per_dev: int,
+    out_nsamps: int,
+    interpret: bool,
+):
+    """Per-shard Pallas blocked-roll kernel (ops/pallas/dedisperse.py):
+    each chip runs the 13x kernel on ITS slice of the delay table — the
+    multi-chip analogue of dedisp_create_plan_multi with dedisp's GPU
+    kernel on every device."""
+    from ..ops.pallas.dedisperse import _build
+
+    fn = _build(per_dev, t_out, cpad, b, spread, interpret)
+
+    def local_fn(xp, delays):
+        out = fn(delays, xp).reshape(per_dev, t_out)[:, :out_nsamps]
+        if scale != 1.0:
+            out = out * jnp.float32(scale)
+        if quantize:
+            out = jnp.clip(jnp.rint(out), 0, 255).astype(jnp.uint8)
+        return out
+
+    return jax.jit(
+        _shard_map_nocheck(
+            local_fn, mesh, (P(), P(axis, None)), P(axis, None)
         )
-    return jax.jit(fn)
+    )
 
 
 def dedisperse_sharded(
@@ -86,6 +124,8 @@ def dedisperse_sharded(
     quantize: bool = True,
     scale: float = 1.0,
     block: int = 16,
+    use_pallas: bool | None = None,
+    interpret: bool = False,
 ):
     """Dedisperse all DM trials with the trial axis sharded over ``mesh``.
 
@@ -93,10 +133,68 @@ def dedisperse_sharded(
     ``P(axis, None)`` — ndm is padded up to a multiple of the mesh axis
     size by repeating the last trial row; callers index rows < ndm only
     (the search's chunk dispatch does exactly that).
+
+    ``use_pallas`` None = auto: on TPU backends that pass the kernel
+    probe (and monotone delay tables), each shard runs the blocked-roll
+    Pallas kernel; elsewhere the jnp channel scan. Both bitwise equal.
     """
     n_dev = mesh.shape[axis]
     delays = np.asarray(delays, dtype=np.int32)
     ndm = delays.shape[0]
+
+    if use_pallas is None:
+        from ..ops.pallas import probe_pallas_dedisperse
+
+        use_pallas = (
+            not interpret
+            and probe_pallas_dedisperse()
+            and bool(np.all(np.diff(delays, axis=0) >= 0))
+        )
+
+    if use_pallas:
+        from ..ops.pallas.dedisperse import (
+            _CC, _DT, _QUANT, _tr_rows, plan_spread,
+        )
+
+        # per-shard trial count must hit the kernel's 8-trial quantum;
+        # shard boundaries at multiples of 8 keep the global 8-chunk
+        # walk of plan_spread aligned with every shard's local chunks
+        per_dev = -(-(-(-ndm // n_dev)) // _DT) * _DT
+        ndm_pad = per_dev * n_dev
+        c = delays.shape[1]
+        cpad = -(-c // _CC) * _CC
+        if ndm_pad > ndm:
+            delays = np.concatenate(
+                [delays, np.tile(delays[-1:], (ndm_pad - ndm, 1))], axis=0
+            )
+        if cpad > c:
+            delays = np.concatenate(
+                [delays, np.tile(delays[:, -1:], (1, cpad - c))], axis=1
+            )
+        t_in = fil_tc.shape[0]
+        b = min(16384, max(_QUANT, -(-out_nsamps // _QUANT) * _QUANT))
+        t_out = -(-out_nsamps // b) * b
+        spread = plan_spread(delays)
+        k_max = (127 + spread) // 128
+        tr = _tr_rows(t_in, b // 128, k_max)
+        x = jnp.asarray(fil_tc).astype(jnp.float32) * jnp.asarray(
+            np.asarray(killmask), dtype=jnp.float32
+        )[None, :]
+        xp = jax.device_put(
+            jnp.pad(x.T, ((0, cpad - c), (0, tr * 128 - t_in))).reshape(
+                cpad, tr, 128
+            ),
+            NamedSharding(mesh, P()),
+        )
+        fn = _make_sharded_dd_pallas(
+            mesh, axis, t_out, cpad, b, spread, quantize, float(scale),
+            per_dev, out_nsamps, interpret,
+        )
+        delays_dev = jax.device_put(
+            delays, NamedSharding(mesh, P(axis, None))
+        )
+        return fn(xp, delays_dev)
+
     per_dev = -(-ndm // n_dev)
     ndm_pad = per_dev * n_dev
     if ndm_pad > ndm:
