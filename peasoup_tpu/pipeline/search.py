@@ -873,47 +873,71 @@ class PeasoupSearch:
         nlev = cfg.nharmonics + 1
         factors_arr = np.asarray(factors, dtype=np.float64)  # (nlev,)
 
-        freq_parts, snr_parts, lvl_parts, a_parts = [], [], [], []
-        seg_counts_parts = []  # (A,) rows per accel trial, per dm
+        # Vectorised across DMs: per-DM numpy loops cost ~1 ms x ndm of
+        # pure call overhead at survey scale. DMs are grouped by their
+        # chunk's (nlev, padded) count shape (uniform stacks), each
+        # group's rows built with one ragged-index pass, and the groups
+        # reassembled into global dm-ascending order by a stable sort —
+        # row order (dm asc, a asc, lvl asc, stream order) is IDENTICAL
+        # to the per-DM loop this replaces.
+        from collections import defaultdict
+
+        by_shape: dict = defaultdict(list)
         for dm_idx in range(dm_plan.ndm):
-            vi, vs, cc = per_dm_results.pop(dm_idx)  # ragged stream + counts
-            A = len(accel_lists[dm_idx])
-            nlev_, padded = cc.shape
-            flat_cc = cc.reshape(-1).astype(np.int64)
-            ends = np.cumsum(flat_cc)
+            vi, vs, cc = per_dm_results.pop(dm_idx)
+            by_shape[cc.shape].append(
+                (dm_idx, vi, vs, cc, len(accel_lists[dm_idx]))
+            )
+
+        g_freq, g_snr, g_lvl, g_a, g_dmrow = [], [], [], [], []
+        g_segc, g_dmseg = [], []
+        for (nlev_, padded), entries in by_shape.items():
+            g = len(entries)
+            dm_ids = np.asarray([e[0] for e in entries])
+            A_arr = np.asarray([e[4] for e in entries], dtype=np.int64)
+            cc3 = np.stack([e[3] for e in entries]).reshape(g, -1)
+            flat_cc = cc3.astype(np.int64)
+            ends = np.cumsum(flat_cc, axis=1)
             starts = ends - flat_cc
-            # cells reordered (a asc, lvl asc), dropping padded accel
-            # slots — the same row order the object path builds
-            cells = (
-                np.arange(nlev_, dtype=np.int64)[None, :] * padded
-                + np.arange(A, dtype=np.int64)[:, None]
-            ).reshape(-1)
-            csel = flat_cc[cells]
+            lens = np.asarray([len(e[1]) for e in entries], dtype=np.int64)
+            base = np.concatenate([[0], np.cumsum(lens)[:-1]])
+            viG = np.concatenate([e[1] for e in entries])
+            vsG = np.concatenate([e[2] for e in entries])
+
+            total_A = int(A_arr.sum())
+            # ragged 0..A_d-1 per dm, then cell = (dm, a, lvl) C-order
+            acat = np.arange(total_A, dtype=np.int64) - np.repeat(
+                np.cumsum(A_arr) - A_arr, A_arr
+            )
+            a_cell = np.repeat(acat, nlev_)
+            lvl_cell = np.tile(np.arange(nlev_, dtype=np.int64), total_A)
+            dml_cell = np.repeat(np.repeat(np.arange(g), A_arr), nlev_)
+            cellidx = lvl_cell * padded + a_cell
+            csel = flat_cc[dml_cell, cellidx]
             n = int(csel.sum())
             seg_e = np.cumsum(csel)
-            src = np.repeat(starts[cells], csel) + (
-                np.arange(n, dtype=np.int64) - np.repeat(seg_e - csel, csel)
-            )
-            lvl_rows = np.repeat(np.tile(np.arange(nlev_), A), csel)
-            freq_parts.append(vi[src].astype(np.float64) * factors_arr[lvl_rows])
-            snr_parts.append(vs[src])
-            lvl_parts.append(lvl_rows.astype(np.int32))
-            a_parts.append(
-                np.repeat(
-                    np.repeat(np.arange(A, dtype=np.int32), nlev_), csel
-                )
-            )
-            seg_counts_parts.append(csel.reshape(A, nlev_).sum(axis=1))
+            src = np.repeat(
+                starts[dml_cell, cellidx] + base[dml_cell], csel
+            ) + (np.arange(n, dtype=np.int64) - np.repeat(seg_e - csel, csel))
+            lvl_rows = np.repeat(lvl_cell, csel)
+            g_freq.append(viG[src].astype(np.float64) * factors_arr[lvl_rows])
+            g_snr.append(vsG[src].astype(np.float64))
+            g_lvl.append(lvl_rows.astype(np.int32))
+            g_a.append(np.repeat(a_cell, csel).astype(np.int32))
+            g_dmrow.append(np.repeat(dm_ids[dml_cell], csel))
+            g_segc.append(csel.reshape(total_A, nlev_).sum(axis=1))
+            g_dmseg.append(np.repeat(dm_ids, A_arr))
 
-        freqs_all = np.concatenate(freq_parts)
-        snr_all = np.concatenate(snr_parts).astype(np.float64)
-        lvl_all = np.concatenate(lvl_parts)
-        a_all = np.concatenate(a_parts)
-        seg_counts = np.concatenate(seg_counts_parts).astype(np.int64)
-        dm_of_seg = np.repeat(
-            np.arange(dm_plan.ndm),
-            [len(a) for a in accel_lists[: dm_plan.ndm]],
-        )
+        dm_of_row = np.concatenate(g_dmrow) if g_dmrow else np.zeros(0, int)
+        perm = np.argsort(dm_of_row, kind="stable")
+        freqs_all = np.concatenate(g_freq)[perm]
+        snr_all = np.concatenate(g_snr)[perm]
+        lvl_all = np.concatenate(g_lvl)[perm]
+        a_all = np.concatenate(g_a)[perm]
+        dm_of_seg_cat = np.concatenate(g_dmseg) if g_dmseg else np.zeros(0, int)
+        segperm = np.argsort(dm_of_seg_cat, kind="stable")
+        seg_counts = np.concatenate(g_segc)[segperm].astype(np.int64)
+        dm_of_seg = dm_of_seg_cat[segperm]
         seg_id = np.repeat(np.arange(seg_counts.size), seg_counts)
 
         # stable within-segment S/N-descending order (primary key is the
