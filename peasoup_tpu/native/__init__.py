@@ -63,6 +63,12 @@ def _load() -> Optional[ctypes.CDLL]:
     ]
     lib.ps_accel_distill.restype = ctypes.c_int64
 
+    lib.ps_accel_distill_seg.argtypes = [
+        _f64p, _f64p, _i64p, ctypes.c_int64, ctypes.c_double,
+        ctypes.c_double, _i8p, _i32p, _i32p, ctypes.c_int64,
+    ]
+    lib.ps_accel_distill_seg.restype = ctypes.c_int64
+
     lib.ps_dm_distill.argtypes = [
         _f64p, ctypes.c_int64, ctypes.c_double, ctypes.c_int32, _i8p, _i32p,
         _i32p, ctypes.c_int64,
@@ -163,6 +169,26 @@ def accel_distill(freqs, accs, tobs_over_c, tol, keep_related):
             freqs, accs, n, tobs_over_c, tol, int(keep_related), u, s, d, cap,
         ),
         n,
+    )
+
+
+def accel_distill_seg(freqs, accs, seg_off, tobs_over_c, tol):
+    """Acceleration-distill every DM-trial segment in one native call
+    (rows pre-sorted S/N-descending within each segment). Returns
+    (survivor mask, edge_src, edge_dst) with GLOBAL row ids, or None
+    without the library."""
+    lib = _load()
+    if lib is None:
+        return None
+    freqs = np.ascontiguousarray(freqs, dtype=np.float64)
+    accs = np.ascontiguousarray(accs, dtype=np.float64)
+    seg_off = np.ascontiguousarray(seg_off, dtype=np.int64)
+    return _run_distill(
+        lambda u, s, d, cap: lib.ps_accel_distill_seg(
+            freqs, accs, seg_off, len(seg_off) - 1, tobs_over_c, tol,
+            u, s, d, cap,
+        ),
+        len(freqs),
     )
 
 

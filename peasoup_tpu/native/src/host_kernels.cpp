@@ -226,6 +226,46 @@ int64_t ps_accel_distill(const double* freqs, const double* accs, int64_t n,
   return edges.n;
 }
 
+// Segmented variant: one call runs the acceleration distill of EVERY
+// DM trial (segment s = rows [seg_off[s], seg_off[s+1]), pre-sorted
+// S/N-descending within each segment), recording winner->loser edges
+// with GLOBAL row ids so the caller can build the assoc tree for the
+// survivors only once.  Same pairwise window test as ps_accel_distill
+// (reference distiller.hpp:115-164).
+int64_t ps_accel_distill_seg(const double* freqs, const double* accs,
+                             const int64_t* seg_off, int64_t nseg,
+                             double tobs_over_c, double tol, uint8_t* unique,
+                             int32_t* edge_src, int32_t* edge_dst,
+                             int64_t max_edges) {
+  EdgeSink edges{edge_src, edge_dst, max_edges};
+  for (int64_t s = 0; s < nseg; ++s) {
+    const int64_t b = seg_off[s], e = seg_off[s + 1];
+    std::fill(unique + b, unique + e, uint8_t{1});
+    for (int64_t idx = b; idx < e; ++idx) {
+      if (!unique[idx]) continue;
+      const double fundi_freq = freqs[idx];
+      const double fundi_acc = accs[idx];
+      const double edge = fundi_freq * tol;
+      for (int64_t jj = idx + 1; jj < e; ++jj) {
+        const double delta_acc = fundi_acc - accs[jj];
+        const double acc_freq =
+            fundi_freq + delta_acc * fundi_freq * tobs_over_c;
+        bool hit;
+        if (acc_freq > fundi_freq) {
+          hit = freqs[jj] > fundi_freq - edge && freqs[jj] < acc_freq + edge;
+        } else {
+          hit = freqs[jj] < fundi_freq + edge && freqs[jj] > acc_freq - edge;
+        }
+        if (hit) {
+          edges.add(idx, jj);
+          unique[jj] = 0;
+        }
+      }
+    }
+  }
+  return edges.n;
+}
+
 int64_t ps_dm_distill(const double* freqs, int64_t n, double tol,
                       int32_t keep_related, uint8_t* unique, int32_t* edge_src,
                       int32_t* edge_dst, int64_t max_edges) {

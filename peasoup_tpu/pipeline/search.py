@@ -958,6 +958,56 @@ class PeasoupSearch:
         s_lvl = lvl_all[surv]
         s_snr = snr_all[surv]
         s_freq = freqs_all[surv]
+
+        # per-row acceleration values via a padded (ndm, maxA) lookup
+        max_a = max((len(a) for a in accel_lists[: dm_plan.ndm]), default=1)
+        acc_tab = np.zeros((dm_plan.ndm, max(max_a, 1)))
+        for di, accs in enumerate(accel_lists[: dm_plan.ndm]):
+            acc_tab[di, : len(accs)] = accs
+        s_acc = acc_tab[s_dm, s_a]
+
+        # the acceleration distill runs as ONE segmented native call
+        # over every DM trial (segment = DM, rows stable-sorted S/N
+        # descending — the distiller's !IMPORTANT sort), with
+        # winner->loser edges building the assoc tree the scorer reads
+        order2 = np.lexsort((-s_snr, s_dm))
+        d_dm, d_a, d_lvl = s_dm[order2], s_a[order2], s_lvl[order2]
+        d_snr, d_freq, d_acc = s_snr[order2], s_freq[order2], s_acc[order2]
+        seg_off2 = np.searchsorted(d_dm, np.arange(dm_plan.ndm + 1))
+        seg_res = native.accel_distill_seg(
+            d_freq, d_acc, seg_off2, acc_still.tobs_over_c,
+            acc_still.tolerance,
+        )
+        if seg_res is not None:
+            unique2, esrc, edst = seg_res
+            dm_vals = dm_plan.dm_list
+            row_cands = [
+                Candidate(
+                    dm=float(dm_vals[d_dm[r]]),
+                    dm_idx=int(d_dm[r]),
+                    acc=float(d_acc[r]),
+                    nh=int(d_lvl[r]),
+                    snr=float(d_snr[r]),
+                    freq=float(d_freq[r]),
+                )
+                for r in range(len(order2))
+            ]
+            for s_, t_ in zip(esrc, edst):
+                row_cands[s_].append(row_cands[t_])
+            for dm_idx in range(dm_plan.ndm):
+                lo, hi = seg_off2[dm_idx], seg_off2[dm_idx + 1]
+                dm_trial_cands.append(
+                    [row_cands[r] for r in range(lo, hi) if unique2[r]]
+                )
+                if cfg.verbose:
+                    print(
+                        f"DM {float(dm_vals[dm_idx]):.3f} "
+                        f"({dm_idx+1}/{dm_plan.ndm}): "
+                        f"{len(accel_lists[dm_idx])} accel trials, "
+                        f"{len(dm_trial_cands)} cands so far"
+                    )
+            return
+
         bounds = np.searchsorted(s_dm, np.arange(dm_plan.ndm + 1))
         for dm_idx in range(dm_plan.ndm):
             dm = float(dm_plan.dm_list[dm_idx])
