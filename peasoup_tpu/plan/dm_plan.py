@@ -3,11 +3,16 @@
 The reference delegates DM-list generation and the per-channel delay
 table to the external ``dedisp`` CUDA library
 (reference: include/transforms/dedisperser.hpp:54-62 calls
-``dedisp_generate_dm_list``; delays use the standard dispersion constant
-4.148808e3 s MHz^2 pc^-1 cm^3). We re-derive both from the published
-maths (Lina Levin's tolerance recurrence for the trial spacing and the
-cold-plasma dispersion delay) so the trial grid matches the golden
-59-trial list in /root/reference/example_output/overview.xml.
+``dedisp_generate_dm_list``). We re-derive both bit-faithfully: Lina
+Levin's tolerance recurrence for the trial spacing (f64 on f32-rounded
+plan inputs, each trial stored through f32 — dedisp's float dm_table),
+and dedisp's generate_delay_table for the per-channel delays — which
+uses the ROUNDED dispersion constant 4.15e3 (its source notes the more
+precise 4.148741601e3 but deliberately ships 4.15e3). Matching that
+rounding is required for candidate parity: the f64 divergence oracle
+(tools/divergence.py) reproduces the golden candidates.peasoup S/N to
+every printed digit with 4.15e3 and is 0.3-0.6% off at high DM with the
+textbook 4.148808e3, because one whole-sample delay rounds differently.
 """
 
 from __future__ import annotations
@@ -16,8 +21,9 @@ from dataclasses import dataclass
 
 import numpy as np
 
-# Dispersion constant in s * MHz^2 / (pc cm^-3) * 1e6 (i.e. us units below).
-DM_CONSTANT = 4.148808e3  # seconds when multiplied by DM * (f_MHz^-2 diff)
+# dedisp's generate_delay_table constant (see module docstring); the
+# textbook value 4.148808e3 is NOT what the reference's delays use.
+DM_CONSTANT = 4.15e3  # seconds when multiplied by DM * (f_MHz^-2 diff)
 
 
 def generate_dm_list(
@@ -46,8 +52,16 @@ def generate_dm_list(
     band) grows by the tolerance factor. All intermediate math in f64;
     trials are rounded through f32 to match the reference's stored list.
     """
+    # dedisp receives every one of these as dedisp_float (f32): dt/f0/df
+    # live in the plan struct, ti/tol are dedisp_generate_dm_list args.
+    # The recurrence itself then runs in f64 on the f32-rounded values.
+    dt = float(np.float32(dt))
+    ti = float(np.float32(ti))
+    f0 = float(np.float32(f0))
+    df = float(np.float32(df))
+    tol = float(np.float32(tol))
     dt_us = dt * 1e6
-    f_centre_ghz = (f0 + (nchans / 2 - 0.5) * df) * 1e-3
+    f_centre_ghz = (f0 + (nchans // 2 - 0.5) * df) * 1e-3
     tol2 = tol * tol
     # Intra-channel smearing per unit DM (us): 8.3 * df_MHz / f_GHz^3
     a = 8.3 * df / f_centre_ghz**3
@@ -72,22 +86,29 @@ def generate_dm_list(
 
 
 def delay_table(f0: float, df: float, nchans: int, dt: float) -> np.ndarray:
-    """Per-channel dispersion delay in SAMPLES per unit DM.
-
-    delay[c] = DM_CONSTANT * ((f0 + c*df)^-2 - f0^-2) / dt
-    Computed in f32 like the reference library's float tables.
+    """Per-channel dispersion delay in SAMPLES per unit DM, bit-faithful
+    to dedisp's generate_delay_table: ``a = 1.f/(f0+c*df)`` and the
+    difference of squares in f32 arithmetic, scaled by the f64 quotient
+    ``4.15e3/dt`` and rounded once to the f32 table entry.
     """
-    freqs = (np.float32(f0) + np.arange(nchans, dtype=np.float32) * np.float32(df))
-    a = np.float32(1.0) / freqs
-    b = np.float32(1.0) / np.float32(f0)
-    return (np.float32(DM_CONSTANT) * (a * a - b * b) / np.float32(dt)).astype(
-        np.float32
-    )
+    f0 = np.float32(f0)
+    df = np.float32(df)
+    c = np.arange(nchans, dtype=np.float32)
+    a = (np.float32(1.0) / (f0 + c * df)).astype(np.float32)
+    b = np.float32(1.0) / f0
+    diff2 = (a * a - b * b).astype(np.float32)
+    return (
+        np.float64(DM_CONSTANT) / np.float64(np.float32(dt))
+        * diff2.astype(np.float64)
+    ).astype(np.float32)
 
 
 def max_delay_samples(dm_max: float, delays: np.ndarray) -> int:
-    """Maximum whole-sample delay across channels at the largest trial DM."""
-    return int(np.rint(float(dm_max) * float(np.max(np.abs(delays)))))
+    """Maximum whole-sample delay at the largest trial DM: dedisp's
+    ``dm_list[last] * delay_table[nchans-1] + 0.5`` truncation, with the
+    product in f32 (both factors are f32 in the library)."""
+    prod = np.float32(np.float32(dm_max) * np.abs(delays[-1]))
+    return int(np.floor(np.float64(prod) + 0.5))
 
 
 @dataclass
@@ -151,9 +172,10 @@ class DMPlan:
         )
 
     def delay_samples(self) -> np.ndarray:
-        """Integer delay (ndm, nchans) in samples, rounded to nearest."""
-        d = np.rint(
-            self.dm_list[:, None].astype(np.float64)
-            * np.abs(self.delays)[None, :].astype(np.float64)
-        )
-        return d.astype(np.int32)
+        """Integer delay (ndm, nchans) in samples: round-half-even of
+        the F32 product ``dm * delay_table[c]`` (the dedisp kernel's
+        __float2uint_rn on float operands)."""
+        prod = (
+            self.dm_list[:, None] * np.abs(self.delays)[None, :]
+        ).astype(np.float32)
+        return np.rint(prod).astype(np.int32)
