@@ -75,6 +75,14 @@ def _load() -> Optional[ctypes.CDLL]:
     ]
     lib.ps_dm_distill.restype = ctypes.c_int64
 
+    lib.ps_snr_sort_perm.argtypes = [_f32p, ctypes.c_int64, _i32p]
+    lib.ps_snr_sort_perm.restype = None
+
+    lib.ps_snr_sort_perm_seg.argtypes = [
+        _f32p, _i64p, ctypes.c_int64, _i32p,
+    ]
+    lib.ps_snr_sort_perm_seg.restype = None
+
     _lib = lib
     return _lib
 
@@ -106,6 +114,35 @@ def cluster_peaks(
     out_snr = np.empty(max(count, 1), dtype=np.float64)
     n = lib.ps_cluster_peaks(idxs, snrs, count, min_gap, out_idx, out_snr)
     return out_idx[:n].copy(), out_snr[:n].copy()
+
+
+def snr_sort_perm(snrs: np.ndarray) -> Optional[np.ndarray]:
+    """The reference's candidate sort as a permutation: libstdc++
+    std::sort (unstable introsort) on (snr, index) pairs with the
+    ``x.snr > y.snr`` comparator of distiller.hpp:11-13.  Returns None
+    when the native library is unavailable (callers fall back to a
+    stable sort, losing only exact-tie winner parity)."""
+    lib = _load()
+    if lib is None:
+        return None
+    snrs = np.ascontiguousarray(snrs, dtype=np.float32)
+    perm = np.empty(len(snrs), dtype=np.int32)
+    lib.ps_snr_sort_perm(snrs, len(snrs), perm)
+    return perm
+
+
+def snr_sort_perm_seg(
+    snrs: np.ndarray, seg_off: np.ndarray
+) -> Optional[np.ndarray]:
+    """Per-segment std::sort permutation (global row ids)."""
+    lib = _load()
+    if lib is None:
+        return None
+    snrs = np.ascontiguousarray(snrs, dtype=np.float32)
+    seg_off = np.ascontiguousarray(seg_off, dtype=np.int64)
+    perm = np.empty(len(snrs), dtype=np.int32)
+    lib.ps_snr_sort_perm_seg(snrs, seg_off, len(seg_off) - 1, perm)
+    return perm
 
 
 def _run_distill(call, n: int):

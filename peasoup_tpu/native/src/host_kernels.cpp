@@ -266,6 +266,51 @@ int64_t ps_accel_distill_seg(const double* freqs, const double* accs,
   return edges.n;
 }
 
+// ---------------------------------------------------------------------------
+// The reference's !IMPORTANT S/N sort (distiller.hpp:31) is std::sort —
+// an UNSTABLE introsort whose permutation of equal-S/N candidates is
+// deterministic but not input-order-preserving.  Real searches contain
+// EXACT S/N ties (accel trials whose resample shift never reaches half a
+// sample produce bitwise-identical spectra), and the distiller crowns
+// whichever tied member the sort leaves first — so matching the golden
+// winners requires replaying the same algorithm, not a stable sort.
+// Sorting (snr, original-index) pairs with the same comparator yields the
+// exact permutation: introsort's compare/move sequence depends only on
+// comparator outcomes, never on element payload.
+// ---------------------------------------------------------------------------
+struct PsSnrTag {
+  float snr;
+  int32_t idx;
+};
+
+void ps_snr_sort_perm(const float* snr, int64_t n, int32_t* perm) {
+  std::vector<PsSnrTag> v(static_cast<size_t>(n));
+  for (int64_t i = 0; i < n; ++i)
+    v[static_cast<size_t>(i)] = {snr[i], static_cast<int32_t>(i)};
+  std::sort(v.begin(), v.end(),
+            [](const PsSnrTag& x, const PsSnrTag& y) { return x.snr > y.snr; });
+  for (int64_t i = 0; i < n; ++i) perm[i] = v[static_cast<size_t>(i)].idx;
+}
+
+// Segmented variant: independent std::sort per [seg_off[s], seg_off[s+1])
+// slice (the reference sorts each trial's candidate list separately);
+// perm entries are GLOBAL row ids.
+void ps_snr_sort_perm_seg(const float* snr, const int64_t* seg_off,
+                          int64_t nseg, int32_t* perm) {
+  std::vector<PsSnrTag> v;
+  for (int64_t s = 0; s < nseg; ++s) {
+    const int64_t b = seg_off[s], e = seg_off[s + 1];
+    v.resize(static_cast<size_t>(e - b));
+    for (int64_t i = b; i < e; ++i)
+      v[static_cast<size_t>(i - b)] = {snr[i], static_cast<int32_t>(i)};
+    std::sort(v.begin(), v.end(), [](const PsSnrTag& x, const PsSnrTag& y) {
+      return x.snr > y.snr;
+    });
+    for (int64_t i = b; i < e; ++i)
+      perm[i] = v[static_cast<size_t>(i - b)].idx;
+  }
+}
+
 int64_t ps_dm_distill(const double* freqs, int64_t n, double tol,
                       int32_t keep_related, uint8_t* unique, int32_t* edge_src,
                       int32_t* edge_dst, int64_t max_edges) {

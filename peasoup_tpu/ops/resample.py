@@ -28,8 +28,13 @@ SPEED_OF_LIGHT = 299792458.0
 
 
 def accel_factor(accs: np.ndarray, tsamp: float) -> np.ndarray:
-    """af = a * tsamp / (2c) in f64 on the host (kernels.cu:354)."""
-    return np.asarray(accs, dtype=np.float64) * tsamp / (2.0 * SPEED_OF_LIGHT)
+    """af = (a*tsamp) / (2c): the a*tsamp product is an F32 multiply in
+    the reference (``float a, float tsamp``, kernels.cu:348-354), the
+    division by 2c is f64."""
+    prod = (np.asarray(accs, dtype=np.float32) * np.float32(tsamp)).astype(
+        np.float32
+    )
+    return prod.astype(np.float64) / (2.0 * SPEED_OF_LIGHT)
 
 
 @jax.jit

@@ -44,7 +44,21 @@ class BaseDistiller:
 
     def distill(self, cands: List[Candidate]) -> List[Candidate]:
         size = len(cands)
-        cands = sorted(cands, key=lambda c: -c.snr)  # S/N desc, stable
+        # The !IMPORTANT S/N-descending sort (distiller.hpp:31) is
+        # std::sort — UNSTABLE introsort, whose arrangement of exactly
+        # tied S/N values decides which tie member the distiller crowns.
+        # Replay the same libstdc++ algorithm via the native runtime;
+        # fall back to a stable sort (tie winners may then differ from
+        # the reference, everything else is identical).
+        from .. import native
+
+        perm = native.snr_sort_perm(
+            np.array([c.snr for c in cands], dtype=np.float32)
+        )
+        if perm is not None:
+            cands = [cands[i] for i in perm]
+        else:
+            cands = sorted(cands, key=lambda c: -c.snr)  # S/N desc, stable
         self.freqs = np.array([c.freq for c in cands], dtype=np.float64)
         self.accs = np.array([c.acc for c in cands], dtype=np.float64)
         self.nhs = np.array([c.nh for c in cands], dtype=np.int64)

@@ -190,13 +190,21 @@ def _densify_ragged(
     )
 
 
-def _freq_factor(size: int, nh: int, tsamp: float) -> float:
-    """Bin index -> frequency for level nh (peakfinder.hpp:89)."""
+def _freq_factor(size: int, nh: int, tsamp: float) -> np.float32:
+    """Bin index -> frequency for level nh, replaying the reference's
+    f32 rounding points exactly: ``float tobs = size*get_tsamp()`` (an
+    f32 product — get_tsamp returns float, timeseries.hpp:123),
+    ``float bin_width = 1.0/tobs`` (pipeline_multi.cu:118-119), then
+    PeakFinder's ``float nyquist = bin_width*size`` and ``float factor``
+    (peakfinder.hpp:77-89).  The candidate's stored f32 freq is
+    ``f32(f32(idx) * factor)``."""
     size_spec = size // 2 + 1
     tobs = np.float32(size) * np.float32(tsamp)
-    bin_width = 1.0 / float(tobs)
-    nyquist = bin_width * size_spec
-    return 1.0 / size_spec * nyquist / 2.0**nh
+    bin_width = np.float32(1.0 / np.float64(tobs))
+    nyquist = np.float32(np.float64(bin_width) * np.float64(size_spec))
+    return np.float32(
+        1.0 / np.float64(size_spec) * np.float64(nyquist) / 2.0**nh
+    )
 
 
 class PeasoupSearch:
@@ -400,7 +408,9 @@ class PeasoupSearch:
         trials_nsamps = dm_plan.out_nsamps
         nsamps_valid = min(trials_nsamps, size)
         tobs = float(np.float32(size) * np.float32(fil.tsamp))
-        bin_width = 1.0 / tobs
+        # float bin_width = 1.0/tobs (pipeline_multi.cu:119) — every
+        # downstream consumer (pos5/pos25, zap masks) sees the f32 value
+        bin_width = float(np.float32(1.0 / tobs))
         # NOTE: the reference passes foff as the accel plan's "bw" —
         # the width term uses the CHANNEL width (pipeline_multi.cu:335-337)
         acc_plan = AccelerationPlan(
@@ -712,7 +722,9 @@ class PeasoupSearch:
                                     acc=acc,
                                     nh=lvl,
                                     snr=float(s),
-                                    freq=float(b) * factors[lvl],
+                                    freq=float(
+                                        np.float32(np.float32(b) * factors[lvl])
+                                    ),
                                 )
                             )
                     accel_trial_cands.append(harm_finder.distill(trial_cands))
@@ -871,7 +883,7 @@ class PeasoupSearch:
         from .. import native
 
         nlev = cfg.nharmonics + 1
-        factors_arr = np.asarray(factors, dtype=np.float64)  # (nlev,)
+        factors_arr = np.asarray(factors, dtype=np.float32)  # (nlev,)
 
         # Vectorised across DMs: per-DM numpy loops cost ~1 ms x ndm of
         # pure call overhead at survey scale. DMs are grouped by their
@@ -920,7 +932,13 @@ class PeasoupSearch:
                 starts[dml_cell, cellidx] + base[dml_cell], csel
             ) + (np.arange(n, dtype=np.int64) - np.repeat(seg_e - csel, csel))
             lvl_rows = np.repeat(lvl_cell, csel)
-            g_freq.append(viG[src].astype(np.float64) * factors_arr[lvl_rows])
+            # f32(f32(idx) * f32 factor): the reference's int*float
+            # multiply (peakfinder.hpp:90), widened to f64 only after
+            g_freq.append(
+                (viG[src].astype(np.float32) * factors_arr[lvl_rows])
+                .astype(np.float32)
+                .astype(np.float64)
+            )
             g_snr.append(vsG[src].astype(np.float64))
             g_lvl.append(lvl_rows.astype(np.int32))
             g_a.append(np.repeat(a_cell, csel).astype(np.int32))
@@ -940,12 +958,19 @@ class PeasoupSearch:
         dm_of_seg = dm_of_seg_cat[segperm]
         seg_id = np.repeat(np.arange(seg_counts.size), seg_counts)
 
-        # stable within-segment S/N-descending order (primary key is the
-        # LAST element of the lexsort key tuple)
-        order = np.lexsort((-snr_all, seg_id))
-        seg_off = np.concatenate(
+        # within-segment S/N-descending order.  The reference's sort is
+        # std::sort (UNSTABLE introsort, distiller.hpp:31) whose
+        # arrangement of exact S/N ties decides distill winners — replay
+        # it via the native runtime; stable lexsort is the fallback.
+        seg_off0 = np.concatenate(
             [np.zeros(1, np.int64), np.cumsum(seg_counts)]
         )
+        order = native.snr_sort_perm_seg(
+            snr_all.astype(np.float32), seg_off0
+        )
+        if order is None:
+            order = np.lexsort((-snr_all, seg_id))
+        seg_off = seg_off0
         unique = native.harmonic_distill_seg(
             freqs_all[order], lvl_all[order], seg_off,
             harm_finder.tolerance, harm_finder.max_harm,
@@ -967,10 +992,19 @@ class PeasoupSearch:
         s_acc = acc_tab[s_dm, s_a]
 
         # the acceleration distill runs as ONE segmented native call
-        # over every DM trial (segment = DM, rows stable-sorted S/N
-        # descending — the distiller's !IMPORTANT sort), with
-        # winner->loser edges building the assoc tree the scorer reads
-        order2 = np.lexsort((-s_snr, s_dm))
+        # over every DM trial (segment = DM, rows in the reference's
+        # std::sort S/N-descending arrangement — the !IMPORTANT sort
+        # applied to the per-DM concatenation of per-accel survivors),
+        # with winner->loser edges building the assoc tree the scorer
+        # reads.  s_dm is non-decreasing (segments were built dm-asc,
+        # a-asc), so the per-DM slices of surv are exactly the
+        # reference's accel_trial_cands input order.
+        seg_bounds = np.searchsorted(s_dm, np.arange(dm_plan.ndm + 1))
+        order2 = native.snr_sort_perm_seg(
+            s_snr.astype(np.float32), seg_bounds.astype(np.int64)
+        )
+        if order2 is None:
+            order2 = np.lexsort((-s_snr, s_dm))
         d_dm, d_a, d_lvl = s_dm[order2], s_a[order2], s_lvl[order2]
         d_snr, d_freq, d_acc = s_snr[order2], s_freq[order2], s_acc[order2]
         seg_off2 = np.searchsorted(d_dm, np.arange(dm_plan.ndm + 1))

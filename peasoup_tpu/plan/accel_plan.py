@@ -40,27 +40,35 @@ class AccelerationPlan:
     def step(self, dm: float) -> float:
         """Trial spacing alt_a at the given DM (m/s^2).
 
-        Width terms mix units like the reference (pulse_width becomes ms,
-        tsamp stays in s) and every intermediate is truncated to f32 the
-        way the reference's float locals are (utils.hpp:162-180).
+        Follows the GOLDEN binary's semantics: pulse_width enters the
+        width sum in MICROSECONDS (w_us = sqrt(tdm + pw^2 + tsamp^2),
+        utils.hpp:175-179).  The reference repo's current utils.hpp:165
+        divides pulse_width by 1e3 in the constructor — a later upstream
+        change the 2014 golden artifacts demonstrably predate: with the
+        division, the tutorial flags yield alt_a ~ 0.24 m/s^2 (~44 accel
+        trials/DM), while the golden candidates.peasoup assoc lists
+        contain exactly the accs {0, -5, +5} per DM trial, which
+        requires alt_a > 10 (w_us = 64 gives ~240).  We match the
+        artifacts, which are the only ground truth for parity.
         """
         # C semantics: float locals, double expression evaluation, one
         # truncation per assignment.
         f32 = np.float32
         bw = float(f32(self.bw))
         cfreq = float(f32(self.cfreq))
-        tol = float(f32(self.tol))
-        pulse_width = float(f32(self.pulse_width / 1.0e3))
-        tsamp = float(f32(self.tsamp))
-        tobs = float(f32(f32(self.nsamps) * f32(self.tsamp)))
-        tdm = float(f32((8.3 * bw / cfreq**3 * dm) ** 2))
-        tpulse = float(f32(pulse_width * pulse_width))
-        ttsamp = float(f32(tsamp * tsamp))
-        w_us = float(f32(np.sqrt(tdm + tpulse + ttsamp)))
+        tol = f32(self.tol)
+        pulse_width = f32(self.pulse_width)
+        tsamp = f32(self.tsamp)
+        tobs = float(f32(self.nsamps) * f32(self.tsamp))  # uint*float: f32
+        tdm = float(f32((8.3 * bw / cfreq**3 * float(f32(dm))) ** 2))
+        tpulse = float(pulse_width * pulse_width)  # float*float: f32
+        ttsamp = float(tsamp * tsamp)  # float*float: f32
+        # float + float additions, then sqrt rounded once to the local
+        w_us = float(f32(np.sqrt(np.float64(f32(f32(tdm + tpulse) + ttsamp)))))
         return float(
             f32(
                 2.0 * w_us * 1.0e-6 * 24.0 * SPEED_OF_LIGHT / tobs / tobs
-                * np.sqrt(tol * tol - 1.0)
+                * np.sqrt(np.float64(tol * tol) - 1.0)
             )
         )
 

@@ -8,14 +8,21 @@ runs our full pipeline with the same flags through the real CLI (so the
 output writers are exercised too) and gates on 100% recall of the 10
 golden candidates via peasoup_tpu.tools.recall.
 
-Known, accepted deltas vs the golden list (documented here per VERDICT
-round 1 item 2):
-- acc: on 4 of the 10, the reference's acceleration distiller crowned a
-  member of the association cluster at acc=+-5 m/s^2 while ours crowns
-  acc=0 (or vice versa).  tutorial.fil's pulsar is not accelerated, so
-  the +-5 entries are statistical ties; frequency/DM/nh/S/N all agree.
-- snr: within 0.6% relative on every candidate (float accumulation
-  order differs on TPU/XLA).
+Parity status after the round-3 delay-math fix (dedisp's 4.15e3
+constant + f32 rounding chain, see plan/dm_plan.py and
+tools/divergence.py):
+- freq: BIT-EXACT (f32) on all 10; DM: bit-exact; nh: exact.
+- snr: within 2e-4 relative on every candidate (was 0.6% in round 2 —
+  the residual is TPU-vs-cuFFT FFT ULP, measured <= 4.2e-3 absolute
+  S/N against the f64 oracle; see PARITY.md ULP analysis).
+- acc: every candidate's acc is a member of the exact-tie cluster
+  {0, -5, +5} (at tutorial scale |a|<=5 shifts < 0.5 samples, so all
+  three accel trials produce BITWISE-IDENTICAL spectra).  The
+  reference crowns a tie member via std::sort's unstable arrangement;
+  we replay the same libstdc++ introsort (native ps_snr_sort_perm) and
+  match the crowned member on >= 6 of 10 — the rest flip on
+  sub-1e-3-S/N comparator outcomes between UNRELATED candidates, which
+  no independent FFT implementation can pin down (PARITY.md).
 """
 
 import os
@@ -60,15 +67,23 @@ def test_golden_recall_100pct(golden_run_outdir):
 
 
 def test_golden_matches_are_tight(golden_run_outdir):
-    """Beyond recall: frequency to ~1e-7 rel, DM exact, nh exact, and the
-    ten golden candidates occupy the top ten ranks of our list."""
+    """Beyond recall: frequency and DM bit-exact, nh exact, S/N within
+    1e-3, acc within the exact-tie cluster with most winners matching
+    the reference's std::sort arrangement, and the ten golden candidates
+    occupy the top ten ranks of our list."""
     rep = match_golden(os.path.join(golden_run_outdir, "overview.xml"))
+    n_acc_exact = 0
     for m in rep.matches:
         assert m.matched
-        assert m.dfreq_rel < 1e-6, m
-        assert abs(m.ddm) < 1e-3, m
+        assert m.dfreq_rel == 0.0, m
+        assert m.ddm == 0.0, m
         assert m.dnh == 0, m
-        assert abs(m.dsnr_rel) < 0.01, m
+        assert abs(m.dsnr_rel) < 1e-3, m
+        # tutorial-scale accel trials are exact ties (resample shift
+        # under half a sample): any crowned member is value-identical
+        assert m.golden_acc + m.dacc in (-5.0, 0.0, 5.0), m
+        n_acc_exact += m.dacc == 0.0
+    assert n_acc_exact >= 5, [m.dacc for m in rep.matches]
     assert sorted(m.our_rank for m in rep.matches) == list(range(10)), [
         m.our_rank for m in rep.matches
     ]
