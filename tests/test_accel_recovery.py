@@ -1,0 +1,110 @@
+"""End-to-end recovery of an INJECTED accelerated pulsar (VERDICT r2
+item 4): the only test that proves the resample chain recovers a known
+nonzero acceleration, not merely that it is bitwise-equal to its oracle.
+
+The injected signal is built to be exactly periodic AFTER resampling at
+the injected acceleration factor: resample_kernelII reads
+``out[i] = in[i + af*i*(i-N)]`` (kernels.cu:314-346), so the pulse
+phase in the raw series follows the inverse map
+``g^-1(j) ~ j - af*j*(j-N)`` (the quadratic's second-order term is
+~1e-3 samples at this scale).  Each channel is delayed by the dedisp
+whole-sample delay at the injected DM.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from peasoup_tpu.io.sigproc import (
+    Filterbank,
+    SigprocHeader,
+    read_filterbank,
+    write_filterbank,
+)
+from peasoup_tpu.ops.resample import accel_factor
+from peasoup_tpu.pipeline.search import PeasoupSearch, SearchConfig
+from peasoup_tpu.plan.accel_plan import AccelerationPlan
+from peasoup_tpu.plan.dm_plan import DMPlan
+
+NCHANS, TSAMP = 16, 0.004
+FCH1, FOFF = 1500.0, -20.0
+SIZE = 1 << 18
+P_INJ, DM_INJ, ACC_INJ = 0.05003, 60.0, 12.0
+
+
+@pytest.fixture(scope="module")
+def acc_fil(tmp_path_factory):
+    rng = np.random.default_rng(11)
+    plan = DMPlan.create(SIZE + 64, NCHANS, TSAMP, FCH1, FOFF, 0.0, 100.0)
+    nsamps = SIZE + plan.max_delay
+    af = float(accel_factor(np.array([ACC_INJ]), TSAMP)[0])
+
+    j = np.arange(nsamps, dtype=np.float64)
+    ginv = j - af * j * (j - SIZE)
+    pulse = (((ginv * TSAMP / P_INJ) % 1.0) < 0.08) * 12.0
+
+    delays = np.rint(
+        (np.float32(DM_INJ) * np.abs(plan.delays)).astype(np.float32)
+    ).astype(int)
+    data = rng.normal(100, 8, size=(nsamps, NCHANS))
+    for c in range(NCHANS):
+        src = np.clip(j - delays[c], 0, nsamps - 1).astype(int)
+        data[:, c] += pulse[src]
+    hdr = SigprocHeader(
+        source_name="acc_pulsar", data_type=1, nchans=NCHANS, nbits=8,
+        nifs=1, tsamp=TSAMP, tstart=50000.0, fch1=FCH1, foff=FOFF,
+    )
+    path = str(tmp_path_factory.mktemp("accfil") / "acc_pulsar.fil")
+    write_filterbank(
+        path,
+        Filterbank(header=hdr, data=np.clip(data, 0, 255).astype(np.uint8)),
+    )
+    return path
+
+
+def _config(**kw):
+    base = dict(
+        dm_end=100.0, acc_start=-30.0, acc_end=30.0, acc_pulse_width=834.0,
+        nharmonics=2, npdmp=1, limit=50,
+    )
+    base.update(kw)
+    return SearchConfig(**base)
+
+
+def _assert_recovered(top):
+    assert abs(1.0 / top.freq - P_INJ) / P_INJ < 1e-4, 1.0 / top.freq
+    assert abs(top.dm - DM_INJ) < 10.0, top.dm
+    plan = AccelerationPlan(
+        acc_lo=-30.0, acc_hi=30.0, tol=1.10, pulse_width=834.0,
+        nsamps=SIZE, tsamp=TSAMP,
+        cfreq=FCH1 + (NCHANS / 2) * FOFF, bw=FOFF,
+    )
+    step = plan.step(top.dm)
+    assert abs(top.acc - ACC_INJ) <= 1.5 * step, (top.acc, step)
+    assert top.acc != 0.0  # the whole point: a nonzero trial won
+    assert top.snr > 50.0, top.snr
+    assert top.folded_snr > 15.0, top.folded_snr
+
+
+def test_recovers_injected_acceleration(acc_fil):
+    res = PeasoupSearch(_config()).run(read_filterbank(acc_fil))
+    assert res.candidates
+    _assert_recovered(res.candidates[0])
+
+
+def test_recovers_injected_acceleration_sharded(acc_fil):
+    """Same recovery through the mesh-sharded driver, bitwise-equal to
+    the single-device result."""
+    if len(jax.devices()) < 8:
+        pytest.skip("need 8 devices")
+    fil = read_filterbank(acc_fil)
+    single = PeasoupSearch(_config(npdmp=0)).run(fil)
+    sharded = PeasoupSearch(_config(npdmp=0, shard_devices=8)).run(fil)
+    assert len(single.candidates) == len(sharded.candidates) > 0
+    for a, b in zip(single.candidates, sharded.candidates):
+        assert a.freq == b.freq and a.snr == b.snr
+        assert a.dm == b.dm and a.acc == b.acc and a.nh == b.nh
+    top = sharded.candidates[0]
+    assert abs(1.0 / top.freq - P_INJ) / P_INJ < 1e-4
+    assert top.acc != 0.0
