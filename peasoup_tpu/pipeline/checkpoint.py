@@ -3,14 +3,22 @@
 The reference has NO checkpointing — a crash mid-sweep loses everything
 (SURVEY.md §5: errors are thrown and crash the process,
 include/utils/exceptions.hpp). This module is the TPU framework's
-addition: after each device block the driver persists the static-size
-peak sets already searched, keyed by DM-trial index, so a long sweep
-resumes where it stopped. The checkpoint is invalidated by a config
-key derived from every search-affecting parameter.
+addition: after each device wave the driver persists the per-trial peak
+sets already searched, keyed by GLOBAL DM-trial index, so a long sweep
+resumes where it stopped. The checkpoint is invalidated by a config key
+derived from every search-affecting parameter.
+
+Multi-host layout: every process writes its own store file (base path +
+a ``.dmLO-HI`` slice suffix — no write contention on shared
+filesystems), but entries are GLOBAL-dm_idx-keyed and ``load()`` unions
+ALL store files sharing the base path. Resuming with a DIFFERENT
+process count therefore reuses every completed trial: each process
+simply filters the union to its own slice.
 """
 
 from __future__ import annotations
 
+import glob
 import os
 import tempfile
 
@@ -18,21 +26,40 @@ import numpy as np
 
 
 class SearchCheckpoint:
-    """Atomic .npz store of {dm_idx: (idxs, snrs, counts)}."""
+    """Atomic .npz store(s) of {global dm_idx: (idxs, snrs, counts)}.
 
-    def __init__(self, path: str, config_key: str) -> None:
-        self.path = path
+    ``base_path`` identifies the search; ``slice_bounds=(lo, hi)`` (the
+    process's global DM slice) routes writes to a per-slice file and
+    filters loads to [lo, hi). Entries are stored and returned with
+    LOCAL keys (global - lo) so the driver's slice-local bookkeeping
+    is unchanged.
+    """
+
+    def __init__(
+        self,
+        base_path: str,
+        config_key: str,
+        slice_bounds: tuple[int, int] | None = None,
+    ) -> None:
+        self.base_path = base_path
         self.config_key = config_key
+        self.lo, self.hi = slice_bounds if slice_bounds else (0, None)
+        self.write_path = (
+            f"{base_path}.dm{self.lo}-{self.hi}" if slice_bounds else base_path
+        )
 
     @staticmethod
-    def make_key(cfg, fil, size: int, ndm: int) -> str:
+    def make_key(cfg, fil, size: int, global_ndm: int) -> str:
         """Config key over everything that changes per-trial results,
         including the observation's identity (header), so a checkpoint
-        from one beam/file never resumes a search of another."""
+        from one beam/file never resumes a search of another.
+        ``global_ndm`` must be the FULL trial-list length (not a
+        process slice's) so stores written under any process count
+        share one key."""
         h = fil.header
         fields = (
-            "v3-ragged",  # per-trial payload format version
-            fil.nsamps, fil.nchans, size, ndm,
+            "v4-global-dm",  # per-trial payload format version
+            fil.nsamps, fil.nchans, size, global_ndm,
             fil.tsamp, fil.fch1, fil.foff,
             getattr(h, "tstart", None), getattr(h, "source_name", None),
             getattr(h, "nbits", None),
@@ -44,43 +71,62 @@ class SearchCheckpoint:
         )
         return repr(fields)
 
+    def _store_files(self) -> list[str]:
+        """The base file plus every per-slice sibling, existing ones."""
+        paths = []
+        if os.path.exists(self.base_path):
+            paths.append(self.base_path)
+        paths.extend(sorted(glob.glob(glob.escape(self.base_path) + ".dm*")))
+        return paths
+
     def load(self) -> dict[int, tuple[np.ndarray, np.ndarray, np.ndarray]]:
-        """Restore completed trials; {} if absent or config changed."""
-        if not self.path or not os.path.exists(self.path):
+        """Union of all store files, filtered to this process's slice,
+        returned with LOCAL keys; {} if absent or config changed."""
+        if not self.base_path:
             return {}
-        try:
-            with np.load(self.path, allow_pickle=False) as z:
-                if str(z["config_key"]) != self.config_key:
-                    return {}
-                dm_idxs = z["dm_idxs"]
-                return {
-                    int(d): (z[f"idxs_{d}"], z[f"snrs_{d}"], z[f"counts_{d}"])
-                    for d in dm_idxs
-                }
-        except (OSError, KeyError, ValueError):
-            return {}  # corrupt/partial file: start over, never crash
+        out: dict[int, tuple] = {}
+        for path in self._store_files():
+            try:
+                with np.load(path, allow_pickle=False) as z:
+                    if str(z["config_key"]) != self.config_key:
+                        continue
+                    for d in z["dm_idxs"]:
+                        g = int(d)
+                        if g < self.lo or (self.hi is not None and g >= self.hi):
+                            continue
+                        out[g - self.lo] = (
+                            z[f"idxs_{g}"], z[f"snrs_{g}"], z[f"counts_{g}"]
+                        )
+            except (OSError, KeyError, ValueError):
+                continue  # corrupt/partial file: skip it, never crash
+        return out
 
     def save(
         self, results: dict[int, tuple[np.ndarray, np.ndarray, np.ndarray]]
     ) -> None:
-        """Write-all + atomic rename (safe against mid-write crashes)."""
-        if not self.path:
+        """Write-all + atomic rename (safe against mid-write crashes).
+        ``results`` carries the driver's LOCAL keys; entries are stored
+        under their GLOBAL index."""
+        if not self.base_path:
             return
         arrays: dict[str, np.ndarray] = {
             "config_key": np.asarray(self.config_key),
-            "dm_idxs": np.asarray(sorted(results), dtype=np.int64),
+            "dm_idxs": np.asarray(
+                sorted(k + self.lo for k in results), dtype=np.int64
+            ),
         }
         for d, (idxs, snrs, counts) in results.items():
-            arrays[f"idxs_{d}"] = idxs
-            arrays[f"snrs_{d}"] = snrs
-            arrays[f"counts_{d}"] = counts
-        dirname = os.path.dirname(os.path.abspath(self.path)) or "."
+            g = d + self.lo
+            arrays[f"idxs_{g}"] = idxs
+            arrays[f"snrs_{g}"] = snrs
+            arrays[f"counts_{g}"] = counts
+        dirname = os.path.dirname(os.path.abspath(self.write_path)) or "."
         os.makedirs(dirname, exist_ok=True)
         fd, tmp = tempfile.mkstemp(dir=dirname, suffix=".ckpt.tmp")
         try:
             with os.fdopen(fd, "wb") as f:
                 np.savez(f, **arrays)
-            os.replace(tmp, self.path)
+            os.replace(tmp, self.write_path)
         except BaseException:
             if os.path.exists(tmp):
                 os.unlink(tmp)

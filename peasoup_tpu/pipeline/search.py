@@ -300,6 +300,7 @@ class PeasoupSearch:
 
         # --- dedispersion plan + execution ---------------------------------
         dm_plan = self.build_dm_plan(fil)
+        global_ndm = dm_plan.ndm
         dm_lo = 0
         if dm_slice is not None:
             dm_lo, dm_hi = dm_slice
@@ -536,19 +537,16 @@ class PeasoupSearch:
         ckpt = None
         per_dm_results: dict[int, tuple] = {}
         if cfg.checkpoint_file:
-            ckpt_file = cfg.checkpoint_file
-            if dm_slice is not None:
-                # one store per process slice: slices search disjoint
-                # trials and must not clobber each other's results.
-                # LIMITATION (documented, ADVICE r1): the suffix embeds
-                # the slice bounds, so resuming a multi-host search with
-                # a DIFFERENT process count gets fresh stores and
-                # re-searches from scratch — resume with the same
-                # process count to reuse prior progress
-                ckpt_file = f"{ckpt_file}.dm{dm_lo}-{dm_hi}"
+            # one GLOBAL-dm_idx-keyed store; multi-host slices write
+            # per-slice sibling files (no write contention) and load()
+            # unions every sibling, so a checkpoint written under one
+            # process count resumes under ANY other with zero
+            # re-searched trials (the r1/r2 process-count limitation is
+            # gone — tests/test_pipeline.py::test_checkpoint_process_count_independent)
             ckpt = SearchCheckpoint(
-                ckpt_file,
-                SearchCheckpoint.make_key(cfg, fil, size, dm_plan.ndm),
+                cfg.checkpoint_file,
+                SearchCheckpoint.make_key(cfg, fil, size, global_ndm),
+                slice_bounds=dm_slice,
             )
             per_dm_results = ckpt.load()
             if cfg.verbose and per_dm_results:
