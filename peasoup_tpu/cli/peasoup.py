@@ -71,6 +71,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="Checkpoint file for resumable searches (TPU extension; "
         "the reference has no checkpointing)",
     )
+    p.add_argument(
+        "--hbm_bytes", type=int, default=0,
+        help="device memory budget in bytes (0 = ask the device; set "
+        "on chips that report no limit — also PEASOUP_HBM_BYTES)",
+    )
     return p
 
 
@@ -147,6 +152,7 @@ def main(argv: list[str] | None = None) -> int:
         verbose=args.verbose,
         progress_bar=args.progress_bar,
         checkpoint_file=args.checkpoint,
+        hbm_bytes=args.hbm_bytes,
         subbands=args.subbands,
         subband_smear=args.subband_smear,
     )

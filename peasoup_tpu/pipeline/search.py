@@ -11,6 +11,7 @@ distilling, scoring) is host work on tiny arrays, as in the reference.
 from __future__ import annotations
 
 import math
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -84,6 +85,10 @@ class SearchConfig:
     subband_smear: float = 1.0  # max extra smear (samples) a trial may
     # suffer from sharing its group's nominal DM (0 = exact)
     accel_bucket: int = 16  # accel batch padded to a multiple of this
+    hbm_bytes: int = 0  # device memory budget override; 0 = ask the
+    # device (memory_stats), falling back to the 12 GB v5e-ish default
+    # — set this on chips that report no limit (or via the
+    # PEASOUP_HBM_BYTES env var / --hbm_bytes CLI flag)
     dm_block: int = 0  # DM trials per device call; 0 = auto from HBM budget
     checkpoint_file: str = ""  # resumable per-DM-trial result store
     use_pallas: bool = True  # Pallas resample kernel on TPU backends
@@ -159,8 +164,22 @@ def _level_windows(
 
 
 def _is_oom(exc: Exception) -> bool:
-    """Device out-of-memory signature (XLA compile- or run-time)."""
+    """Device out-of-memory signature (XLA compile- or run-time).
+
+    jaxlib exposes no status-code attribute on its runtime error, so
+    the typed contract available is: a JaxRuntimeError whose ABSL
+    status message LEADS with the canonical code RESOURCE_EXHAUSTED
+    (absl::Status string formatting — stabler than substring-anywhere).
+    Host allocation failure (MemoryError) joins it; the substring
+    heuristics remain only as a fallback for wrapped/re-raised text.
+    """
+    if isinstance(exc, MemoryError):
+        return True
     msg = str(exc)
+    if isinstance(exc, jax.errors.JaxRuntimeError) and msg.lstrip().startswith(
+        "RESOURCE_EXHAUSTED"
+    ):
+        return True
     return "RESOURCE_EXHAUSTED" in msg or (
         "memory" in msg.lower() and "hbm" in msg.lower()
     )
@@ -236,10 +255,14 @@ class PeasoupSearch:
         # is absent on some backends, e.g. the CPU mesh in tests)
 
         devs = jax.local_devices()
-        try:
-            limit = (devs[0].memory_stats() or {}).get("bytes_limit", 0)
-        except Exception:
-            limit = 0
+        limit = config.hbm_bytes or int(
+            os.environ.get("PEASOUP_HBM_BYTES", 0) or 0
+        )
+        if not limit:
+            try:
+                limit = (devs[0].memory_stats() or {}).get("bytes_limit", 0)
+            except Exception:
+                limit = 0
         if limit:
             self.TOTAL_HBM = int(limit)
             self.MEM_BUDGET = int(limit) // 2
