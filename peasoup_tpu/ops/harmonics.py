@@ -171,10 +171,10 @@ def _fused_level_sums(p: jnp.ndarray, nharms: int) -> jnp.ndarray:
     return out.reshape(*p.shape[:-1], H, nbins_pad)
 
 
-@partial(jax.jit, static_argnames=("nharms", "method", "scaled"))
+@partial(jax.jit, static_argnames=("nharms", "method", "scaled", "block_align"))
 def harmonic_sums(
     p: jnp.ndarray, *, nharms: int = 4, method: str = "conv",
-    scaled: bool = True,
+    scaled: bool = True, block_align: int = 0,
 ) -> list[jnp.ndarray]:
     """Cumulative fractional-harmonic sums of a spectrum.
 
@@ -189,9 +189,15 @@ def harmonic_sums(
       scaled: apply the reference's rsqrt(2^h) per-level factor here.
         False skips it (one full HBM pass per level) for consumers that
         scale downstream, e.g. the Pallas peaks kernel scaling in VMEM.
+      block_align: conv method only — when > 0, levels come back PADDED
+        to a multiple of this (garbage past ``nbins``: the pad region's
+        gathers read real low bins) so a downstream blocked consumer
+        (the Pallas peaks kernel) needs no per-level pad pass; bins
+        below ``nbins`` are bitwise identical to the unpadded result.
 
-    Returns a list of ``nharms`` arrays shaped like ``p``; entry h-1 is
-    the 2^h-harmonic sum, scaled by rsqrt(2^h) unless ``scaled=False``.
+    Returns a list of ``nharms`` arrays shaped like ``p`` (last axis
+    padded when ``block_align``); entry h-1 is the 2^h-harmonic sum,
+    scaled by rsqrt(2^h) unless ``scaled=False``.
     """
     if not 0 < nharms <= 5:
         raise ValueError("nharms must be in 1..5")
@@ -202,17 +208,29 @@ def harmonic_sums(
 
     if method == "conv":
         P = _CONV_P
-        npad = -(-nbins // P) * P
+        align = max(P, block_align)
+        npad = -(-nbins // align) * align
         Q = npad // P
         # src indices for i < nbins stay < nbins, so zero pad is inert
+        # for the real bins (pad-region outputs gather real low bins —
+        # garbage the caller masks or slices away)
         pp = jnp.pad(p, [(0, 0)] * (p.ndim - 1) + [(0, npad + 1 - nbins)])
         x = pp.reshape(-1, pp.shape[-1], 1)
-        out, val = [], p
+        # accumulate IN THE CONV OUTPUT BLOCK SPACE (rows, Q, P): every
+        # (h, k) conv emits the same (q, lane) -> bin q*P+lane order, so
+        # the val chain needs no per-gather reshape/slice — XLA fuses
+        # each add into its conv — and only the nharms level outputs pay
+        # a (free, contiguous) flatten.  Add ORDER per element is
+        # unchanged, so results stay bitwise identical to "take".
+        val = pp[..., :npad].reshape(-1, Q, P)
+        out = []
         for h in range(1, nharms + 1):
             for k in range(1, 1 << h, 2):  # odd: new gathers this level
-                g = _gather_conv(x, Q, k, h)
-                val = val + g.reshape(*p.shape[:-1], Q * P)[..., :nbins]
-            out.append(lvl_out(val, h))
+                val = val + _gather_conv(x, Q, k, h)
+            flat = val.reshape(*p.shape[:-1], npad)
+            if not block_align:
+                flat = flat[..., :nbins]
+            out.append(lvl_out(flat, h))
         return out
     if method == "take":
         i = jnp.arange(nbins, dtype=jnp.int32)

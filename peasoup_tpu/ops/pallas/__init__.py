@@ -93,7 +93,17 @@ def probe_pallas_peaks(nbins: int, nlev: int, max_peaks: int) -> bool:
         windows = np.tile(
             np.asarray([[lo, hi]], np.int32), (nlev, 1)
         )
-        sp = jnp.asarray(s)
+        # probe the PRODUCTION input configuration: levels arrive
+        # block-aligned with a GARBAGE tail past the true nbins
+        # (harmonic_sums block_align) plus the explicit nbins override —
+        # the pad region carries huge values so a masking/sentinel
+        # regression in the kernel fails the probe, not production
+        from .peaks import PEAKS_BLOCK
+
+        npad = -(-nbins // PEAKS_BLOCK) * PEAKS_BLOCK
+        sp = jnp.asarray(
+            np.pad(s, ((0, 0), (0, npad - nbins)), constant_values=1e9)
+        )
         # probe the MULTI-level kernel (the production path): every
         # level gets a scaled view of the same data, in-kernel scales
         # matching the jnp oracle's pre-scaled inputs bitwise
@@ -103,7 +113,9 @@ def probe_pallas_peaks(nbins: int, nlev: int, max_peaks: int) -> bool:
         ci, cs, rc, cc = find_cluster_peaks_multi(
             [sp] * nlev, jnp.asarray(windows),
             threshold=9.0, max_peaks=max_peaks, scales=scales,
+            nbins=nbins,
         )
+        sp = sp[:, :nbins]  # the jnp oracle below sees the true bins
         ci, cs, rc, cc = map(np.asarray, (ci, cs, rc, cc))
         ok = True
         for lv in range(nlev):

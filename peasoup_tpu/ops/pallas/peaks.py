@@ -48,9 +48,21 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_BLOCK = 4096  # bins per grid step (128-lane multiple); 4096 measured
-# best on v5e (fewer grid steps beats the larger per-step vector work:
-# 34 -> 29 ms per level-call at production shapes; 8192 regresses)
+import os as _os
+
+PEAKS_BLOCK = int(_os.environ.get("PEASOUP_PEAKS_BLOCK", "4096"))
+# bins per grid step (128-lane multiple); 4096 measured best on v5e
+# (fewer grid steps beats the larger per-step vector work; r3 scan:
+# 512/1024/8704/17408 give 112/94/99/135 ms vs 87 ms for 2048-4096 at
+# production shapes).  Overridable for tuning via PEASOUP_PEAKS_BLOCK
+# (read once at import); harmonic_sums(block_align=PEAKS_BLOCK) keeps
+# its level padding in lockstep with this value.
+if PEAKS_BLOCK <= 0 or PEAKS_BLOCK % 128:
+    raise ValueError(
+        f"PEASOUP_PEAKS_BLOCK must be a positive multiple of 128, got "
+        f"{PEAKS_BLOCK}"
+    )
+_BLOCK = PEAKS_BLOCK
 _SUB = 8  # rows per stripe (f32 sublane quantum)
 _BIG = 1 << 30  # "no crossing" sentinel for the masked min reduction
 
@@ -194,23 +206,34 @@ def find_cluster_peaks_multi(
     scales: tuple,  # per-level in-VMEM factors (1.0 for pre-scaled)
     min_gap: int = 30,
     interpret: bool = False,
+    nbins: int | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One-dispatch equivalent of nlev find_cluster_peaks_pallas calls.
     Returns (idxs (..., nlev, max_peaks), snrs, raw counts (..., nlev),
-    cluster counts (..., nlev))."""
+    cluster counts (..., nlev)).  ``nbins`` is the TRUE bin count (the
+    idx pad sentinel) when the level arrays arrive pre-padded past it
+    (harmonic_sums block_align) — the pad region must be masked by the
+    windows' hi bounds."""
     nlev = len(levels)
-    nbins = levels[0].shape[-1]
+    nbins_in = levels[0].shape[-1]
+    nbins = nbins if nbins is not None else nbins_in
+    # the pad region past the true nbins is GARBAGE (harmonic sums
+    # gather real low bins there), so no window may reach into it —
+    # clamp rather than trust every caller's window construction
+    windows = jnp.stack(
+        [windows[:, 0], jnp.minimum(windows[:, 1], nbins)], axis=1
+    )
     batch = levels[0].shape[:-1]
     rows = 1
     for d in batch:
         rows *= d
-    npad = -(-nbins // _BLOCK) * _BLOCK
+    npad = -(-nbins_in // _BLOCK) * _BLOCK
     rpad = -(-rows // _SUB) * _SUB
     flats = []
     for s in levels:
-        flat = s.reshape(rows, nbins)
-        if npad != nbins or rpad != rows:
-            flat = jnp.pad(flat, ((0, rpad - rows), (0, npad - nbins)))
+        flat = s.reshape(rows, nbins_in)
+        if npad != nbins_in or rpad != rows:
+            flat = jnp.pad(flat, ((0, rpad - rows), (0, npad - nbins_in)))
         flats.append(flat)
     fn = _build_multi(
         rpad, npad, nlev, max_peaks, nbins, float(threshold), min_gap,

@@ -91,12 +91,26 @@ def _spectra_and_peaks(
         s = form_interpolated(fr)
         s = normalise(s, mean, std)
     # the fused kernel applies the per-level rsqrt(2^h) factor in VMEM
-    # (one fewer full HBM pass per level); the jnp path scales here
+    # (one fewer full HBM pass per level); the jnp path scales here.
+    # For the kernel path the levels also come back pre-padded to the
+    # kernel's block size (block_align) so no per-level pad pass is
+    # spent — the pad region is garbage the kernel's windows mask.
     kernel_scales = pallas_peaks and cluster
-    with jax.named_scope("Harmonic summing"):
-        sums = harmonic_sums(s, nharms=nharms, scaled=not kernel_scales)
-    levels = [s] + sums
     nbins = s.shape[-1]
+    with jax.named_scope("Harmonic summing"):
+        if kernel_scales:
+            from ..ops.pallas.peaks import PEAKS_BLOCK
+
+            sums = harmonic_sums(
+                s, nharms=nharms, scaled=False, block_align=PEAKS_BLOCK
+            )
+            npad = sums[0].shape[-1]
+            s = jnp.pad(
+                s, [(0, 0)] * (s.ndim - 1) + [(0, npad - nbins)]
+            )
+        else:
+            sums = harmonic_sums(s, nharms=nharms, scaled=True)
+    levels = [s] + sums
 
     if pallas_peaks and cluster:
         # ONE kernel dispatch walks every level's threshold+cluster
@@ -108,7 +122,7 @@ def _spectra_and_peaks(
         )
         i_, s_, c_, cc_ = find_cluster_peaks_multi(
             levels, windows, threshold=threshold, max_peaks=max_peaks,
-            scales=scales,
+            scales=scales, nbins=nbins,
         )
         # kernel emits (..., nlev, ...); the NamedTuple wants the level
         # axis at stack_axis

@@ -211,6 +211,21 @@ class TestHarmonics:
             # f32 accumulation vs f64 oracle
             np.testing.assert_allclose(np.asarray(out), oracle, rtol=1e-4, atol=1e-5)
 
+    def test_block_align_bitwise_below_nbins(self, rng):
+        """block_align levels are padded past nbins (garbage tail) but
+        BITWISE identical to the unpadded result below it, unscaled and
+        scaled alike."""
+        p = rng.normal(size=(3, 1025)).astype(np.float32)
+        plain = harmonic_sums(jnp.asarray(p), nharms=4, scaled=False)
+        padded = harmonic_sums(
+            jnp.asarray(p), nharms=4, scaled=False, block_align=4096
+        )
+        assert padded[0].shape[-1] == 4096
+        for a, b in zip(plain, padded):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)[..., :1025]
+            )
+
     def test_impulse_train_gains(self):
         # fundamental at bin 512 with harmonics at 256, 128, ...: the
         # harmonic sum at the fundamental grows as expected

@@ -297,6 +297,44 @@ class TestPallasPeaks:
         )
 
 
+class TestPeaksPaddedLevels:
+    def test_padded_garbage_tail_masked(self):
+        """The production input configuration: block-aligned levels with
+        a garbage tail past the true nbins plus the explicit nbins
+        override — the kernel must mask the tail (window clamp) and pad
+        idx slots with the TRUE nbins sentinel."""
+        import jax.numpy as jnp
+
+        import peasoup_tpu.ops.pallas.peaks as ppk
+
+        nbins, npad, rows = 1025, 4096, 8
+        rng = np.random.default_rng(5)
+        s = np.abs(rng.normal(size=(rows, nbins))).astype(np.float32)
+        s[:, 100] = 30.0
+        sp = jnp.asarray(
+            np.pad(s, ((0, 0), (0, npad - nbins)), constant_values=1e9)
+        )
+        # window hi deliberately set PAST nbins: the clamp must cap it
+        windows = jnp.asarray(np.asarray([[10, npad]], np.int32))
+        orig = ppk._build_multi.__wrapped__
+        ppk._build_multi.cache_clear()
+        ppk._build_multi = lambda *a: orig(*a[:-1], True)  # interpret
+        try:
+            ci, cs, rc, cc = ppk.find_cluster_peaks_multi(
+                [sp], windows, threshold=9.0, max_peaks=16,
+                scales=(1.0,), nbins=nbins,
+            )
+        finally:
+            import functools
+
+            ppk._build_multi = functools.lru_cache(maxsize=None)(orig)
+        rc, cc, ci, cs = map(np.asarray, (rc, cc, ci, cs))
+        assert (rc[:, 0] == 1).all(), rc[:, 0]  # only the planted peak
+        assert (cc[:, 0] == 1).all()
+        assert (ci[:, 0, 0] == 100).all()
+        assert (ci[:, 0, 1:] == nbins).all()  # TRUE-nbins sentinel
+
+
 class TestPallasDedisperse:
     """Interpret-mode parity of the Pallas dedispersion kernel
     (ops/pallas/dedisperse.py) against the jnp scan."""
