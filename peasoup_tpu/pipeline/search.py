@@ -381,14 +381,42 @@ class PeasoupSearch:
         )
         n_shard = len(devices) if shardable else 1
         spill = trials_bytes > self.TRIALS_DEVICE_LIMIT * n_shard
+
+        # --- resume fast path: when EVERY trial of this run restores
+        # from the checkpoint and nothing will be folded, the trial
+        # data is never read — skip dedispersion entirely (it dominates
+        # resume wall time at survey scale: tens of minutes of packed
+        # upload + scan through a high-latency link for zero work)
+        skip_dedisp = False
+        if cfg.checkpoint_file and cfg.npdmp == 0 and dm_plan.ndm > 0:
+            restored = SearchCheckpoint(
+                cfg.checkpoint_file,
+                SearchCheckpoint.make_key(
+                    cfg, fil, choose_fft_size(fil.nsamps, cfg.size),
+                    global_ndm,
+                ),
+                slice_bounds=dm_slice,
+            ).load()
+            skip_dedisp = all(d in restored for d in range(dm_plan.ndm))
+            if skip_dedisp and cfg.verbose:
+                print(
+                    "Resume fast path: all trials checkpointed and "
+                    "npdmp=0 — skipping dedispersion"
+                )
+        if skip_dedisp:
+            trials = np.zeros((0, dm_plan.out_nsamps), dtype=np.uint8)
+            spill = True  # host ndarray semantics; nothing device-resident
+            self._trials_sharded = False
         with trace_span("Dedisperse"):  # NVTX parity: pipeline_multi.cu:318
             scale = output_scale(fil.nbits, int(dm_plan.killmask.sum()))
             # sharded dedispersion wants the whole masked f32 filterbank
             # replicated per chip; bigger inputs fall back to the
             # channel-chunked single-device engines
-            shard_dd = shardable and not spill
+            shard_dd = shardable and not spill and not skip_dedisp
             self._trials_sharded = shard_dd
-            if shard_dd:
+            if skip_dedisp:
+                pass
+            elif shard_dd:
                 from ..parallel.sharded_dedisperse import dedisperse_sharded
 
                 trials = dedisperse_sharded(
