@@ -429,8 +429,12 @@ class PeasoupSearch:
                     block=cfg.dedisp_block,
                 )
             elif cfg.subbands > 0:
+                # the subband engine stages the filterbank on DEVICE
+                # regardless of trial spill (to_host only routes the
+                # OUTPUTS), so always take the packed-upload + on-device
+                # unpack path: 4x less H2D for 2-bit survey data
                 trials = dedisperse_subband(
-                    fil.data if spill else fil_to_device(fil),
+                    fil_to_device(fil),
                     dm_plan.delay_samples(),
                     dm_plan.killmask,
                     dm_plan.out_nsamps,
