@@ -23,6 +23,17 @@ def form_power(fseries: jnp.ndarray) -> jnp.ndarray:
     return jnp.abs(fseries).astype(jnp.float32)
 
 
+def form_interpolated_parts(re: jnp.ndarray, im: jnp.ndarray) -> jnp.ndarray:
+    """form_interpolated on explicit (re, im) f32 parts — lets a lazy
+    elementwise producer (the matmul rfft's untwist) fuse straight into
+    the interbin pass without materialising a complex array."""
+    re_l = jnp.concatenate([jnp.zeros_like(re[..., :1]), re[..., :-1]], axis=-1)
+    im_l = jnp.concatenate([jnp.zeros_like(im[..., :1]), im[..., :-1]], axis=-1)
+    ampsq = re * re + im * im
+    ampsq_diff = 0.5 * ((re - re_l) ** 2 + (im - im_l) ** 2)
+    return jnp.sqrt(jnp.maximum(ampsq, ampsq_diff))
+
+
 def form_interpolated(fseries: jnp.ndarray) -> jnp.ndarray:
     """Interbinned amplitude: sqrt(max(|X_k|^2, 0.5|X_k - X_{k-1}|^2)).
 
@@ -30,13 +41,10 @@ def form_interpolated(fseries: jnp.ndarray) -> jnp.ndarray:
     (kernels.cu:231-252). X_{-1} is taken as 0 like the kernel's idx==0
     branch. Operates along the last axis.
     """
-    re = jnp.real(fseries).astype(jnp.float32)
-    im = jnp.imag(fseries).astype(jnp.float32)
-    re_l = jnp.concatenate([jnp.zeros_like(re[..., :1]), re[..., :-1]], axis=-1)
-    im_l = jnp.concatenate([jnp.zeros_like(im[..., :1]), im[..., :-1]], axis=-1)
-    ampsq = re * re + im * im
-    ampsq_diff = 0.5 * ((re - re_l) ** 2 + (im - im_l) ** 2)
-    return jnp.sqrt(jnp.maximum(ampsq, ampsq_diff))
+    return form_interpolated_parts(
+        jnp.real(fseries).astype(jnp.float32),
+        jnp.imag(fseries).astype(jnp.float32),
+    )
 
 
 def spectrum_stats(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:

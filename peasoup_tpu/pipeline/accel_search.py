@@ -87,8 +87,15 @@ def _spectra_and_peaks(
     # program (pipeline_multi.cu:207, harmonicfolder.hpp:28): ops carry
     # the scope in their metadata, so profiler traces group them
     with jax.named_scope("Acceleration-Loop"):
-        fr = jnp.fft.rfft(xr, axis=-1)
-        s = form_interpolated(fr)
+        from ..ops.fft import _use_matmul, rfft_pow2_matmul_parts
+        from ..ops.spectrum import form_interpolated_parts
+
+        if _use_matmul(xr.shape[-1]):
+            # matmul four-step rfft as lazy (re, im) parts: the untwist
+            # fuses into the interbin pass (no complex materialisation)
+            s = form_interpolated_parts(*rfft_pow2_matmul_parts(xr))
+        else:
+            s = form_interpolated(jnp.fft.rfft(xr, axis=-1))
         s = normalise(s, mean, std)
     # the fused kernel applies the per-level rsqrt(2^h) factor in VMEM
     # (one fewer full HBM pass per level); the jnp path scales here.

@@ -683,3 +683,33 @@ class TestCompactPeaks:
                     di.reshape(-1, di.shape[-1])[k, : ccl[k]],
                     idxs.reshape(-1, mp)[k, : ccl[k]],
                 )
+
+
+class TestMatmulRFFT:
+    """The packed-real four-step matmul rfft (ops/fft.py) — the TPU
+    hot-path FFT — against numpy's f64 rfft."""
+
+    @pytest.mark.parametrize("n", [1 << 14, 1 << 15, 1 << 17])
+    def test_matches_numpy(self, rng, n):
+        from peasoup_tpu.ops.fft import rfft_pow2_matmul
+
+        # zero-mean like the whitened series the pipeline transforms (a
+        # large DC term would dominate the error scale: absolute DFT
+        # error grows with ||x||, and the CPU backend's einsum runs
+        # plain f32 regardless of the precision request)
+        x = rng.normal(0.0, 10.0, size=(3, n)).astype(np.float32)
+        out = np.asarray(jax.jit(rfft_pow2_matmul)(jnp.asarray(x)))
+        ref = np.fft.rfft(x.astype(np.float64), axis=-1)
+        scale = np.sqrt(np.mean(np.abs(ref) ** 2))
+        assert np.max(np.abs(out - ref)) / scale < 1e-5
+        assert out.shape == (3, n // 2 + 1)
+
+    def test_router_fallback_matches_stock(self, rng):
+        """Non-pow2 or small sizes (and the CPU test backend) route to
+        jnp.fft.rfft bitwise."""
+        from peasoup_tpu.ops.fft import rfft
+
+        x = jnp.asarray(rng.normal(size=(2, 1000)).astype(np.float32))
+        np.testing.assert_array_equal(
+            np.asarray(rfft(x)), np.asarray(jnp.fft.rfft(x))
+        )
