@@ -85,6 +85,9 @@ class SearchConfig:
     subband_smear: float = 1.0  # max extra smear (samples) a trial may
     # suffer from sharing its group's nominal DM (0 = exact)
     accel_bucket: int = 16  # accel batch padded to a multiple of this
+    dedupe_accel: bool = True  # collapse accel trials whose resample is
+    # provably the identity into one dispatched representative
+    # (bitwise-identical output, device work / identity-class size)
     hbm_bytes: int = 0  # device memory budget override; 0 = ask the
     # device (memory_stats), falling back to the 12 GB v5e-ish default
     # — set this on chips that report no limit (or via the
@@ -107,7 +110,9 @@ class SearchResult:
     timers: dict
     nsamps: int
     size: int
-    n_accel_trials: int = 0  # total DM x accel trials actually searched
+    n_accel_trials: int = 0  # effective (brute-force-equivalent) DM x
+    # accel trials: identity-deduped trials count — their results are
+    # produced bitwise — but fewer resamplings may have been dispatched
 
 
 @dataclass
@@ -207,6 +212,92 @@ def _densify_ragged(
         snrs.reshape(*cc.shape, mx),
         cc,
     )
+
+
+def _accel_pad(n: int, bucket: int) -> int:
+    """Padded accel-column count for a dispatch list of length n: the
+    usual bucket multiple, with one extra small shape (4) so searches
+    whose accel lists collapse to a few distinct trials (the golden
+    [0,-5,+5] list, or identity-deduped grids) don't pad 1-3 columns
+    of real work to a 16-wide tile."""
+    if n <= 4:
+        return 4
+    return int(math.ceil(n / bucket) * bucket)
+
+
+def _dedupe_identity_accels(
+    accel_lists, tsamp: float, size: int
+) -> tuple[list, list]:
+    """Collapse accel trials whose resample is provably the IDENTITY
+    into one representative per DM.
+
+    resample reads src = i + rn(af * i*(i-size)) with the product in
+    f32 (ops/resample.py). |i*(i-size)| <= size^2/4, so when
+    |af| * size^2/4 < 0.5 every rounded shift is 0 (a real product
+    below 0.5 rounds to at most 0.5, and rn(0.5) = 0 under
+    round-half-even) — the resampled series is BITWISE the input, and
+    every such trial's spectrum, peaks, and candidates are bitwise
+    identical. Searching one representative and replicating its results
+    host-side (_expand_accel_results) is output-identical to brute
+    force while cutting device work by the identity-class size — e.g.
+    the whole +-5 m/s^2 tutorial grid is one class at 2^17 samples.
+
+    Returns (dispatch_lists, expand_maps): expand_maps[dm] is None when
+    nothing deduped, else an int array mapping each FULL accel index to
+    its dispatch-list index.
+    """
+    q_max = (size // 2) ** 2
+    dispatch_lists: list = []
+    expand_maps: list = []
+    for accs in accel_lists:
+        afs = accel_factor(np.asarray(accs), tsamp)
+        ident = np.abs(afs) * q_max < 0.4999999  # margin for f32 edges
+        if ident.sum() <= 1:
+            dispatch_lists.append(accs)
+            expand_maps.append(None)
+            continue
+        rep = int(np.nonzero(ident)[0][0])
+        keep = [i for i in range(len(accs)) if i == rep or not ident[i]]
+        pos = {full_i: j for j, full_i in enumerate(keep)}
+        expand_maps.append(
+            np.asarray(
+                [pos.get(i, pos[rep]) for i in range(len(accs))],
+                dtype=np.int64,
+            )
+        )
+        dispatch_lists.append(np.asarray([accs[i] for i in keep]))
+    return dispatch_lists, expand_maps
+
+
+def _expand_accel_results(vi, vs, cc, emap, padded_full):
+    """Replicate a deduped dispatch's ragged per-(lvl, accel) results
+    onto the full accel list (identity trials share their
+    representative's spectrum bitwise). Stream cell order is C-order
+    over (nlev, padded) — lvl-major — matching the device pack.
+    Vectorised: one fancy-index gather, no per-cell Python loop."""
+    nlev, nd = cc.shape
+    flat = cc.astype(np.int64).reshape(-1)
+    ends = np.cumsum(flat)
+    starts = ends - flat
+    a_count = len(emap)
+    # output cells (lvl-major over the FULL accel list) -> source cells
+    src_cells = (
+        np.arange(nlev, dtype=np.int64)[:, None] * nd
+        + np.asarray(emap, dtype=np.int64)[None, :]
+    ).ravel()
+    src_counts = flat[src_cells]
+    cc_full = np.zeros((nlev, padded_full), dtype=cc.dtype)
+    cc_full[:, :a_count] = src_counts.reshape(nlev, a_count)
+    n_out = int(src_counts.sum())
+    # per output entry: its source index = start of its source cell +
+    # offset within the cell
+    cell_of = np.repeat(np.arange(src_cells.size), src_counts)
+    out_cell_start = np.concatenate(
+        [[0], np.cumsum(src_counts)[:-1]]
+    )
+    within = np.arange(n_out, dtype=np.int64) - out_cell_start[cell_of]
+    src = starts[src_cells][cell_of] + within
+    return vi[src], vs[src], cc_full
 
 
 def _freq_factor(size: int, nh: int, tsamp: float) -> np.float32:
@@ -514,15 +605,36 @@ class PeasoupSearch:
         accel_lists = [
             acc_plan.generate_accel_list(float(dm)) for dm in dm_plan.dm_list
         ]
+        # identity-trial dedupe: device programs run only the DISTINCT
+        # resamplings; results replicate host-side, bitwise-identical
+        # to brute force (see _dedupe_identity_accels)
+        if cfg.dedupe_accel:
+            dispatch_lists, self._accel_expand = _dedupe_identity_accels(
+                accel_lists, fil.tsamp, size
+            )
+        else:
+            dispatch_lists = accel_lists
+            self._accel_expand = [None] * len(accel_lists)
+        self._accel_full_pad = [
+            _accel_pad(len(a), cfg.accel_bucket) for a in accel_lists
+        ]
+        if cfg.verbose and any(m is not None for m in self._accel_expand):
+            n_full = sum(len(a) for a in accel_lists)
+            n_disp = sum(len(a) for a in dispatch_lists)
+            print(
+                f"accel dedupe: {n_disp}/{n_full} distinct resamplings "
+                "dispatched (identity trials share their "
+                "representative's spectrum bitwise)"
+            )
         bucket = cfg.accel_bucket
         by_bucket: dict[int, list[int]] = {}
-        for dm_idx, accs in enumerate(accel_lists):
-            padded = int(math.ceil(len(accs) / bucket) * bucket)
+        for dm_idx, accs in enumerate(dispatch_lists):
+            padded = _accel_pad(len(accs), bucket)
             by_bucket.setdefault(padded, []).append(dm_idx)
 
         af_max = max(
             (float(np.abs(accel_factor(a, fil.tsamp)).max())
-             for a in accel_lists if len(a)),
+             for a in dispatch_lists if len(a)),
             default=0.0,
         )
         # gather-free select resample whenever the shift span is small:
@@ -679,9 +791,7 @@ class PeasoupSearch:
         # checkpoint a save point per wave)
         def chunk_out_bytes(chunk):
             dm_indices, d_blk = chunk
-            padded = int(
-                math.ceil(len(accel_lists[dm_indices[0]]) / bucket) * bucket
-            )
+            padded = _accel_pad(len(dispatch_lists[dm_indices[0]]), bucket)
             # budget with the learned compaction size: later waves (and
             # repeat runs) dispatch at mp0, not cfg.max_peaks
             mp = max(cfg.max_peaks, self._learned_max_peaks)
@@ -712,7 +822,7 @@ class PeasoupSearch:
             try:
                 self._run_waves(
                     build_waves(chunks), len(chunks), per_dm_results, ckpt,
-                    progress, build_search, accel_lists,
+                    progress, build_search, dispatch_lists,
                     trials, tim_len, zapmask_dev, windows,
                     size=size, nsamps_valid=nsamps_valid, pos5=pos5,
                     pos25=pos25, tsamp=fil.tsamp,
@@ -865,7 +975,7 @@ class PeasoupSearch:
 
     def _run_waves(
         self, waves, n_chunks, per_dm_results, ckpt, progress, build_search,
-        accel_lists, trials, tim_len, zapmask_dev, windows,
+        dispatch_lists, trials, tim_len, zapmask_dev, windows,
         *, size, nsamps_valid, pos5, pos25, tsamp,
     ) -> None:
         disp = dict(
@@ -882,7 +992,7 @@ class PeasoupSearch:
                 with trace_span("DM-Loop"):  # NVTX parity: pipeline_multi.cu:144
                     try:
                         self._search_wave(
-                            todo, accel_lists, trials, tim_len, zapmask_dev,
+                            todo, dispatch_lists, trials, tim_len, zapmask_dev,
                             windows, self._active_search_block,
                             per_dm_results, **disp,
                         )
@@ -910,7 +1020,7 @@ class PeasoupSearch:
                             0, getattr(self, "_pallas_peaks", False)
                         )
                         self._search_wave(
-                            todo, accel_lists, trials, tim_len, zapmask_dev,
+                            todo, dispatch_lists, trials, tim_len, zapmask_dev,
                             windows, self._active_search_block,
                             per_dm_results, **disp,
                         )
@@ -1120,7 +1230,7 @@ class PeasoupSearch:
                 )
 
     def _dispatch_chunk(
-        self, chunk, accel_lists, trials, tim_len, zapmask_dev, windows,
+        self, chunk, dispatch_lists, trials, tim_len, zapmask_dev, windows,
         search_block, max_peaks, *, size, nsamps_valid, pos5, pos25, tsamp,
     ):
         """Asynchronously launch one (dm_block, accel_bucket) device
@@ -1130,15 +1240,14 @@ class PeasoupSearch:
         dm_indices, dm_block = chunk
         real = len(dm_indices)
         padded = max(
-            int(math.ceil(len(accel_lists[d]) / bucket) * bucket)
-            for d in dm_indices
+            _accel_pad(len(dispatch_lists[d]), bucket) for d in dm_indices
         )
         # pad the block to its fixed shape by repeating the first trial
         # (discarded): one compile per (dm_block, padded) tile shape
         block_idx = dm_indices + [dm_indices[0]] * (dm_block - real)
         afs = np.zeros((dm_block, padded), dtype=np.float32)
         for row, dm_idx in enumerate(block_idx):
-            accs = accel_lists[dm_idx]
+            accs = dispatch_lists[dm_idx]
             afs[row, : len(accs)] = accel_factor(accs, tsamp).astype(
                 np.float32
             )
@@ -1191,7 +1300,7 @@ class PeasoupSearch:
         return peaks, padded
 
     def _search_wave(
-        self, wave, accel_lists, trials, tim_len, zapmask_dev, windows,
+        self, wave, dispatch_lists, trials, tim_len, zapmask_dev, windows,
         search_block, per_dm_results, *, size, nsamps_valid, pos5, pos25,
         tsamp,
     ) -> None:
@@ -1209,7 +1318,7 @@ class PeasoupSearch:
             size=size, nsamps_valid=nsamps_valid, pos5=pos5, pos25=pos25,
             tsamp=tsamp,
         )
-        args = (accel_lists, trials, tim_len, zapmask_dev, windows,
+        args = (dispatch_lists, trials, tim_len, zapmask_dev, windows,
                 search_block)
 
 
@@ -1334,8 +1443,15 @@ class PeasoupSearch:
             for row in range(len(dm_indices)):
                 lo = int(row_ends[row - 1]) if row else 0
                 hi = int(row_ends[row])
-                per_dm_results[dm_indices[row]] = (
-                    vi[lo:hi],
-                    vs[lo:hi],
-                    cc[row],
-                )
+                dm_idx = dm_indices[row]
+                emap = self._accel_expand[dm_idx]
+                if emap is None:
+                    per_dm_results[dm_idx] = (vi[lo:hi], vs[lo:hi], cc[row])
+                else:
+                    # deduped dispatch: replicate the representative's
+                    # results onto every identity accel column (bitwise
+                    # what brute force would have produced)
+                    per_dm_results[dm_idx] = _expand_accel_results(
+                        vi[lo:hi], vs[lo:hi], cc[row], emap,
+                        self._accel_full_pad[dm_idx],
+                    )

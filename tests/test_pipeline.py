@@ -316,3 +316,44 @@ class TestScorer:
         c = Candidate(freq=1000.0, snr=20.0, dm=10000.0, dm_idx=5)
         s.score(c)
         assert c.is_physical
+
+
+class TestAccelDedupe:
+    def test_identity_dedupe_bitwise_equal(self, synthetic):
+        """Identity-trial dedupe must produce BITWISE the brute-force
+        candidate list: at this scale every |a|<=5 trial's resample
+        shift stays under half a sample, so the whole accel grid is one
+        identity class."""
+        path, _, _ = synthetic
+        fil = read_filterbank(path)
+        common = dict(
+            dm_end=40.0, acc_start=-5.0, acc_end=5.0,
+            acc_pulse_width=0.064, nharmonics=2, npdmp=0, limit=100,
+        )
+        brute = PeasoupSearch(
+            SearchConfig(dedupe_accel=False, **common)
+        ).run(fil)
+        dedup = PeasoupSearch(
+            SearchConfig(dedupe_accel=True, **common)
+        ).run(fil)
+        assert len(brute.candidates) == len(dedup.candidates) > 0
+        for a, b in zip(brute.candidates, dedup.candidates):
+            assert a.freq == b.freq and a.snr == b.snr
+            assert a.dm == b.dm and a.acc == b.acc and a.nh == b.nh
+            assert len(a.assoc) == len(b.assoc)
+
+    def test_nonidentity_trials_not_deduped(self):
+        from peasoup_tpu.pipeline.search import _dedupe_identity_accels
+
+        # afs large enough to shift: no dedupe
+        lists = [np.asarray([0.0, 1e5, 2e5], np.float32)]
+        disp, maps = _dedupe_identity_accels(lists, 0.004, 1 << 18)
+        assert maps[0] is None and len(disp[0]) == 3
+        # tiny accs all collapse onto the first
+        lists = [np.asarray([0.0, -5.0, 5.0], np.float32)]
+        disp, maps = _dedupe_identity_accels(lists, 0.00032, 1 << 17)
+        assert len(disp[0]) == 1 and list(maps[0]) == [0, 0, 0]
+        # mixed: identity trials (0, +-5) collapse, the fast one stays
+        lists = [np.asarray([0.0, -5.0, 1e6, 5.0], np.float32)]
+        disp, maps = _dedupe_identity_accels(lists, 0.00032, 1 << 17)
+        assert len(disp[0]) == 2 and list(maps[0]) == [0, 0, 1, 0]
