@@ -76,6 +76,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="device memory budget in bytes (0 = ask the device; set "
         "on chips that report no limit — also PEASOUP_HBM_BYTES)",
     )
+    p.add_argument(
+        "--no_accel_dedupe", action="store_true",
+        help="dispatch every accel trial even when its resample is "
+        "provably the identity (the dedupe is bitwise-output-equal; "
+        "this flag exists for timing comparisons)",
+    )
     return p
 
 
@@ -153,6 +159,7 @@ def main(argv: list[str] | None = None) -> int:
         progress_bar=args.progress_bar,
         checkpoint_file=args.checkpoint,
         hbm_bytes=args.hbm_bytes,
+        dedupe_accel=not args.no_accel_dedupe,
         subbands=args.subbands,
         subband_smear=args.subband_smear,
     )
