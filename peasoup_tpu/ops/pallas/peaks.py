@@ -64,6 +64,9 @@ if PEAKS_BLOCK <= 0 or PEAKS_BLOCK % 128:
     )
 _BLOCK = PEAKS_BLOCK
 _SUB = 8  # rows per stripe (f32 sublane quantum)
+# crossing-walk subblock width (lanes): full _BLOCK when it doesn't
+# divide evenly (tiny tuning blocks), else 512
+_SBW = 512 if _BLOCK % 512 == 0 else _BLOCK
 _BIG = 1 << 30  # "no crossing" sentinel for the masked min reduction
 
 
@@ -112,40 +115,69 @@ def _kernel_multi(*refs, nlev, mx, nbins, threshold, min_gap, scales):
             snr_ref[:, o0:o1] = jnp.where(hot, cpeak, snr_ref[:, o0:o1])
 
         @pl.when(jnp.max(cnt) > 0)
-        def _(mask=mask, cnt=cnt, s=s, emit=emit, c0=c0):
+        def _(mask=mask, s=s, emit=emit, c0=c0):
             mstate[:] = mask.astype(jnp.int32)
 
-            def body(it):
-                m = mstate[:] > 0
-                cursor = istate[:, c0 : c0 + 1]
-                open_ = istate[:, c0 + 2 : c0 + 3]
-                cpeakidx = istate[:, c0 + 3 : c0 + 4]
-                lastidx = istate[:, c0 + 4 : c0 + 5]
-                cpeak = fstate[:, c0 : c0 + 1]
-                idx = jnp.min(
-                    jnp.where(m, gidx, jnp.int32(_BIG)), axis=1,
-                    keepdims=True,
+            # walk the block's crossings SUBBLOCK by subblock (left to
+            # right, so the cluster machine sees the same ascending
+            # crossing sequence): the serial walk's per-trip vector work
+            # — masked min/max + mstate clear — shrinks from the full
+            # _BLOCK width to _SBW lanes. Measured honestly: the walk
+            # is TRIP-LATENCY-bound at tutorial crossing densities
+            # (~8.7 us/trip; 86.6 -> 85.1 ms), so this pays off only on
+            # dense-crossing data where vector width matters; empty
+            # subblocks cost one reduce. All slices are STATIC (python
+            # unroll), so no dynamic lane indexing reaches Mosaic.
+            # Cutting the trip COUNT (run-merging in the state machine)
+            # is the remaining lever — see NOTES.md.
+            for lo_l in range(0, _BLOCK, _SBW):
+                mask_sb = mask[:, lo_l : lo_l + _SBW]
+                gidx_sb = gidx[:, lo_l : lo_l + _SBW]
+                s_sb = s[:, lo_l : lo_l + _SBW]
+                cnt_sb = jnp.max(
+                    jnp.sum(mask_sb.astype(jnp.int32), axis=1)
                 )
-                act = idx < jnp.int32(_BIG)
-                snr = jnp.max(
-                    jnp.where(m & (gidx == idx), s, -jnp.inf),
-                    axis=1,
-                    keepdims=True,
-                )
-                close = act & (open_ == 1) & (idx - lastidx >= min_gap)
-                emit(close, cursor, cpeakidx, cpeak)
-                cursor = jnp.where(close, cursor + 1, cursor)
-                start = act & ((open_ == 0) | close)
-                take = start | (act & (snr > cpeak))
-                mstate[:] = jnp.where(gidx == idx, 0, mstate[:])
-                istate[:, c0 : c0 + 1] = cursor
-                istate[:, c0 + 2 : c0 + 3] = jnp.where(act, 1, open_)
-                istate[:, c0 + 3 : c0 + 4] = jnp.where(take, idx, cpeakidx)
-                istate[:, c0 + 4 : c0 + 5] = jnp.where(take, idx, lastidx)
-                fstate[:, c0 : c0 + 1] = jnp.where(take, snr, cpeak)
-                return it - 1
 
-            jax.lax.while_loop(lambda it: it > 0, body, jnp.max(cnt))
+                @pl.when(cnt_sb > 0)
+                def _(mask_sb=mask_sb, gidx_sb=gidx_sb, s_sb=s_sb,
+                      cnt_sb=cnt_sb, lo_l=lo_l, emit=emit, c0=c0):
+                    def body(it):
+                        m = mstate[:, lo_l : lo_l + _SBW] > 0
+                        cursor = istate[:, c0 : c0 + 1]
+                        open_ = istate[:, c0 + 2 : c0 + 3]
+                        cpeakidx = istate[:, c0 + 3 : c0 + 4]
+                        lastidx = istate[:, c0 + 4 : c0 + 5]
+                        cpeak = fstate[:, c0 : c0 + 1]
+                        idx = jnp.min(
+                            jnp.where(m, gidx_sb, jnp.int32(_BIG)),
+                            axis=1, keepdims=True,
+                        )
+                        act = idx < jnp.int32(_BIG)
+                        snr = jnp.max(
+                            jnp.where(m & (gidx_sb == idx), s_sb, -jnp.inf),
+                            axis=1,
+                            keepdims=True,
+                        )
+                        close = act & (open_ == 1) & (idx - lastidx >= min_gap)
+                        emit(close, cursor, cpeakidx, cpeak)
+                        cursor = jnp.where(close, cursor + 1, cursor)
+                        start = act & ((open_ == 0) | close)
+                        take = start | (act & (snr > cpeak))
+                        mstate[:, lo_l : lo_l + _SBW] = jnp.where(
+                            gidx_sb == idx, 0, mstate[:, lo_l : lo_l + _SBW]
+                        )
+                        istate[:, c0 : c0 + 1] = cursor
+                        istate[:, c0 + 2 : c0 + 3] = jnp.where(act, 1, open_)
+                        istate[:, c0 + 3 : c0 + 4] = jnp.where(
+                            take, idx, cpeakidx
+                        )
+                        istate[:, c0 + 4 : c0 + 5] = jnp.where(
+                            take, idx, lastidx
+                        )
+                        fstate[:, c0 : c0 + 1] = jnp.where(take, snr, cpeak)
+                        return it - 1
+
+                    jax.lax.while_loop(lambda it: it > 0, body, cnt_sb)
 
         @pl.when(b == nb - 1)
         def _(emit=emit, c0=c0, lvl=lvl):
