@@ -103,7 +103,7 @@ SURVEY_DM_END = float(os.environ.get("PEASOUP_SURVEY_DM_END", 100.0))
 def _ensure_survey_fil(path: str) -> None:
     """Synthesize the survey-scale filterbank once: SURVEY_NCHANS chans
     x SURVEY_NSAMPS samples, 2-bit, with a dispersed P=50.03 ms pulsar
-    at DM 120*? (DM 60) buried in noise."""
+    at DM 60 buried in noise."""
     if os.path.exists(path):
         return
     from peasoup_tpu.io.sigproc import (
@@ -234,11 +234,11 @@ def main() -> int:
     # production default (dedupe ON, bitwise-identical output, ~44x
     # less device work on this degenerate grid) is reported in the
     # dedupe_* fields below.
-    cfg = SearchConfig(
+    grid = dict(
         dm_end=250.0, acc_start=-5.0, acc_end=5.0, acc_pulse_width=0.064,
-        npdmp=0, limit=1000, dedupe_accel=False,
+        npdmp=0, limit=1000,
     )
-    search = PeasoupSearch(cfg)
+    search = PeasoupSearch(SearchConfig(dedupe_accel=False, **grid))
 
     # Warm-up TWICE: the first run learns the adaptive compaction /
     # fetch sizes, which changes compiled shapes — the second run
@@ -305,12 +305,7 @@ def main() -> int:
     # production default: identity-trial dedupe ON (bitwise-identical
     # candidates, only DISTINCT resamplings dispatched — this grid is
     # one identity class per DM, so ~44x less device work)
-    dsearch = PeasoupSearch(
-        SearchConfig(
-            dm_end=250.0, acc_start=-5.0, acc_end=5.0,
-            acc_pulse_width=0.064, npdmp=0, limit=1000,
-        )
-    )
+    dsearch = PeasoupSearch(SearchConfig(**grid))
     dsearch.run(fil)
     dsearch.run(fil)
     dtimes = sorted(dsearch.run(fil).timers["searching"] for _ in range(3))

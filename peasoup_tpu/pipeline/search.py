@@ -473,27 +473,37 @@ class PeasoupSearch:
         n_shard = len(devices) if shardable else 1
         spill = trials_bytes > self.TRIALS_DEVICE_LIMIT * n_shard
 
-        # --- resume fast path: when EVERY trial of this run restores
-        # from the checkpoint and nothing will be folded, the trial
-        # data is never read — skip dedispersion entirely (it dominates
-        # resume wall time at survey scale: tens of minutes of packed
-        # upload + scan through a high-latency link for zero work)
-        skip_dedisp = False
-        if cfg.checkpoint_file and cfg.npdmp == 0 and dm_plan.ndm > 0:
-            restored = SearchCheckpoint(
+        # --- checkpoint store (one construction + ONE load, shared by
+        # the resume fast path below and the wave loop later) ---------
+        ckpt = None
+        restored: dict[int, tuple] = {}
+        if cfg.checkpoint_file:
+            ckpt = SearchCheckpoint(
                 cfg.checkpoint_file,
                 SearchCheckpoint.make_key(
                     cfg, fil, choose_fft_size(fil.nsamps, cfg.size),
                     global_ndm,
                 ),
                 slice_bounds=dm_slice,
-            ).load()
-            skip_dedisp = all(d in restored for d in range(dm_plan.ndm))
-            if skip_dedisp and cfg.verbose:
-                print(
-                    "Resume fast path: all trials checkpointed and "
-                    "npdmp=0 — skipping dedispersion"
-                )
+            )
+            restored = ckpt.load()
+
+        # --- resume fast path: when EVERY trial of this run restores
+        # from the checkpoint and nothing will be folded, the trial
+        # data is never read — skip dedispersion entirely (it dominates
+        # resume wall time at survey scale: tens of minutes of packed
+        # upload + scan through a high-latency link for zero work)
+        skip_dedisp = (
+            ckpt is not None
+            and cfg.npdmp == 0
+            and dm_plan.ndm > 0
+            and all(d in restored for d in range(dm_plan.ndm))
+        )
+        if skip_dedisp and cfg.verbose:
+            print(
+                "Resume fast path: all trials checkpointed and "
+                "npdmp=0 — skipping dedispersion"
+            )
         if skip_dedisp:
             trials = np.zeros((0, dm_plan.out_nsamps), dtype=np.uint8)
             spill = True  # host ndarray semantics; nothing device-resident
@@ -701,26 +711,18 @@ class PeasoupSearch:
         self._active_search_block = search_block
         tim_len = min(size, trials.shape[1])
 
-        ckpt = None
-        per_dm_results: dict[int, tuple] = {}
-        if cfg.checkpoint_file:
-            # one GLOBAL-dm_idx-keyed store; multi-host slices write
-            # per-slice sibling files (no write contention) and load()
-            # unions every sibling, so a checkpoint written under one
-            # process count resumes under ANY other with zero
-            # re-searched trials (the r1/r2 process-count limitation is
-            # gone — tests/test_pipeline.py::test_checkpoint_process_count_independent)
-            ckpt = SearchCheckpoint(
-                cfg.checkpoint_file,
-                SearchCheckpoint.make_key(cfg, fil, size, global_ndm),
-                slice_bounds=dm_slice,
+        # the GLOBAL-dm_idx-keyed store was built (and loaded ONCE)
+        # before dedispersion; multi-host slices write per-slice sibling
+        # files (no write contention) and load() unions every sibling,
+        # so a checkpoint written under one process count resumes under
+        # ANY other with zero re-searched trials
+        # (tests/test_pipeline.py::test_checkpoint_process_count_independent)
+        per_dm_results: dict[int, tuple] = restored
+        if cfg.verbose and per_dm_results:
+            print(
+                f"Resuming: {len(per_dm_results)}/{dm_plan.ndm} DM "
+                f"trials restored from {cfg.checkpoint_file}"
             )
-            per_dm_results = ckpt.load()
-            if cfg.verbose and per_dm_results:
-                print(
-                    f"Resuming: {len(per_dm_results)}/{dm_plan.ndm} DM "
-                    f"trials restored from {cfg.checkpoint_file}"
-                )
 
         # chunk sizing: a PER-CHIP block of d_local trials, auto-sized
         # from a working-set budget of ~16 spectrum-sized f32 arrays per

@@ -239,7 +239,6 @@ def compare_trial(fil_path: str, dm: float, accs: list[float] | None = None):
     from ..ops.spectrum import form_interpolated, form_power, spectrum_stats
     from ..ops.harmonics import harmonic_sums
     from ..plan.fft_plan import choose_fft_size
-    from .recall import GOLDEN_OVERVIEW  # noqa: F401  (path sanity)
 
     fil = read_filterbank(fil_path)
     h = fil.header
