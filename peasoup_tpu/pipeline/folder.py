@@ -21,7 +21,7 @@ from ..core.candidates import Candidate
 from ..ops.fold import fold_bins_np, fold_time_series
 from ..ops.fold_optimise import FoldOptimiser
 from ..ops.rednoise import whiten_fseries
-from ..ops.resample import SPEED_OF_LIGHT, resample_accel_quadratic
+from ..ops.resample import accel_factor, resample_accel_quadratic
 from ..plan.fft_plan import prev_power_of_two
 
 
@@ -56,11 +56,18 @@ class MultiFolder:
         self.trials = trials
         self.dm_offset = dm_offset
         self.nsamps = prev_power_of_two(trials_nsamps)
-        self.tsamp = tsamp
-        self.tobs = self.nsamps * tsamp
+        # the reference folds with the f32 tsamp member
+        # (timeseries.hpp:54; double tsamp_by_period = tsamp/period in
+        # kernels.cu:641 sees the f32-rounded value) — the fold's
+        # phase-bin assignment is sensitive to this at the 1e-8 level,
+        # which flips ~0.06% of samples into adjacent bins over a 2^17
+        # series
+        self.tsamp = float(np.float32(tsamp))
+        # float tobs = nsamps*tsamp (folder.hpp:358: uint*float in f32)
+        self.tobs = float(np.float32(self.nsamps) * np.float32(tsamp))
         self.nbins = nbins
         self.nints = nints
-        bin_width = 1.0 / (self.nsamps * tsamp)
+        bin_width = 1.0 / (self.nsamps * self.tsamp)
         self.pos5 = int(pos5_freq / bin_width)
         self.pos25 = int(pos25_freq / bin_width)
         self.optimiser = FoldOptimiser(nbins, nints)
@@ -113,13 +120,12 @@ class MultiFolder:
             ids_pad = cand_ids + [cand_ids[0]] * (k_pad - k)
             # batched resample (the folder uses the quadratic v1 kernel,
             # folder.hpp:396 -> kernels.cu:308-332)
-            afs = np.array(
-                [
-                    cands[ci].acc * self.tsamp / (2.0 * SPEED_OF_LIGHT)
-                    for ci in ids_pad
-                ],
-                dtype=np.float32,
-            )
+            # (a*tsamp) is an f32 product in the reference's launcher
+            # (float a, float tsamp, kernels.cu:367) — accel_factor
+            # replays it
+            afs = accel_factor(
+                np.asarray([cands[ci].acc for ci in ids_pad]), self.tsamp
+            ).astype(np.float32)
             xr = jax.vmap(lambda af: resample_accel_quadratic(xd, af))(
                 jnp.asarray(afs)
             )  # (K_pad, N)

@@ -86,7 +86,11 @@ def test_golden_matches_are_tight(golden_run_outdir):
         assert m.golden_acc + m.dacc in (-5.0, 0.0, 5.0), m
         n_acc_exact += m.dacc == 0.0
     assert n_acc_exact >= 5, [m.dacc for m in rep.matches]
-    assert sorted(m.our_rank for m in rep.matches) == list(range(10)), [
+    # every golden candidate at its EXACT golden rank: the final order
+    # is max(snr, folded_snr) desc (folder.hpp:25-31), so this also
+    # pins fold-S/N parity at the rank-deciding level (the r3 f32-tsamp
+    # fold fix closed the last rank swap)
+    assert [m.our_rank for m in rep.matches] == list(range(10)), [
         m.our_rank for m in rep.matches
     ]
 
@@ -113,11 +117,13 @@ def test_golden_binary_parses(golden_run_outdir):
 
 def test_golden_fold_parity(golden_run_outdir):
     """Quantitative fold parity vs the golden FOLD blocks (VERDICT r2
-    item 6): shift-aligned profile correlation > 0.99, opt_period
+    item 6): shift-aligned profile correlation > 0.999, opt_period
     matching the reference's quirk formula (folder.hpp:330) to f32
-    print precision, folded_snr within 5% (measured: corr >= 0.9996,
-    |dsnr| <= 1.9% — the optimiser's argmax over 64x64x63 near-tie
-    (shift, template) cells is the residual)."""
+    print precision, folded_snr within 2% (measured after the r3
+    f32-tsamp fold fix: corr >= 0.9998, |dsnr| <= 0.25% — the fold's
+    phase-bin assignment now replays the reference's f32 tsamp, so the
+    residual is FFT ULP on the dereddened input plus the reference's
+    own nondeterministic atomicAdd ordering)."""
     from peasoup_tpu.tools.parsers import CandidateFileParser, OverviewFile
 
     def folds(ov_path, pea_path):
@@ -158,9 +164,9 @@ def test_golden_fold_parity(golden_run_outdir):
         corr = max(
             np.corrcoef(gp, np.roll(op, s))[0, 1] for s in range(64)
         )
-        assert corr > 0.99, (key, corr)
+        assert corr > 0.999, (key, corr)
         assert abs(oop - gop) / gop < 1e-6, (key, oop, gop)
-        assert abs(ofs - gfs) / max(gfs, 1.0) < 0.05, (key, ofs, gfs)
+        assert abs(ofs - gfs) / max(gfs, 1.0) < 0.02, (key, ofs, gfs)
         n_checked += 1
     assert n_checked >= 10
 
