@@ -116,3 +116,11 @@ def test_pipeline_chain_matches_oracle_membership(fil):
         ref = o["acc"][0.0]["levels"][lvl]
         assert np.array_equal(ours[lvl] > 9.0, ref > 9.0), lvl
         assert np.max(np.abs(ours[lvl] - ref)) < 5e-3, lvl
+
+
+def test_compare_trial_report(fil):
+    """The harness's own stage-by-stage report path (the CLI main):
+    every stage of the jitted chain tracks the oracle."""
+    from peasoup_tpu.tools.divergence import main
+
+    assert main(["--dm", "0.0"]) == 0
