@@ -1275,8 +1275,24 @@ class PeasoupSearch:
         else:
             # single-device trials: trial rows are sliced ON DEVICE,
             # then (with a mesh active but unsharded trials, e.g. the
-            # subband path) staged onto the mesh
-            rows = jnp.take(trials, jnp.asarray(idx), axis=0)[:, :tim_len]
+            # subband path) staged onto the mesh. Chunks are almost
+            # always CONSECUTIVE dm rows (build_chunks deals contiguous
+            # ranges; only the block-padding tail repeats row 0), so a
+            # plain slice+broadcast replaces the row gather
+            lo, hi = int(idx[0]), int(idx[real - 1]) + 1
+            if np.array_equal(idx[:real], np.arange(lo, hi)):
+                body = jax.lax.slice(trials, (lo, 0), (hi, tim_len))
+                if real < len(idx):
+                    pad = jnp.broadcast_to(
+                        body[:1], (len(idx) - real, tim_len)
+                    )
+                    rows = jnp.concatenate([body, pad], axis=0)
+                else:
+                    rows = body
+            else:
+                rows = jnp.take(trials, jnp.asarray(idx), axis=0)[
+                    :, :tim_len
+                ]
             tims_dev = (
                 jax.device_put(rows, self._dm_sharding)
                 if self._dm_sharding is not None
