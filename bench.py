@@ -213,6 +213,49 @@ def bench_survey() -> int:
     return 0
 
 
+def _device_busy_seconds(run) -> float:
+    """Total device-busy seconds of one ``run()`` call, from a
+    jax.profiler trace (sum of X events with an hlo_category on the TPU
+    process tracks). 0.0 when tracing fails — callers fall back to
+    wall-clock."""
+    try:
+        import glob
+        import gzip
+        import tempfile
+
+        import jax
+
+        with tempfile.TemporaryDirectory() as tdir:
+            with jax.profiler.trace(tdir):
+                run()
+            path = max(
+                glob.glob(tdir + "/**/*.trace.json.gz", recursive=True),
+                key=os.path.getmtime,
+            )
+            with gzip.open(path, "rt") as f:
+                tr = json.load(f)
+            pids = {
+                e["pid"]
+                for e in tr["traceEvents"]
+                if e.get("ph") == "M"
+                and e.get("name") == "process_name"
+                and "TPU" in (e.get("args") or {}).get("name", "")
+            }
+            return (
+                sum(
+                    e["dur"]
+                    for e in tr["traceEvents"]
+                    if e.get("ph") == "X"
+                    and e.get("pid") in pids
+                    and "hlo_category" in (e.get("args") or {})
+                )
+                / 1e6
+            )
+    except Exception as exc:  # profiling is best-effort
+        print(f"device-time trace failed: {exc!r}", file=sys.stderr)
+        return 0.0
+
+
 def main() -> int:
     from peasoup_tpu.io import read_filterbank
     from peasoup_tpu.pipeline import PeasoupSearch, SearchConfig
@@ -257,59 +300,29 @@ def main() -> int:
     res = runs[0]
     print(f"searching times: {[round(t, 3) for t in times]}", file=sys.stderr)
     n_trials = res.n_accel_trials
-    value = n_trials / searching
     baseline = 59 * 3 / 0.3088  # 2014 golden run (BASELINE.md)
 
-    # secondary, weather-independent record: DEVICE-busy time of one
-    # steady-state run via a profiler trace (the chip sits behind a
-    # shared tunnel whose sync latency varies by the HOUR — wall medians
-    # moved 0.97 -> 1.9 s within one r3 session at fixed code). The
-    # driver contract reads the four core keys; these ride along.
-    device_s = 0.0
-    try:
-        import glob
-        import gzip
-        import tempfile
+    # PRIMARY record: DEVICE-busy time of one steady-state run via a
+    # profiler trace. The chip sits behind a shared tunnel whose sync
+    # latency varies by the HOUR (r3 weather log: same code, wall
+    # 0.98 -> 2.64 s over 8 h while device busy moved 0.7%), so wall
+    # rates measure the tunnel, not the chip — BENCH_r01..r03 headline
+    # values fell monotonically while the chip got faster. Per the
+    # definition in BASELINE.md ("Official benchmark definition,
+    # round 4"), `value` is the device-anchored rate, with min-wall
+    # across the 5 timed runs as the fallback when tracing fails.
+    device_s = _device_busy_seconds(lambda: search.run(fil))
 
-        import jax
-
-        with tempfile.TemporaryDirectory() as tdir:
-            with jax.profiler.trace(tdir):
-                search.run(fil)
-            path = max(
-                glob.glob(tdir + "/**/*.trace.json.gz", recursive=True),
-                key=os.path.getmtime,
-            )
-            with gzip.open(path, "rt") as f:
-                tr = json.load(f)
-            pids = {
-                e["pid"]
-                for e in tr["traceEvents"]
-                if e.get("ph") == "M"
-                and e.get("name") == "process_name"
-                and "TPU" in (e.get("args") or {}).get("name", "")
-            }
-            device_s = (
-                sum(
-                    e["dur"]
-                    for e in tr["traceEvents"]
-                    if e.get("ph") == "X"
-                    and e.get("pid") in pids
-                    and "hlo_category" in (e.get("args") or {})
-                )
-                / 1e6
-            )
-    except Exception as exc:  # profiling is best-effort
-        print(f"device-time trace failed: {exc!r}", file=sys.stderr)
-
-    # production default: identity-trial dedupe ON (bitwise-identical
-    # candidates, only DISTINCT resamplings dispatched — this grid is
-    # one identity class per DM, so ~44x less device work)
+    # PRODUCTION configuration (first-class, BASELINE.md row): identity-
+    # trial dedupe ON — the shipped default; bitwise-identical
+    # candidates, only DISTINCT resamplings dispatched (this grid is one
+    # identity class per DM, so ~44x less device work)
     dsearch = PeasoupSearch(SearchConfig(**grid))
     dsearch.run(fil)
     dsearch.run(fil)
     dtimes = sorted(dsearch.run(fil).timers["searching"] for _ in range(3))
     dedupe_median = dtimes[1]
+    dedupe_device_s = _device_busy_seconds(lambda: dsearch.run(fil))
 
     # sanity: the search must still find the pulsar, else the number is void
     top = res.candidates[0]
@@ -317,22 +330,46 @@ def main() -> int:
         "benchmark run failed to recover the golden candidate"
     )
 
+    # weather-proof primary (BASELINE.md "Official benchmark
+    # definition, round 4"): the chip's brute-force rate by device-busy
+    # time; min-wall fallback if the trace failed
+    if device_s > 0:
+        value = n_trials / device_s
+        anchor = "device"
+    else:
+        value = n_trials / times[0]  # min of the 5 sorted walls
+        anchor = "min_wall"
+    wall_value = n_trials / searching
+
     print(
         json.dumps(
             {
-                "metric": "dm_accel_trials_per_sec_per_chip",
+                # metric RENAMED from r01-r03's dm_accel_trials_per_sec
+                # _per_chip: the timing anchor moved from tunnel-wall to
+                # device-busy (BASELINE.md "Official benchmark
+                # definition, round 4"), so the series break is visible
+                # in the core keys — suffixed by the ACTUAL anchor so a
+                # min-wall fallback record can never pollute the
+                # device-anchored series; wall_trials_per_sec continues
+                # the old series
+                "metric": f"dm_accel_trials_per_sec_per_chip_{anchor}",
                 "value": round(value, 2),
-                "unit": "trials/s/chip",
+                "unit": f"trials/s/chip ({anchor}-anchored)",
                 "vs_baseline": round(value / baseline, 4),
+                "value_anchor": anchor,
+                "device_busy_s": round(device_s, 3),
                 "wall_median_s": round(searching, 3),
                 "wall_all_s": [round(t, 3) for t in times],
-                "device_busy_s": round(device_s, 3),
-                "trials_per_sec_device": (
-                    round(n_trials / device_s, 2) if device_s else 0.0
-                ),
-                "dedupe_wall_median_s": round(dedupe_median, 3),
-                "dedupe_trials_per_sec_effective": round(
+                "wall_trials_per_sec": round(wall_value, 2),
+                "production_dedupe_wall_median_s": round(dedupe_median, 3),
+                "production_dedupe_device_busy_s": round(dedupe_device_s, 3),
+                "production_dedupe_trials_per_sec_effective": round(
                     n_trials / dedupe_median, 2
+                ),
+                "production_dedupe_trials_per_sec_device_effective": (
+                    round(n_trials / dedupe_device_s, 2)
+                    if dedupe_device_s
+                    else 0.0
                 ),
             }
         )

@@ -14,6 +14,7 @@ import math
 import os
 import time
 from dataclasses import dataclass, field
+from functools import lru_cache
 from typing import Optional
 
 import jax
@@ -231,11 +232,11 @@ def _dedupe_identity_accels(
     """Collapse accel trials whose resample is provably the IDENTITY
     into one representative per DM.
 
-    resample reads src = i + rn(af * i*(i-size)) with the product in
-    f32 (ops/resample.py). |i*(i-size)| <= size^2/4, so when
-    |af| * size^2/4 < 0.5 every rounded shift is 0 (a real product
-    below 0.5 rounds to at most 0.5, and rn(0.5) = 0 under
-    round-half-even) — the resampled series is BITWISE the input, and
+    resample reads src = i + rn(af * quad(i)) with quad and the product
+    each rounded once to f32 (ops/resample.py). rn is monotone, so
+    every shift is 0 exactly when |f32(af * max|quad|)| <= 0.5
+    (rn(0.5) = 0 under round-half-even) — the resampled series is then
+    BITWISE the input, and
     every such trial's spectrum, peaks, and candidates are bitwise
     identical. Searching one representative and replicating its results
     host-side (_expand_accel_results) is output-identical to brute
@@ -246,12 +247,26 @@ def _dedupe_identity_accels(
     nothing deduped, else an int array mapping each FULL accel index to
     its dispatch-list index.
     """
-    q_max = (size // 2) ** 2
+    # EXACT identity criterion (no heuristic margin): resample computes
+    # shift = rn(f32(af) * quad) with quad = f32(i)*(f32(i) - f32(size))
+    # and ADDS the rounded shift to the integer index (shift-then-add —
+    # the bitwise claim depends on that formulation; rn(i + s) would
+    # need a different bound).  rn is monotone, so every shift rounds
+    # to 0 iff it does at max|quad|: |f32(af * max|quad|)| <= 0.5
+    # (round-half-even sends exactly 0.5 to 0).  max|quad| is taken
+    # over the f32-ROUNDED quad values, evaluated exactly below.
+    max_abs_quad = _max_abs_quad_f32(size)
     dispatch_lists: list = []
     expand_maps: list = []
+    max_ident_af = np.float32(0.0)
     for accs in accel_lists:
         afs = accel_factor(np.asarray(accs), tsamp)
-        ident = np.abs(afs) * q_max < 0.4999999  # margin for f32 edges
+        prod = np.abs(afs.astype(np.float32) * max_abs_quad)  # one f32 rn
+        ident = prod <= np.float32(0.5)
+        if ident.any():
+            max_ident_af = max(
+                max_ident_af, np.abs(afs.astype(np.float32))[ident].max()
+            )
         if ident.sum() <= 1:
             dispatch_lists.append(accs)
             expand_maps.append(None)
@@ -266,7 +281,32 @@ def _dedupe_identity_accels(
             )
         )
         dispatch_lists.append(np.asarray([accs[i] for i in keep]))
+    if max_ident_af > 0:
+        # belt-and-braces: replay the device's exact shift chain for the
+        # LARGEST deduped |af| (monotonicity covers the rest) and verify
+        # every rounded shift is zero
+        shifts = np.rint(max_ident_af * _quad_f32(size))
+        assert not shifts.any(), (
+            f"identity-dedupe invariant violated: af={max_ident_af!r} "
+            f"has a nonzero resample shift (max |shift| = "
+            f"{np.abs(shifts).max()})"
+        )
     return dispatch_lists, expand_maps
+
+
+@lru_cache(maxsize=8)
+def _quad_f32(size: int) -> np.ndarray:
+    """resample's f32-rounded quadratic index map: f32(i)*(f32(i)-f32(size))
+    for all i (exactly the device computation, ops/resample.py)."""
+    idx = np.arange(size, dtype=np.float32)
+    quad = idx * (idx - np.float32(size))
+    quad.setflags(write=False)  # cached: protect from caller mutation
+    return quad
+
+
+@lru_cache(maxsize=8)
+def _max_abs_quad_f32(size: int) -> np.float32:
+    return np.float32(np.abs(_quad_f32(size)).max())
 
 
 def _expand_accel_results(vi, vs, cc, emap, padded_full):

@@ -32,10 +32,24 @@ class AccelerationPlan:
     tsamp: float  # seconds
     cfreq: float  # MHz
     bw: float  # MHz (absolute total bandwidth)
+    # Golden-vs-modern pulse-width semantics (full analysis: PARITY.md
+    # "accel plan"): the 2014 golden binary fed pulse_width to the width
+    # sum in MICROSECONDS; today's reference source (utils.hpp:165)
+    # divides it by 1e3 first, shrinking alt_a ~100x.  Default False
+    # matches the golden artifacts (the only parity ground truth);
+    # set True to reproduce a build of the checked-in reference source.
+    modern_pulse_width: bool = False
 
     def __post_init__(self):
         self.bw = abs(self.bw)
         self.tobs = self.nsamps * self.tsamp
+        if self.modern_pulse_width:
+            # current reference source: ``pulse_width /= 1.0e3`` in the
+            # constructor (utils.hpp:165) — f32 division like the float
+            # member it mutates
+            self.pulse_width = float(
+                np.float32(self.pulse_width) / np.float32(1.0e3)
+            )
 
     def step(self, dm: float) -> float:
         """Trial spacing alt_a at the given DM (m/s^2).

@@ -106,8 +106,14 @@ def delay_table(f0: float, df: float, nchans: int, dt: float) -> np.ndarray:
 def max_delay_samples(dm_max: float, delays: np.ndarray) -> int:
     """Maximum whole-sample delay at the largest trial DM: dedisp's
     ``dm_list[last] * delay_table[nchans-1] + 0.5`` truncation, with the
-    product in f32 (both factors are f32 in the library)."""
-    prod = np.float32(np.float32(dm_max) * np.abs(delays[-1]))
+    product in f32 (both factors are f32 in the library).
+
+    For standard descending bands (foff < 0) the table is monotone and
+    ``abs(delays).max() == abs(delays[-1])`` exactly, so using the max
+    keeps dedisp parity while staying safe for ascending-frequency
+    inputs, where the largest |delay| need not sit at the last channel
+    (per-channel reads would otherwise run past the input)."""
+    prod = np.float32(np.float32(dm_max) * np.abs(delays).max())
     return int(np.floor(np.float64(prod) + 0.5))
 
 

@@ -357,3 +357,29 @@ class TestAccelDedupe:
         lists = [np.asarray([0.0, -5.0, 1e6, 5.0], np.float32)]
         disp, maps = _dedupe_identity_accels(lists, 0.00032, 1 << 17)
         assert len(disp[0]) == 2 and list(maps[0]) == [0, 0, 1, 0]
+
+    def test_identity_criterion_exact_boundary(self):
+        """The dedupe criterion is the EXACT f32 condition
+        |f32(af * max|quad|)| <= 0.5 (ADVICE r3: no heuristic margin) —
+        accelerations just past the boundary must NOT dedupe, and any
+        deduped af must replay to all-zero shifts through resample's
+        exact f32 chain."""
+        from peasoup_tpu.ops.resample import accel_factor
+        from peasoup_tpu.pipeline.search import (
+            _dedupe_identity_accels,
+            _max_abs_quad_f32,
+            _quad_f32,
+        )
+
+        size, tsamp = 1 << 17, 0.00032
+        mq = float(_max_abs_quad_f32(size))
+        # acc whose af sits at ~the 0.5 shift boundary
+        acc_half = 0.5 / mq * 2.0 * 299792458.0 / tsamp
+        for frac, expect_dedupe in [(0.95, True), (1.2, False)]:
+            accs = np.asarray([0.0, frac * acc_half], np.float32)
+            disp, maps = _dedupe_identity_accels([accs], tsamp, size)
+            deduped = maps[0] is not None
+            assert deduped == expect_dedupe, (frac, disp, maps)
+            if deduped:
+                af = np.float32(accel_factor(accs, tsamp)[1])
+                assert not np.rint(af * _quad_f32(size)).any()

@@ -90,6 +90,22 @@ class TestAccelPlan:
         n100 = len(plan.generate_accel_list(100.0))
         assert n100 <= n0
 
+    def test_modern_pulse_width_flag(self):
+        # ADVICE r3: opt-in semantics of the CURRENT reference source
+        # (utils.hpp:165 divides pulse_width by 1e3), vs the default
+        # golden-binary microsecond semantics (PARITY.md "accel plan").
+        golden = self.make()
+        modern = AccelerationPlan(
+            acc_lo=-5.0, acc_hi=5.0, tol=1.10000002384186, pulse_width=64.0,
+            nsamps=131072, tsamp=0.00032, cfreq=1475.12, bw=69.76,
+            modern_pulse_width=True,
+        )
+        # the shrunk width shrinks alt_a ~100x -> ~100x more trials
+        assert modern.step(0.0) < golden.step(0.0) / 50
+        assert len(modern.generate_accel_list(0.0)) > 10 * len(
+            golden.generate_accel_list(0.0)
+        )
+
     def test_walk_covers_range(self):
         plan = self.make()
         accs = plan.generate_accel_list(30.0)

@@ -70,9 +70,16 @@ def test_golden_recall_100pct(golden_run_outdir):
 
 def test_golden_matches_are_tight(golden_run_outdir):
     """Beyond recall: frequency and DM bit-exact, nh exact, S/N within
-    1e-3, acc within the exact-tie cluster with most winners matching
-    the reference's std::sort arrangement, and the ten golden candidates
-    occupy the top ten ranks of our list."""
+    5e-4 (measured 2e-4), acc within the exact-tie cluster with >= 6/10
+    winners matching the reference's std::sort arrangement (measured
+    6/10; the rest flip on sub-ULP comparator outcomes, PARITY.md), and
+    the ten golden candidates occupy the top ten ranks of our list.
+
+    Gates are set to the round-3 MEASURED state, not loose floors, so
+    any drift is caught.  The CLI run under test uses the production
+    default dedupe_accel=ON; brute force is covered transitively by the
+    bitwise dedupe==brute equality test
+    (tests/test_pipeline.py::test_identity_dedupe_bitwise_equal)."""
     rep = match_golden(os.path.join(golden_run_outdir, "overview.xml"))
     n_acc_exact = 0
     for m in rep.matches:
@@ -80,12 +87,12 @@ def test_golden_matches_are_tight(golden_run_outdir):
         assert m.dfreq_rel == 0.0, m
         assert m.ddm == 0.0, m
         assert m.dnh == 0, m
-        assert abs(m.dsnr_rel) < 1e-3, m
+        assert abs(m.dsnr_rel) < 5e-4, m
         # tutorial-scale accel trials are exact ties (resample shift
         # under half a sample): any crowned member is value-identical
         assert m.golden_acc + m.dacc in (-5.0, 0.0, 5.0), m
         n_acc_exact += m.dacc == 0.0
-    assert n_acc_exact >= 5, [m.dacc for m in rep.matches]
+    assert n_acc_exact >= 6, [m.dacc for m in rep.matches]
     # every golden candidate at its EXACT golden rank: the final order
     # is max(snr, folded_snr) desc (folder.hpp:25-31), so this also
     # pins fold-S/N parity at the rank-deciding level (the r3 f32-tsamp
@@ -117,7 +124,7 @@ def test_golden_binary_parses(golden_run_outdir):
 
 def test_golden_fold_parity(golden_run_outdir):
     """Quantitative fold parity vs the golden FOLD blocks (VERDICT r2
-    item 6): shift-aligned profile correlation > 0.999, opt_period
+    item 6): shift-aligned profile correlation > 0.9995, opt_period
     matching the reference's quirk formula (folder.hpp:330) to f32
     print precision, folded_snr within 2% (measured after the r3
     f32-tsamp fold fix: corr >= 0.9998, |dsnr| <= 0.25% — the fold's
@@ -164,7 +171,7 @@ def test_golden_fold_parity(golden_run_outdir):
         corr = max(
             np.corrcoef(gp, np.roll(op, s))[0, 1] for s in range(64)
         )
-        assert corr > 0.999, (key, corr)
+        assert corr > 0.9995, (key, corr)
         assert abs(oop - gop) / gop < 1e-6, (key, oop, gop)
         assert abs(ofs - gfs) / max(gfs, 1.0) < 0.02, (key, ofs, gfs)
         n_checked += 1
