@@ -21,6 +21,10 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
+from peasoup_tpu.utils.cache import enable_compilation_cache
+
+enable_compilation_cache()  # warm XLA compiles across bench processes
+
 
 def bench_fft(n: int = 1 << 23, iters: int = 50) -> int:
     """hcfft-equivalent micro-bench (reference src/hcfft.cpp:14-42):
@@ -214,43 +218,15 @@ def bench_survey() -> int:
 
 
 def _device_busy_seconds(run) -> float:
-    """Total device-busy seconds of one ``run()`` call, from a
-    jax.profiler trace (sum of X events with an hlo_category on the TPU
-    process tracks). 0.0 when tracing fails — callers fall back to
-    wall-clock."""
+    """Total device-busy seconds of one ``run()`` call via the shared
+    profiler-trace parser (peasoup_tpu.tools.scope_trace). 0.0 when
+    tracing fails — callers fall back to wall-clock."""
     try:
-        import glob
-        import gzip
-        import tempfile
+        from peasoup_tpu.tools.scope_trace import scope_trace
 
-        import jax
-
-        with tempfile.TemporaryDirectory() as tdir:
-            with jax.profiler.trace(tdir):
-                run()
-            path = max(
-                glob.glob(tdir + "/**/*.trace.json.gz", recursive=True),
-                key=os.path.getmtime,
-            )
-            with gzip.open(path, "rt") as f:
-                tr = json.load(f)
-            pids = {
-                e["pid"]
-                for e in tr["traceEvents"]
-                if e.get("ph") == "M"
-                and e.get("name") == "process_name"
-                and "TPU" in (e.get("args") or {}).get("name", "")
-            }
-            return (
-                sum(
-                    e["dur"]
-                    for e in tr["traceEvents"]
-                    if e.get("ph") == "X"
-                    and e.get("pid") in pids
-                    and "hlo_category" in (e.get("args") or {})
-                )
-                / 1e6
-            )
+        with scope_trace() as res:
+            run()
+        return res.device_s
     except Exception as exc:  # profiling is best-effort
         print(f"device-time trace failed: {exc!r}", file=sys.stderr)
         return 0.0

@@ -99,26 +99,9 @@ def apply_platform_env() -> None:
         import jax
 
         jax.config.update("jax_platforms", platforms)
-    cache = os.environ.get(
-        "JAX_COMPILATION_CACHE_DIR",
-        os.path.join(
-            os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
-            "peasoup_tpu", "jax",
-        ),
-    )
-    try:
-        os.makedirs(cache, exist_ok=True)
-        import jax
+    from ..utils.cache import enable_compilation_cache
 
-        jax.config.update("jax_compilation_cache_dir", cache)
-        # cache everything (default floor would skip fast compiles),
-        # unless the operator set their own floor via the env var
-        if "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS" not in os.environ:
-            jax.config.update(
-                "jax_persistent_cache_min_compile_time_secs", 0.0
-            )
-    except Exception:
-        pass  # read-only home etc.: run without the persistent cache
+    enable_compilation_cache()
 
 
 def main(argv: list[str] | None = None) -> int:

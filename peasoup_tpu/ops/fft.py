@@ -56,14 +56,34 @@ def _plan(n: int):
     }
 
 
-def rfft_pow2_matmul_parts(
-    x: jnp.ndarray,
+def packed_dft_z(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """The matmul four-step half-length packed complex DFT: returns
+    (zr, zi), each (R, n//2) f32 with the batch flattened, Z in natural
+    bin order. The untwist to rfft bins is left to the caller — either
+    the jnp formulas below or the fused Pallas interbin kernel
+    (ops/pallas/interbin.py)."""
+    m = x.shape[-1] // 2
+    # materialise the input ONCE: without the barrier XLA fuses the
+    # producer chain (e.g. the resample select) separately into the
+    # even- and odd-sample operands, computing it twice (measured:
+    # resample_select 1.9 -> 94 ms when this fed the deinterleave)
+    x = jax.lax.optimization_barrier(x.astype(jnp.float32))
+    z = x.reshape(-1, m, 2)
+    return packed_dft_z_parts(z[..., 0], z[..., 1])
+
+
+def packed_dft_z_parts(
+    xe: jnp.ndarray, xo: jnp.ndarray
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """rfft via the packed four-step matmul DFT, returned as lazy
-    (re, im) f32 parts so elementwise consumers (interbin) fuse with
-    the untwist instead of reading a materialised complex array."""
-    n = x.shape[-1]
-    m = n // 2
+    """:func:`packed_dft_z` on pre-deinterleaved even/odd sample planes
+    (..., n//2) — producers that can emit the planes directly (e.g.
+    resample_select_packed) skip the stride-2 relayout entirely."""
+    # one joint barrier: each plane feeds two einsum operands, and
+    # without it XLA would fuse (= recompute) the producer chain into
+    # every operand (see packed_dft_z)
+    xe, xo = jax.lax.optimization_barrier((xe, xo))
+    m = xe.shape[-1]
+    n = 2 * m
     p = _plan(n)
     n1, n2 = p["n1"], p["n2"]
     P = jax.lax.Precision.HIGHEST
@@ -71,15 +91,8 @@ def rfft_pow2_matmul_parts(
     d2r, d2i = jnp.asarray(p["d2r"]), jnp.asarray(p["d2i"])
     twr, twi = jnp.asarray(p["twr"]), jnp.asarray(p["twi"])
 
-    batch = x.shape[:-1]
-    # materialise the input ONCE: without the barrier XLA fuses the
-    # producer chain (e.g. the resample select) separately into the
-    # even- and odd-sample operands, computing it twice (measured:
-    # resample_select 1.9 -> 94 ms when this fed the deinterleave)
-    x = jax.lax.optimization_barrier(x.astype(jnp.float32))
-    z = x.reshape(-1, m, 2)
-    ar = z[..., 0].reshape(-1, n1, n2)  # A[j1, j2] = z[j1*n2 + j2]
-    ai = z[..., 1].reshape(-1, n1, n2)
+    ar = xe.reshape(-1, n1, n2)  # A[j1, j2] = z[j1*n2 + j2]
+    ai = xo.reshape(-1, n1, n2)
     # step 1: DFT over j1 (columns)  C[k1, j2] = sum_j1 W1[k1,j1] A[j1,j2]
     f1 = lambda D, A: jnp.einsum("lj,rjm->rlm", D, A, precision=P)
     cr = f1(d1r, ar) - f1(d1i, ai)
@@ -94,6 +107,20 @@ def rfft_pow2_matmul_parts(
     ei = f2(tr, d2i) + f2(ti, d2r)
     zr = er.reshape(-1, m)  # (r, k2, k1) -> k = k1 + N1*k2
     zi = ei.reshape(-1, m)
+    return zr, zi
+
+
+def rfft_pow2_matmul_parts(
+    x: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """rfft via the packed four-step matmul DFT, returned as lazy
+    (re, im) f32 parts so elementwise consumers (interbin) fuse with
+    the untwist instead of reading a materialised complex array."""
+    n = x.shape[-1]
+    m = n // 2
+    p = _plan(n)
+    batch = x.shape[:-1]
+    zr, zi = packed_dft_z(x)
 
     # untwist the packed transform to the real-input spectrum:
     # X[k] = (Z[k] + conj(Z[M-k]))/2 - i/2 e^{-2pi i k/n}(Z[k] - conj(Z[M-k]))

@@ -113,11 +113,17 @@ def _gather_conv(x: jnp.ndarray, Q: int, k: int, h: int) -> jnp.ndarray:
     """out[..., i] = p[..., (i*k + 2^(h-1)) >> h] for i < Q*P via one
     strided conv. ``x`` is the padded spectrum as (rows, >=Q*s+1, 1)."""
     s = (_CONV_P * k) >> h
+    # per-operand precision: the spectrum operand needs the full bf16x3
+    # split (HIGHEST) for exactness, but the TAPS are one-hot — exactly
+    # representable in ONE bf16 term — so DEFAULT on that side halves
+    # the MXU pass count while staying BITWISE equal (each output is a
+    # single 1.0*x product; measured equal on v5e, gated by the
+    # bitwise ==take/mxu twin tests)
     g = jax.lax.conv_general_dilated(
         x, jnp.asarray(_conv_taps(k, h)),
         window_strides=(s,), padding="VALID",
         dimension_numbers=("NWC", "WIO", "NWC"),
-        precision=jax.lax.Precision.HIGHEST,
+        precision=(jax.lax.Precision.HIGHEST, jax.lax.Precision.DEFAULT),
     )
     return g[:, :Q]  # (rows, Q, P)
 
@@ -164,7 +170,8 @@ def _fused_level_sums(p: jnp.ndarray, nharms: int) -> jnp.ndarray:
     x = jnp.concatenate(cols, axis=-1)  # (..., Q, K)
     out = jnp.einsum(
         "...qc,cr->...qr", x, jnp.asarray(C),
-        precision=jax.lax.Precision.HIGHEST,
+        # one-hot C is exact in a single bf16 term (see _gather_conv)
+        precision=(jax.lax.Precision.HIGHEST, jax.lax.Precision.DEFAULT),
     )  # (..., Q, H*2^H)
     out = out.reshape(*p.shape[:-1], Q, H, 1 << H)
     out = jnp.moveaxis(out, -2, -3)  # (..., H, Q, 2^H)

@@ -156,6 +156,72 @@ def probe_pallas_peaks(nbins: int, nlev: int, max_peaks: int) -> bool:
         return False
 
 
+@lru_cache(maxsize=None)
+def probe_pallas_interbin(size: int, block: int) -> bool:
+    """REAL compile+run probe of the fused untwist+interbin+normalise
+    kernel (ops/pallas/interbin.py) at a small pow2 shape, gated on
+    BITWISE equality with the jnp twin chain (rfft_pow2_matmul_parts ->
+    form_interpolated_parts -> normalise): the kernel replays exactly
+    the same f32 formulas, so any difference means a broken lowering
+    (roll off by a lane, bad carry, wrong clamp). The features that
+    vary by toolchain (static pltpu.roll, clamped block index maps,
+    VMEM carry scratch) are shape-independent, so a small probe gates
+    every production shape — at the PRODUCTION block width (Mosaic
+    failures can be block-geometry-specific, e.g. the documented
+    PEASOUP_PEAKS_SUB SIGABRT), with the probe's m scaled up to fit."""
+    if not backend_supports_pallas():
+        return False
+    try:
+        import numpy as np
+        import jax.numpy as jnp
+
+        from .interbin import untwist_interbin_normalise
+        from ..fft import rfft_pow2_matmul_parts
+        from ..spectrum import form_interpolated_parts, normalise
+
+        blk = block
+        m = 8192 if 8192 % blk == 0 else 2 * blk
+        n = 2 * m
+        npad = m + blk
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(9, n)).astype(np.float32))
+        mean = jnp.asarray(rng.normal(size=9).astype(np.float32))
+        std = jnp.asarray((1.0 + rng.random(9)).astype(np.float32))
+        from ..fft import packed_dft_z
+
+        zr, zi = packed_dft_z(x)
+        got = np.asarray(
+            untwist_interbin_normalise(zr, zi, mean, std, npad=npad, block=blk)
+        )
+        ref = np.asarray(
+            normalise(
+                form_interpolated_parts(*rfft_pow2_matmul_parts(x)),
+                mean, std,
+            )
+        )
+        ok = (
+            got.shape == (9, npad)
+            and np.array_equal(got[:, : m + 1], ref)
+            and not got[:, m + 1 :].any()
+        )
+        if not ok:
+            import warnings
+
+            warnings.warn(
+                "Pallas interbin kernel FAILED the bitwise oracle check; "
+                "using the unfused path"
+            )
+        return ok
+    except Exception as exc:  # any Mosaic/compile failure -> unfused path
+        import warnings
+
+        warnings.warn(
+            f"Pallas interbin kernel unavailable; using the unfused "
+            f"path: {type(exc).__name__}: {exc}"
+        )
+        return False
+
+
 from .resample import resample_block_pallas, resample_block  # noqa: E402
 
 

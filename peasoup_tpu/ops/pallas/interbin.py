@@ -1,0 +1,158 @@
+"""Pallas TPU kernel: fused rfft-untwist + interbin + normalise.
+
+Completes the packed four-step matmul rfft (ops/fft.py): the two MXU
+einsums emit the half-length complex DFT Z[k] in natural order, and
+this kernel turns Z straight into the NORMALISED interbin spectrum the
+search consumes (reference chain: cuFFT R2C -> bin_interbin_series
+-> normalise, src/kernels.cu:231-304 + 469-494) in ONE streaming pass.
+
+Why a kernel: the untwist needs conj(Z[M-k]) — in pure XLA that is a
+rev + concat per (re, im) plus separate interbin-shift concats and a
+normalise pass, ~6 full HBM round trips that ate the matmul FFT's
+standalone 1.75x win in-pipeline (NOTES.md round 3). Here the mirror
+term comes from ONE XLA rev copy (zrev[k-1] == Z[M-k] — a shift by
+one), and the shift-by-one patterns (mirror + interbin's X[k-1]) are
+carried lane boundaries in VMEM scratch across a sequential k-block
+grid, so the chain is einsums -> rev -> one fused pass.
+
+Bin layout (matches the jnp path's pad convention): output (R, npad)
+f32 with bins k = 0..m real, k > m zeroed (npad = the peaks kernel's
+block alignment so no separate pad pass is spent downstream).
+
+Special bins, from the real-input untwist identities:
+  X[0] = Re Z[0] + Im Z[0]   (mirror wraps to Z[0] itself)
+  X[m] = Re Z[0] - Im Z[0]   (Nyquist; Z[0] carried from block 0)
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_SUB = 8  # rows per stripe (f32 sublane quantum)
+
+
+def _kernel(
+    unc_ref, uns_ref, mean_ref, std_ref, zr_ref, zi_ref, zrv_ref, ziv_ref,
+    out_ref, state, *, block, m,
+):
+    b = pl.program_id(1)
+    zr = zr_ref[:]
+    zi = zi_ref[:]
+
+    @pl.when(b == 0)
+    def _():
+        # carries: [zrv_last, ziv_last, xr_last, xi_last, z0r, z0i]
+        # k=0's mirror wraps to Z[0]; X[-1] = 0 (the interbin kernel's
+        # idx==0 branch, kernels.cu:242)
+        state[:, 0:1] = zr[:, 0:1]
+        state[:, 1:2] = zi[:, 0:1]
+        state[:, 2:3] = jnp.zeros((_SUB, 1), jnp.float32)
+        state[:, 3:4] = jnp.zeros((_SUB, 1), jnp.float32)
+        state[:, 4:5] = zr[:, 0:1]
+        state[:, 5:6] = zi[:, 0:1]
+
+    lane = jax.lax.broadcasted_iota(jnp.int32, (_SUB, block), 1)
+    gk = b * block + lane  # global bin index
+    # forward term Z[k]: at the Nyquist bin k == m it wraps to Z[0]
+    # (carried from block 0); the mirror carry already holds the right
+    # value there (zrev[m-1] == Z[0]), so no result override is needed
+    # and the arithmetic below is bit-identical to the jnp untwist
+    nyq = gk == m
+    zr = jnp.where(nyq, state[:, 4:5], zr)
+    zi = jnp.where(nyq, state[:, 5:6], zi)
+    # mirror term Z[M-k] = zrev[k-1]: in-block right-shift + carried
+    # boundary lane
+    zmr = jnp.where(lane == 0, state[:, 0:1], pltpu.roll(zrv_ref[:], 1, 1))
+    zmi = jnp.where(lane == 0, state[:, 1:2], pltpu.roll(ziv_ref[:], 1, 1))
+    # untwist (ops/fft.py formulas):
+    # X[k] = (Z[k]+conj(Zm))/2 - i/2 e^{-2pi i k/n} (Z[k]-conj(Zm))
+    c = unc_ref[:]
+    s = uns_ref[:]
+    arr = 0.5 * (zr + zmr)
+    aii = 0.5 * (zi - zmi)
+    br = zr - zmr
+    bi = zi + zmi
+    xr = arr + 0.5 * (c * bi - s * br)
+    xi = aii - 0.5 * (c * br + s * bi)
+    # interbin (kernels.cu:231-252): X[k-1] via the same shift pattern
+    xr_l = jnp.where(lane == 0, state[:, 2:3], pltpu.roll(xr, 1, 1))
+    xi_l = jnp.where(lane == 0, state[:, 3:4], pltpu.roll(xi, 1, 1))
+    ampsq = xr * xr + xi * xi
+    dsq = 0.5 * ((xr - xr_l) ** 2 + (xi - xi_l) ** 2)
+    amp = jnp.sqrt(jnp.maximum(ampsq, dsq))
+    # normalise (kernels.cu:469-494) + zero the pad past the true bins
+    out = (amp - mean_ref[:, 0:1]) / std_ref[:, 0:1]
+    out_ref[:] = jnp.where(gk <= m, out, 0.0)
+    # advance carries
+    state[:, 0:1] = zrv_ref[:, block - 1 : block]
+    state[:, 1:2] = ziv_ref[:, block - 1 : block]
+    state[:, 2:3] = xr[:, block - 1 : block]
+    state[:, 3:4] = xi[:, block - 1 : block]
+
+
+@lru_cache(maxsize=None)
+def _build(rpad: int, m: int, npad: int, block: int, interpret: bool):
+    nbz = m // block  # z blocks (m is a multiple of block by gating)
+    zspec = pl.BlockSpec(
+        (_SUB, block), lambda r, b: (r, jnp.minimum(b, nbz - 1))
+    )
+    return pl.pallas_call(
+        partial(_kernel, block=block, m=m),
+        grid=(rpad // _SUB, npad // block),
+        in_specs=[
+            pl.BlockSpec((1, block), lambda r, b: (0, b)),  # unc
+            pl.BlockSpec((1, block), lambda r, b: (0, b)),  # uns
+            pl.BlockSpec((_SUB, 128), lambda r, b: (r, 0)),  # mean
+            pl.BlockSpec((_SUB, 128), lambda r, b: (r, 0)),  # std
+            zspec, zspec, zspec, zspec,  # zr, zi, zrv, ziv
+        ],
+        out_specs=pl.BlockSpec((_SUB, block), lambda r, b: (r, b)),
+        out_shape=jax.ShapeDtypeStruct((rpad, npad), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((_SUB, 128), jnp.float32)],
+        interpret=interpret,
+    )
+
+
+def untwist_interbin_normalise(
+    zr: jnp.ndarray,  # (R, m) f32 packed-DFT real part, natural order
+    zi: jnp.ndarray,  # (R, m) f32 imaginary part
+    mean: jnp.ndarray,  # (R,) f32 per-row spectrum mean
+    std: jnp.ndarray,  # (R,) f32 per-row spectrum std
+    *,
+    npad: int,  # output width (multiple of ``block``, > m)
+    block: int = 4096,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """(R, npad) f32 normalised interbin spectrum of the real series
+    whose packed half-length DFT is Z = zr + i*zi; bins k in [0, m]
+    real, the rest zero. ``m`` must be a multiple of ``block`` and
+    ``npad`` a strictly larger multiple."""
+    r, m = zr.shape
+    if m % block or npad % block or npad <= m:
+        raise ValueError(f"bad interbin kernel geometry {m=} {npad=} {block=}")
+    n = 2 * m
+    k = np.arange(npad, dtype=np.float64)
+    un = np.exp(-2j * np.pi * np.minimum(k, m) / n)
+    unc = jnp.asarray(un.real[None, :].astype(np.float32))
+    uns = jnp.asarray((-un.imag)[None, :].astype(np.float32))
+    rpad = -(-r // _SUB) * _SUB
+    mean2 = jnp.broadcast_to(mean[:, None], (r, 128))
+    std2 = jnp.broadcast_to(std[:, None], (r, 128))
+    zrv = jnp.flip(zr, axis=-1)
+    ziv = jnp.flip(zi, axis=-1)
+    if rpad != r:
+        pad = [(0, rpad - r), (0, 0)]
+        zr, zi, zrv, ziv = (jnp.pad(a, pad) for a in (zr, zi, zrv, ziv))
+        # std pads with ONES so the pad rows' normalise never divides
+        # by zero (their outputs are dropped)
+        mean2 = jnp.pad(mean2, pad)
+        std2 = jnp.pad(std2, pad, constant_values=1.0)
+    fn = _build(rpad, m, npad, block, interpret)
+    out = fn(unc, uns, mean2, std2, zr, zi, zrv, ziv)
+    return out[:r]

@@ -63,10 +63,22 @@ if PEAKS_BLOCK <= 0 or PEAKS_BLOCK % 128:
         f"{PEAKS_BLOCK}"
     )
 _BLOCK = PEAKS_BLOCK
-_SUB = 8  # rows per stripe (f32 sublane quantum)
+# rows per stripe (multiple of the f32 sublane quantum 8): taller
+# stripes cut the number of grid steps — the window-merged walk (r4)
+# made the per-step fixed work (per-level threshold mask + count) the
+# dominant cost, and it row-vectorises for free
+_SUB = int(_os.environ.get("PEASOUP_PEAKS_SUB", "8"))
+if _SUB <= 0 or _SUB % 8:
+    raise ValueError(f"PEASOUP_PEAKS_SUB must be a positive multiple of 8: {_SUB}")
 # crossing-walk subblock width (lanes): full _BLOCK when it doesn't
 # divide evenly (tiny tuning blocks), else 512
 _SBW = 512 if _BLOCK % 512 == 0 else _BLOCK
+# unrolled machine steps per while-loop trip (the walk is trip-latency
+# bound; each step is one close/emit + one window merge); must be >= 1
+# or the walk loop would never clear crossings (infinite device loop)
+_WSTEPS = int(_os.environ.get("PEASOUP_PEAKS_WSTEPS", "2"))
+if _WSTEPS < 1:
+    raise ValueError(f"PEASOUP_PEAKS_WSTEPS must be >= 1, got {_WSTEPS}")
 _BIG = 1 << 30  # "no crossing" sentinel for the masked min reduction
 
 
@@ -120,64 +132,105 @@ def _kernel_multi(*refs, nlev, mx, nbins, threshold, min_gap, scales):
 
             # walk the block's crossings SUBBLOCK by subblock (left to
             # right, so the cluster machine sees the same ascending
-            # crossing sequence): the serial walk's per-trip vector work
-            # — masked min/max + mstate clear — shrinks from the full
-            # _BLOCK width to _SBW lanes. Measured honestly: the walk
-            # is TRIP-LATENCY-bound at tutorial crossing densities
-            # (~8.7 us/trip; 86.6 -> 85.1 ms), so this pays off only on
-            # dense-crossing data where vector width matters; empty
-            # subblocks cost one reduce. All slices are STATIC (python
+            # crossing sequence). All slices are STATIC (python
             # unroll), so no dynamic lane indexing reaches Mosaic.
-            # Cutting the trip COUNT (run-merging in the state machine)
-            # is the remaining lever — see NOTES.md.
+            #
+            # WINDOW-MERGED walk (r4): the walk is TRIP-LATENCY-bound
+            # (~8.7 us/trip regardless of vector width — r3 measured
+            # subblock shrinking and block-size scans flat), so the
+            # lever is trip COUNT. Each trip processes the first
+            # remaining crossing through the full close/emit/take
+            # machine, then MERGES every further crossing j in the
+            # close-free window (idx, lastidx' + min_gap) in one vector
+            # step: for such j, close cannot fire (lastidx only
+            # advances, so j - lastidx_at_j < min_gap), and a close-free
+            # sequence of takes reduces to "final cpeak = max(cpeak,
+            # window max); lastidx/cpeakidx move to the FIRST position
+            # of the window max iff it strictly beats cpeak" — exactly
+            # the identify_unique_peaks quirk (lastidx advances only on
+            # new max, peakfinder.hpp:27-56), because intermediate
+            # non-emitting takes leave no other trace. A contiguous
+            # ~min_gap-wide cluster run collapses from ~30 trips to ~2.
             for lo_l in range(0, _BLOCK, _SBW):
                 mask_sb = mask[:, lo_l : lo_l + _SBW]
                 gidx_sb = gidx[:, lo_l : lo_l + _SBW]
                 s_sb = s[:, lo_l : lo_l + _SBW]
-                cnt_sb = jnp.max(
-                    jnp.sum(mask_sb.astype(jnp.int32), axis=1)
-                )
+                tot_sb = jnp.sum(mask_sb.astype(jnp.int32))
 
-                @pl.when(cnt_sb > 0)
+                @pl.when(tot_sb > 0)
                 def _(mask_sb=mask_sb, gidx_sb=gidx_sb, s_sb=s_sb,
-                      cnt_sb=cnt_sb, lo_l=lo_l, emit=emit, c0=c0):
-                    def body(it):
-                        m = mstate[:, lo_l : lo_l + _SBW] > 0
+                      tot_sb=tot_sb, lo_l=lo_l, emit=emit, c0=c0):
+                    def body(rem):
+                        msk = mstate[:, lo_l : lo_l + _SBW] > 0
                         cursor = istate[:, c0 : c0 + 1]
                         open_ = istate[:, c0 + 2 : c0 + 3]
                         cpeakidx = istate[:, c0 + 3 : c0 + 4]
                         lastidx = istate[:, c0 + 4 : c0 + 5]
                         cpeak = fstate[:, c0 : c0 + 1]
-                        idx = jnp.min(
-                            jnp.where(m, gidx_sb, jnp.int32(_BIG)),
-                            axis=1, keepdims=True,
-                        )
-                        act = idx < jnp.int32(_BIG)
-                        snr = jnp.max(
-                            jnp.where(m & (gidx_sb == idx), s_sb, -jnp.inf),
-                            axis=1,
-                            keepdims=True,
-                        )
-                        close = act & (open_ == 1) & (idx - lastidx >= min_gap)
-                        emit(close, cursor, cpeakidx, cpeak)
-                        cursor = jnp.where(close, cursor + 1, cursor)
-                        start = act & ((open_ == 0) | close)
-                        take = start | (act & (snr > cpeak))
-                        mstate[:, lo_l : lo_l + _SBW] = jnp.where(
-                            gidx_sb == idx, 0, mstate[:, lo_l : lo_l + _SBW]
-                        )
+                        # _WSTEPS unrolled machine steps per trip: the
+                        # loop is trip-latency-bound, so more vector
+                        # work per trip is nearly free
+                        for _ in range(_WSTEPS):
+                            idx = jnp.min(
+                                jnp.where(msk, gidx_sb, jnp.int32(_BIG)),
+                                axis=1, keepdims=True,
+                            )
+                            act = idx < jnp.int32(_BIG)
+                            snr = jnp.max(
+                                jnp.where(
+                                    msk & (gidx_sb == idx), s_sb, -jnp.inf
+                                ),
+                                axis=1,
+                                keepdims=True,
+                            )
+                            close = (
+                                act
+                                & (open_ == 1)
+                                & (idx - lastidx >= min_gap)
+                            )
+                            emit(close, cursor, cpeakidx, cpeak)
+                            cursor = jnp.where(close, cursor + 1, cursor)
+                            start = act & ((open_ == 0) | close)
+                            take = start | (act & (snr > cpeak))
+                            cpeakidx = jnp.where(take, idx, cpeakidx)
+                            lastidx = jnp.where(take, idx, lastidx)
+                            cpeak = jnp.where(take, snr, cpeak)
+                            open_ = jnp.where(act, 1, open_)
+                            # close-free window past the first element:
+                            # one masked max + first-argmax stands in
+                            # for every crossing the sequential machine
+                            # could only take, never close on
+                            wmask = (
+                                msk
+                                & (gidx_sb > idx)
+                                & (gidx_sb < lastidx + jnp.int32(min_gap))
+                            )
+                            wmax = jnp.max(
+                                jnp.where(wmask, s_sb, -jnp.inf),
+                                axis=1, keepdims=True,
+                            )
+                            wfirst = jnp.min(
+                                jnp.where(
+                                    wmask & (s_sb == wmax), gidx_sb,
+                                    jnp.int32(_BIG),
+                                ),
+                                axis=1, keepdims=True,
+                            )
+                            wtake = act & (wmax > cpeak)
+                            cpeakidx = jnp.where(wtake, wfirst, cpeakidx)
+                            lastidx = jnp.where(wtake, wfirst, lastidx)
+                            cpeak = jnp.where(wtake, wmax, cpeak)
+                            msk = msk & ~((gidx_sb == idx) | wmask)
+                        nst = msk.astype(jnp.int32)
+                        mstate[:, lo_l : lo_l + _SBW] = nst
                         istate[:, c0 : c0 + 1] = cursor
-                        istate[:, c0 + 2 : c0 + 3] = jnp.where(act, 1, open_)
-                        istate[:, c0 + 3 : c0 + 4] = jnp.where(
-                            take, idx, cpeakidx
-                        )
-                        istate[:, c0 + 4 : c0 + 5] = jnp.where(
-                            take, idx, lastidx
-                        )
-                        fstate[:, c0 : c0 + 1] = jnp.where(take, snr, cpeak)
-                        return it - 1
+                        istate[:, c0 + 2 : c0 + 3] = open_
+                        istate[:, c0 + 3 : c0 + 4] = cpeakidx
+                        istate[:, c0 + 4 : c0 + 5] = lastidx
+                        fstate[:, c0 : c0 + 1] = cpeak
+                        return jnp.sum(nst)
 
-                    jax.lax.while_loop(lambda it: it > 0, body, cnt_sb)
+                    jax.lax.while_loop(lambda rem: rem > 0, body, tot_sb)
 
         @pl.when(b == nb - 1)
         def _(emit=emit, c0=c0, lvl=lvl):

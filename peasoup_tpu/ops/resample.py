@@ -92,6 +92,47 @@ def resample_select(
     return out
 
 
+@partial(jax.jit, static_argnames=("smax",))
+def resample_select_packed(
+    x: jnp.ndarray,  # (D, N) f32 time series per DM trial
+    afs: jnp.ndarray,  # (D, A) f32 acceleration factors a*tsamp/2c
+    *,
+    smax: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """:func:`resample_select` emitted directly as (even, odd) sample
+    planes — the packed matmul rfft's complex deinterleave
+    (ops/fft.py:packed_dft_z) costs a stride-2 relayout of the full
+    (D, A, N) resample output (~21 ms/dense search on v5e); selecting
+    into the two half-length planes costs the same select work and
+    makes the relayout FREE (the per-trial input is tiny, so its own
+    parity split is noise). Values are BITWISE those of
+    resample_select: out_even[..., j] == out[..., 2j],
+    out_odd[..., j] == out[..., 2j+1].
+
+    Returns ((D, A, N//2), (D, A, N//2)).
+    """
+    n = x.shape[-1]
+    m = n // 2
+    idx = jnp.arange(n, dtype=jnp.float32)
+    quad = idx * (idx - jnp.float32(n))  # exact inputs, one f32 rounding
+    she = jnp.rint(afs[..., None] * quad[0::2]).astype(jnp.int32)
+    sho = jnp.rint(afs[..., None] * quad[1::2]).astype(jnp.int32)
+    xp = jnp.pad(x.astype(jnp.float32), ((0, 0), (smax, smax)), mode="edge")
+    planes = (xp[:, 0::2], xp[:, 1::2])  # xp[2t], xp[2t+1]
+    oute = jnp.zeros(she.shape, jnp.float32)
+    outo = jnp.zeros(sho.shape, jnp.float32)
+    for s in range(-smax, smax + 1):
+        # even output j reads xp[smax + s + 2j]: parity of (smax+s)
+        # picks the plane, its half-index the slice offset
+        p = smax + s
+        arm = jax.lax.dynamic_slice_in_dim(planes[p % 2], p // 2, m, axis=1)
+        oute = jnp.where(she == jnp.int32(s), arm[:, None, :], oute)
+        p = smax + s + 1  # odd output j reads xp[smax + s + 2j + 1]
+        arm = jax.lax.dynamic_slice_in_dim(planes[p % 2], p // 2, m, axis=1)
+        outo = jnp.where(sho == jnp.int32(s), arm[:, None, :], outo)
+    return oute, outo
+
+
 def select_span(af_max: float, n: int, limit: int = 64) -> int:
     """Static shift bound for :func:`resample_select`: ceil of
     max|af|*N^2/4 plus one guard sample, or 0 when the span exceeds

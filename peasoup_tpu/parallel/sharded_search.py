@@ -30,6 +30,7 @@ def make_sharded_search_fn(
     pallas_block: int = 0,
     select_smax: int = 0,
     pallas_peaks: bool = False,
+    fused_interbin: bool = False,
 ):
     """Jitted (D, ...) -> (D, ...) search with D sharded over ``axis``.
 
@@ -65,7 +66,7 @@ def make_sharded_search_fn(
                 threshold=threshold, size=size, nsamps_valid=nsamps_valid,
                 nharms=nharms, max_peaks=max_peaks, pos5=pos5, pos25=pos25,
                 pallas_block=pallas_block, select_smax=select_smax,
-                pallas_peaks=pallas_peaks,
+                pallas_peaks=pallas_peaks, fused_interbin=fused_interbin,
             )
 
         return jax.shard_map(

@@ -71,7 +71,7 @@ def _preprocess_trial(tim, zapmask, *, size, nsamps_valid, pos5, pos25):
 
 def _spectra_and_peaks(
     xr, mean, std, windows, *, threshold, nharms, max_peaks, stack_axis,
-    cluster=True, pallas_peaks=False,
+    cluster=True, pallas_peaks=False, fused_interbin=False,
 ):
     """Post-resample stage: batched rfft, interbin, normalise, harmonic
     sums, per-level peak compaction (pipeline_multi.cu:216-234), and —
@@ -86,24 +86,48 @@ def _spectra_and_peaks(
     # named scopes mirror the reference's NVTX ranges inside the jitted
     # program (pipeline_multi.cu:207, harmonicfolder.hpp:28): ops carry
     # the scope in their metadata, so profiler traces group them
+    packed = isinstance(xr, tuple)  # pre-deinterleaved (even, odd) planes
+    size = 2 * xr[0].shape[-1] if packed else xr.shape[-1]
+    nbins = size // 2 + 1
+    kernel_scales = pallas_peaks and cluster
     with jax.named_scope("Acceleration-Loop"):
         from ..ops.fft import _use_matmul, rfft_pow2_matmul_parts
         from ..ops.spectrum import form_interpolated_parts
 
-        if _use_matmul(xr.shape[-1]):
+        if fused_interbin and kernel_scales:
+            # matmul four-step packed DFT, then ONE Pallas pass does
+            # untwist + interbin + normalise and emits the spectrum
+            # already padded to the peaks kernel's block alignment
+            # (ops/pallas/interbin.py) — callers gate on the
+            # probe_pallas_interbin oracle
+            from ..ops.fft import packed_dft_z, packed_dft_z_parts
+            from ..ops.pallas.interbin import untwist_interbin_normalise
+            from ..ops.pallas.peaks import PEAKS_BLOCK
+
+            batch = xr[0].shape[:-1] if packed else xr.shape[:-1]
+            npad = -(-nbins // PEAKS_BLOCK) * PEAKS_BLOCK
+            zr, zi = (
+                packed_dft_z_parts(*xr) if packed else packed_dft_z(xr)
+            )
+            s = untwist_interbin_normalise(
+                zr, zi,
+                jnp.broadcast_to(mean, batch).reshape(-1),
+                jnp.broadcast_to(std, batch).reshape(-1),
+                npad=npad, block=PEAKS_BLOCK,
+            ).reshape(*batch, npad)
+        elif _use_matmul(xr.shape[-1]):
             # matmul four-step rfft as lazy (re, im) parts: the untwist
             # fuses into the interbin pass (no complex materialisation)
             s = form_interpolated_parts(*rfft_pow2_matmul_parts(xr))
+            s = normalise(s, mean, std)
         else:
             s = form_interpolated(jnp.fft.rfft(xr, axis=-1))
-        s = normalise(s, mean, std)
+            s = normalise(s, mean, std)
     # the fused kernel applies the per-level rsqrt(2^h) factor in VMEM
     # (one fewer full HBM pass per level); the jnp path scales here.
     # For the kernel path the levels also come back pre-padded to the
     # kernel's block size (block_align) so no per-level pad pass is
     # spent — the pad region is garbage the kernel's windows mask.
-    kernel_scales = pallas_peaks and cluster
-    nbins = s.shape[-1]
     with jax.named_scope("Harmonic summing"):
         if kernel_scales:
             from ..ops.pallas.peaks import PEAKS_BLOCK
@@ -112,9 +136,10 @@ def _spectra_and_peaks(
                 s, nharms=nharms, scaled=False, block_align=PEAKS_BLOCK
             )
             npad = sums[0].shape[-1]
-            s = jnp.pad(
-                s, [(0, 0)] * (s.ndim - 1) + [(0, npad - nbins)]
-            )
+            if s.shape[-1] != npad:
+                s = jnp.pad(
+                    s, [(0, 0)] * (s.ndim - 1) + [(0, npad - nbins)]
+                )
         else:
             sums = harmonic_sums(s, nharms=nharms, scaled=True)
     levels = [s] + sums
@@ -236,6 +261,7 @@ def search_block_core(
     select_smax: int = 0,
     cluster: bool = True,
     pallas_peaks: bool = False,
+    fused_interbin: bool = False,
 ) -> AccelSearchPeaks:
     """Block-batched search: all per-DM preprocessing vmapped, then the
     (D, A) accel grid processed as single batched array programs. With
@@ -259,9 +285,17 @@ def search_block_core(
             xd, afs, block=pallas_block, interpret=pallas_interpret
         )
     elif select_smax > 0:
-        from ..ops.resample import resample_select
+        if fused_interbin and cluster and pallas_peaks:
+            # the packed-DFT consumer wants even/odd planes: selecting
+            # straight into them skips the stride-2 deinterleave
+            # relayout (bitwise-equal elements, ops/resample.py)
+            from ..ops.resample import resample_select_packed
 
-        xr = resample_select(xd, afs, smax=select_smax)  # (D, A, size)
+            xr = resample_select_packed(xd, afs, smax=select_smax)
+        else:
+            from ..ops.resample import resample_select
+
+            xr = resample_select(xd, afs, smax=select_smax)  # (D, A, size)
     else:
         xr = jax.vmap(resample_accel)(xd, afs)  # (D, A, size)
 
@@ -271,13 +305,14 @@ def search_block_core(
         xr, mean[:, None], std[:, None], windows,
         threshold=threshold, nharms=nharms, max_peaks=max_peaks,
         stack_axis=1, cluster=cluster, pallas_peaks=pallas_peaks,
+        fused_interbin=fused_interbin,
     )
 
 
 @lru_cache(maxsize=None)
 def make_batched_search_fn(
     threshold: float, pallas_block: int = 0, select_smax: int = 0,
-    pallas_peaks: bool = False,
+    pallas_peaks: bool = False, fused_interbin: bool = False,
 ):
     """Jitted (D, ...) -> (D, ...) search over a block of DM trials.
 
@@ -301,6 +336,7 @@ def make_batched_search_fn(
             nharms=nharms, max_peaks=max_peaks, pos5=pos5, pos25=pos25,
             pallas_block=pallas_block, select_smax=select_smax,
             cluster=cluster, pallas_peaks=pallas_peaks,
+            fused_interbin=fused_interbin,
         )
 
     return search_dm_block

@@ -718,6 +718,25 @@ class PeasoupSearch:
         self._pallas_peaks = pallas_peaks
         self._peaks_probe_nlev = cfg.nharmonics + 1
         self._peaks_probe_nbins = size_spec
+        # fused matmul-rfft untwist + interbin + normalise kernel
+        # (ops/pallas/interbin.py): one streaming pass replaces XLA's
+        # FFT untwist/concat/normalise passes. Needs the peaks-kernel
+        # path (its output is pre-padded to PEAKS_BLOCK), a pow2 size
+        # whose half divides the block, and the bitwise oracle probe.
+        # PEASOUP_FUSED_FFT=0 restores the stock XLA FFT chain.
+        fused_interbin = False
+        if pallas_peaks and os.environ.get("PEASOUP_FUSED_FFT", "1") != "0":
+            from ..ops.fft import _MIN_N
+            from ..ops.pallas import probe_pallas_interbin
+            from ..ops.pallas.peaks import PEAKS_BLOCK
+
+            if (
+                size >= _MIN_N
+                and not (size & (size - 1))
+                and (size // 2) % PEAKS_BLOCK == 0
+            ):
+                fused_interbin = probe_pallas_interbin(size, PEAKS_BLOCK)
+        self._fused_interbin = fused_interbin
 
         # --- search-side mesh wiring (mesh chosen before dedispersion) --
         if mesh is not None:
@@ -729,7 +748,7 @@ class PeasoupSearch:
                 return make_sharded_search_fn(
                     mesh, cfg.min_snr, axis="dm", pallas_block=pb,
                     select_smax=select_smax if pb == 0 else 0,
-                    pallas_peaks=pp,
+                    pallas_peaks=pp, fused_interbin=fused_interbin and pp,
                 )
 
             # stage blocks directly onto the mesh (no hop through chip 0)
@@ -740,7 +759,7 @@ class PeasoupSearch:
             def build_search(pb: int, pp: bool = pallas_peaks):
                 return make_batched_search_fn(
                     cfg.min_snr, pb, select_smax if pb == 0 else 0,
-                    pallas_peaks=pp,
+                    pallas_peaks=pp, fused_interbin=fused_interbin and pp,
                 )
 
             self._dm_sharding = None

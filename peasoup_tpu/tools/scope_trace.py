@@ -1,0 +1,121 @@
+"""Per-scope device-time/bytes attribution from a jax.profiler trace.
+
+The axon tunnel's wall clock swings 2-3x by the hour, so kernel work is
+measured from the profiler's device tracks instead: TPU-pid X events
+carry ``args.tf_op`` (the jax named-scope path), ``hlo_category`` and
+``raw_bytes_accessed`` — aggregating durations by tf_op prefix gives an
+honest (time, bytes) breakdown per pipeline stage (NOTES.md "Roofline
+re-measurement").
+
+Library use:
+    with scope_trace() as result: run()
+    result.table()  # [(scope, seconds, gigabytes), ...]
+
+CLI: ``python -m peasoup_tpu.tools.scope_trace`` runs the dense-grid
+tutorial search (the official bench workload) once warm and prints the
+table — the source of NOTES.md's per-scope numbers.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import gzip
+import json
+import os
+import tempfile
+
+
+class ScopeResult:
+    def __init__(self) -> None:
+        self.events: list[tuple[str, float, int]] = []  # (tf_op, us, bytes)
+
+    @property
+    def device_s(self) -> float:
+        return sum(e[1] for e in self.events) / 1e6
+
+    def table(self, depth: int = 2, top: int = 20):
+        """Aggregate by the first ``depth`` components of the tf_op
+        scope path; returns [(scope, seconds, gigabytes)] sorted by
+        time."""
+        agg: dict[str, list[float]] = {}
+        for op, us, nbytes in self.events:
+            key = "/".join(op.split("/")[:depth]) if op else "<unscoped>"
+            a = agg.setdefault(key, [0.0, 0.0])
+            a[0] += us / 1e6
+            a[1] += nbytes / 1e9
+        rows = sorted(agg.items(), key=lambda kv: -kv[1][0])[:top]
+        return [(k, v[0], v[1]) for k, v in rows]
+
+    def print_table(self, depth: int = 2, top: int = 20) -> None:
+        print(f"device busy: {self.device_s * 1e3:.1f} ms")
+        for scope, s, gb in self.table(depth, top):
+            print(f"  {s * 1e3:8.1f} ms  {gb:8.2f} GB  {scope}")
+
+
+@contextlib.contextmanager
+def scope_trace():
+    """Trace the with-block and populate a ScopeResult from the TPU
+    device tracks of the resulting trace.json.gz."""
+    import jax
+
+    res = ScopeResult()
+    with tempfile.TemporaryDirectory() as tdir:
+        with jax.profiler.trace(tdir):
+            yield res
+        paths = glob.glob(tdir + "/**/*.trace.json.gz", recursive=True)
+        if not paths:
+            return
+        with gzip.open(max(paths, key=os.path.getmtime), "rt") as f:
+            tr = json.load(f)
+        pids = {
+            e["pid"]
+            for e in tr["traceEvents"]
+            if e.get("ph") == "M"
+            and e.get("name") == "process_name"
+            and "TPU" in (e.get("args") or {}).get("name", "")
+        }
+        for e in tr["traceEvents"]:
+            args = e.get("args") or {}
+            if (
+                e.get("ph") == "X"
+                and e.get("pid") in pids
+                and "hlo_category" in args
+            ):
+                res.events.append(
+                    (
+                        args.get("tf_op", ""),
+                        float(e.get("dur", 0)),
+                        int(args.get("raw_bytes_accessed", 0) or 0),
+                    )
+                )
+
+
+def main() -> int:
+    import sys
+
+    from peasoup_tpu.io import read_filterbank
+    from peasoup_tpu.pipeline import PeasoupSearch, SearchConfig
+
+    fil = read_filterbank(
+        os.environ.get(
+            "PEASOUP_BENCH_FIL", "/root/reference/example_data/tutorial.fil"
+        )
+    )
+    dedupe = "--dedupe" in sys.argv
+    search = PeasoupSearch(
+        SearchConfig(
+            dm_end=250.0, acc_start=-5.0, acc_end=5.0, acc_pulse_width=0.064,
+            npdmp=0, limit=1000, dedupe_accel=dedupe,
+        )
+    )
+    search.run(fil)
+    search.run(fil)  # second warm-up locks adaptive sizes
+    with scope_trace() as res:
+        search.run(fil)
+    res.print_table(depth=int(os.environ.get("SCOPE_DEPTH", "2")))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

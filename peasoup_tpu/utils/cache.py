@@ -1,0 +1,44 @@
+"""Persistent XLA compilation cache wiring.
+
+At survey scale a fresh process pays minutes of XLA compiles (~70 s per
+subband-stage shape, ~30 s for the fold phase at 2^21 samples —
+NOTES.md); the persistent cache amortises them across processes. Every
+entry point (the CLIs via apply_platform_env, bench.py) calls
+:func:`enable_compilation_cache` before building programs.
+``JAX_COMPILATION_CACHE_DIR`` overrides the location."""
+
+from __future__ import annotations
+
+import os
+
+
+def default_cache_dir() -> str:
+    return os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(
+            os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+            "peasoup_tpu", "jax",
+        ),
+    )
+
+
+def enable_compilation_cache() -> str | None:
+    """Point jax at the persistent on-disk compilation cache and return
+    its path (None when it could not be enabled). Safe to call
+    repeatedly, before or after backend init; failures are non-fatal
+    (an uncached run is just slower)."""
+    cache = default_cache_dir()
+    try:
+        os.makedirs(cache, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache)
+        # cache everything (default floor would skip fast compiles),
+        # unless the operator set their own floor via the env var
+        if "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS" not in os.environ:
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.0
+            )
+        return cache
+    except Exception:  # read-only home etc.: run without the cache
+        return None

@@ -78,3 +78,104 @@ def test_birdies_from_mask():
     # run of 3 zeros ending at index 4: freq=(4-1.5)*2=5.0 width=6.0
     assert b[0] == (5.0, 6.0)
     assert b[1] == ((6 - 0.5) * 2.0, 2.0)
+
+
+def test_multibeam_rfi_loop(tmp_path):
+    """The reference's full multibeam OPERATIONAL loop in one pipeline
+    (VERDICT r3 item 5; src/coincidencer.cpp:46-215 +
+    misc/default_zaplist.txt workflow): synthesize B beams sharing a
+    zero-DM RFI pulse train, coincidencer them into a birdie list +
+    sample mask, feed the artifacts into a peasoup search via -z/-k,
+    and assert the planted tone is zapped from the candidate list while
+    the (single-beam) pulsar survives. A control run without -z proves
+    the zap — not luck — removed the tone."""
+    from peasoup_tpu.io.sigproc import (
+        Filterbank, SigprocHeader, write_filterbank,
+    )
+
+    nbeams, nsamps, nchans, tsamp = 5, 1 << 15, 16, 0.000256
+    p_rfi, p_psr, dm_psr = 0.05, 0.064, 20.0
+    fch1, foff = 1400.0, -8.0
+    rng = np.random.default_rng(11)
+    t = np.arange(nsamps)
+    rfi = 18.0 * ((((t * tsamp) / p_rfi) % 1.0) < 0.04)  # zero-DM train
+    freqs = fch1 + np.arange(nchans) * foff
+    delays = 4.148808e3 * dm_psr * (freqs**-2 - fch1**-2) / tsamp
+    paths = []
+    for b in range(nbeams):
+        data = rng.normal(32.0, 4.0, size=(nsamps, nchans))
+        data += rfi[:, None]  # the tone fires in EVERY beam
+        if b == 0:  # the pulsar lives in one beam only
+            for c in range(nchans):
+                phase = ((t - delays[c]) * tsamp / p_psr) % 1.0
+                data[:, c] += 10.0 * (phase < 0.03)
+        hdr = SigprocHeader(
+            source_name=f"BEAM{b}", tsamp=tsamp, tstart=55000.0, fch1=fch1,
+            foff=foff, nchans=nchans, nbits=8, nifs=1, data_type=1,
+        )
+        path = tmp_path / f"beam{b}.fil"
+        write_filterbank(path, Filterbank(
+            header=hdr, data=np.clip(np.rint(data), 0, 255).astype(np.uint8)
+        ))
+        paths.append(str(path))
+
+    # --- stage 1: coincidencer over the beams -> mask + birdie list ---
+    samp_out, spec_out = tmp_path / "rfi.eb_mask", tmp_path / "birdies.txt"
+    rc = coin_main(
+        [*paths, "--o", str(samp_out), "--o2", str(spec_out),
+         "--thresh", "4", "--beam_thresh", "4"]
+    )
+    assert rc == 0
+    mask = np.array(
+        [int(x) for x in samp_out.read_text().strip().splitlines()[1:]]
+    )
+    # the sample mask flags the pulse-train samples (multibeam in time)
+    assert mask.size == nsamps
+    on = rfi > 0
+    assert mask[on].mean() < 0.5 < mask[~on].mean()
+    birdies = np.loadtxt(spec_out)
+    assert birdies.ndim == 2 and len(birdies) >= 1
+    f_rfi = 1.0 / p_rfi
+    # some birdie row must cover the tone's fundamental
+    cover = np.abs(birdies[:, 0] - f_rfi) <= birdies[:, 1] / 2 + 0.5
+    assert cover.any(), birdies
+
+    # --- stage 2: peasoup search consuming the artifacts via -z/-k ---
+    killfile = tmp_path / "chans.kill"
+    killfile.write_text("1\n" * nchans)
+
+    def run(outname, zap):
+        outdir = tmp_path / outname
+        argv = [
+            "-i", paths[0], "-o", str(outdir), "--dm_end", "40",
+            "-n", "2", "--limit", "50", "-k", str(killfile),
+        ]
+        if zap:
+            argv += ["-z", str(spec_out)]
+        assert peasoup_main(argv) == 0
+        return OverviewFile(str(outdir / "overview.xml")).candidates
+
+    def near_tone(cands):
+        per = np.asarray([float(c["period"]) for c in cands])
+        return np.abs(1.0 / per - f_rfi) < 0.02 * f_rfi
+
+    control = run("out_nozap", zap=False)
+    assert near_tone(control).any(), "control must detect the planted tone"
+    zapped = run("out_zap", zap=True)
+    assert not near_tone(zapped).any(), "birdie zap must remove the tone"
+    # the pulsar (or a harmonic) survives the zap at ~the right DM; at
+    # this tiny tobs the DM response is broad, so the crowned tie
+    # member may sit anywhere in the cluster — some matching candidate
+    # must carry the true DM
+    best = zapped[0]
+    ratio = float(best["period"]) / p_psr
+    assert min(abs(ratio - r) for r in (0.25, 0.5, 1.0, 2.0, 4.0)) < 0.01
+    psr_dms = [
+        float(c["dm"])
+        for c in zapped
+        if min(
+            abs(float(c["period"]) / p_psr - r)
+            for r in (0.25, 0.5, 1.0, 2.0, 4.0)
+        ) < 0.01
+    ]
+    assert min(abs(d - dm_psr) for d in psr_dms) < 10.0, psr_dms

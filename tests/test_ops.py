@@ -713,3 +713,26 @@ class TestMatmulRFFT:
         np.testing.assert_array_equal(
             np.asarray(rfft(x)), np.asarray(jnp.fft.rfft(x))
         )
+
+
+def test_resample_select_packed_bitwise():
+    """resample_select_packed's planes are BITWISE the even/odd lanes
+    of resample_select (same clip-to-edge gather semantics)."""
+    import jax.numpy as jnp
+
+    from peasoup_tpu.ops.resample import (
+        resample_select, resample_select_packed,
+    )
+
+    rng = np.random.default_rng(7)
+    n, smax = 4096, 5
+    x = jnp.asarray(rng.normal(size=(3, n)).astype(np.float32))
+    afs = jnp.asarray(
+        np.asarray(
+            [[0.0, 2.3e-7, -2.3e-7, 1.1e-7]] * 3, dtype=np.float32
+        )
+    )
+    full = np.asarray(resample_select(x, afs, smax=smax))
+    ev, od = resample_select_packed(x, afs, smax=smax)
+    np.testing.assert_array_equal(np.asarray(ev), full[..., 0::2])
+    np.testing.assert_array_equal(np.asarray(od), full[..., 1::2])

@@ -398,3 +398,85 @@ class TestPallasDedisperse:
         for lo in range(0, delays.shape[0], _DT):
             blk = delays[lo : lo + _DT]
             assert int((blk.max(0) - blk.min(0)).max()) <= s
+
+
+class TestPallasInterbin:
+    """Fused untwist+interbin+normalise kernel (ops/pallas/interbin.py)
+    vs the jnp twin chain (packed matmul rfft parts -> interbin ->
+    normalise), interpret mode.
+
+    On-TPU the kernel is gated BITWISE (probe_pallas_interbin measured
+    0 differing bins on v5e); under CPU interpret mode XLA:CPU's FMA
+    contraction rounds the same formulas differently per fusion, so
+    this oracle asserts last-ULP closeness instead."""
+
+    def _case(self, r, n, block, seed=0):
+        import jax.numpy as jnp
+
+        from peasoup_tpu.ops.fft import (
+            packed_dft_z, rfft_pow2_matmul_parts,
+        )
+        from peasoup_tpu.ops.pallas.interbin import (
+            untwist_interbin_normalise,
+        )
+        from peasoup_tpu.ops.spectrum import (
+            form_interpolated_parts, normalise,
+        )
+
+        rng = np.random.default_rng(seed)
+        m = n // 2
+        npad = (m // block + 1) * block
+        # a tone + noise so interbin's max() takes both branches
+        t = np.arange(n)
+        x = rng.normal(size=(r, n)) + 3.0 * np.sin(2 * np.pi * t * 0.1317)
+        x = jnp.asarray(x.astype(np.float32))
+        mean = jnp.asarray(rng.normal(size=r).astype(np.float32))
+        std = jnp.asarray((0.5 + rng.random(r)).astype(np.float32))
+        zr, zi = packed_dft_z(x)
+        got = np.asarray(
+            untwist_interbin_normalise(
+                zr, zi, mean, std, npad=npad, block=block, interpret=True
+            )
+        )
+        ref = np.asarray(
+            normalise(
+                form_interpolated_parts(*rfft_pow2_matmul_parts(x)),
+                mean, std,
+            )
+        )
+        assert got.shape == (r, npad)
+        np.testing.assert_allclose(
+            got[:, : m + 1], ref, rtol=1e-5, atol=1e-5
+        )
+        # the vast majority of bins must still agree exactly — anything
+        # structural (shifted lanes, wrong carry, bad clamp) breaks far
+        # more than FMA-contraction ULPs
+        assert (got[:, : m + 1] == ref).mean() > 0.5
+        assert not got[:, m + 1 :].any()
+
+    def test_bitwise_vs_jnp_chain(self):
+        self._case(r=9, n=1 << 14, block=1024)
+
+    def test_row_padding_and_multi_stripe(self):
+        # r not a multiple of 8 exercises the row-pad path (std pads
+        # with ones so no 0/0 NaNs leak); 17 rows = 3 stripes
+        self._case(r=17, n=1 << 14, block=2048, seed=3)
+
+    def test_block_equals_m_over_two(self):
+        # two z blocks + one pure-pad block past the Nyquist
+        self._case(r=8, n=1 << 13, block=2048, seed=5)
+
+    def test_geometry_validation(self):
+        import jax.numpy as jnp
+        import pytest
+
+        from peasoup_tpu.ops.pallas.interbin import (
+            untwist_interbin_normalise,
+        )
+
+        z = jnp.zeros((8, 4096), jnp.float32)
+        v = jnp.ones((8,), jnp.float32)
+        with pytest.raises(ValueError):
+            untwist_interbin_normalise(z, z, v, v, npad=4096, block=4096)
+        with pytest.raises(ValueError):
+            untwist_interbin_normalise(z, z, v, v, npad=8192, block=2560)
