@@ -78,9 +78,9 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--no_accel_dedupe", action="store_true",
-        help="dispatch every accel trial even when its resample is "
-        "provably the identity (the dedupe is bitwise-output-equal; "
-        "this flag exists for timing comparisons)",
+        help="dispatch every accel trial even when trials provably "
+        "share their entire rounded resample-shift map (the dedupe is "
+        "bitwise-output-equal; this flag exists for timing comparisons)",
     )
     return p
 

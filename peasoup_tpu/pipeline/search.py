@@ -86,9 +86,10 @@ class SearchConfig:
     subband_smear: float = 1.0  # max extra smear (samples) a trial may
     # suffer from sharing its group's nominal DM (0 = exact)
     accel_bucket: int = 16  # accel batch padded to a multiple of this
-    dedupe_accel: bool = True  # collapse accel trials whose resample is
-    # provably the identity into one dispatched representative
-    # (bitwise-identical output, device work / identity-class size)
+    dedupe_accel: bool = True  # collapse accel trials whose entire
+    # rounded resample-shift maps provably coincide (identity or not)
+    # into one dispatched representative per equivalence class
+    # (bitwise-identical output, device work / class size)
     hbm_bytes: int = 0  # device memory budget override; 0 = ask the
     # device (memory_stats), falling back to the 12 GB v5e-ish default
     # — set this on chips that report no limit (or via the
@@ -229,62 +230,100 @@ def _accel_pad(n: int, bucket: int) -> int:
 def _dedupe_identity_accels(
     accel_lists, tsamp: float, size: int
 ) -> tuple[list, list]:
-    """Collapse accel trials whose resample is provably the IDENTITY
-    into one representative per DM.
+    """Collapse accel trials whose resamples are provably BITWISE
+    EQUAL into one representative per equivalence class per DM.
 
     resample reads src = i + rn(af * quad(i)) with quad and the product
-    each rounded once to f32 (ops/resample.py). rn is monotone, so
-    every shift is 0 exactly when |f32(af * max|quad|)| <= 0.5
-    (rn(0.5) = 0 under round-half-even) — the resampled series is then
-    BITWISE the input, and
-    every such trial's spectrum, peaks, and candidates are bitwise
-    identical. Searching one representative and replicating its results
+    each rounded once to f32 (ops/resample.py; shift-then-add — the
+    bitwise claim depends on that formulation). Two trials whose entire
+    rounded SHIFT MAPS i -> rn(f32(af)*quad[i]) coincide read identical
+    sources, so their spectra, peaks, and candidates are bitwise
+    identical; searching one representative and replicating its results
     host-side (_expand_accel_results) is output-identical to brute
-    force while cutting device work by the identity-class size — e.g.
-    the whole +-5 m/s^2 tutorial grid is one class at 2^17 samples.
+    force. The IDENTITY class (map == 0 everywhere, exactly when
+    |f32(af * max|quad|)| <= 0.5 by rn's monotonicity — rn(0.5) = 0
+    under round-half-even) is the common case (the whole +-5 m/s^2
+    tutorial grid at 2^17 samples), handled without building maps.
+
+    Class detection (r4, VERDICT item 9): quad <= 0 everywhere, so
+    maps are pointwise monotone in af and classes are CONTIGUOUS in
+    af-sorted order — adjacent-pair comparison finds them all. Exact
+    screens keep it cheap: equal f32 afs share a map trivially;
+    differing rints at the max-|quad| bin mean the maps differ there
+    (rint is odd, so rint(af*max|quad|) determines that bin's value);
+    and a 64-point strided probe of the maps rejects most remaining
+    unequal pairs before the full O(size) compare.
 
     Returns (dispatch_lists, expand_maps): expand_maps[dm] is None when
     nothing deduped, else an int array mapping each FULL accel index to
     its dispatch-list index.
     """
-    # EXACT identity criterion (no heuristic margin): resample computes
-    # shift = rn(f32(af) * quad) with quad = f32(i)*(f32(i) - f32(size))
-    # and ADDS the rounded shift to the integer index (shift-then-add —
-    # the bitwise claim depends on that formulation; rn(i + s) would
-    # need a different bound).  rn is monotone, so every shift rounds
-    # to 0 iff it does at max|quad|: |f32(af * max|quad|)| <= 0.5
-    # (round-half-even sends exactly 0.5 to 0).  max|quad| is taken
-    # over the f32-ROUNDED quad values, evaluated exactly below.
     max_abs_quad = _max_abs_quad_f32(size)
     dispatch_lists: list = []
     expand_maps: list = []
     max_ident_af = np.float32(0.0)
     for accs in accel_lists:
-        afs = accel_factor(np.asarray(accs), tsamp)
-        prod = np.abs(afs.astype(np.float32) * max_abs_quad)  # one f32 rn
-        ident = prod <= np.float32(0.5)
-        if ident.any():
-            max_ident_af = max(
-                max_ident_af, np.abs(afs.astype(np.float32))[ident].max()
-            )
-        if ident.sum() <= 1:
+        n = len(accs)
+        afs32 = accel_factor(np.asarray(accs), tsamp).astype(np.float32)
+        if n <= 1:
             dispatch_lists.append(accs)
             expand_maps.append(None)
             continue
-        rep = int(np.nonzero(ident)[0][0])
-        keep = [i for i in range(len(accs)) if i == rep or not ident[i]]
+        prods = afs32 * max_abs_quad  # one f32 rounding each
+        if (np.abs(prods) <= np.float32(0.5)).all():
+            # whole list is the identity class: no maps needed
+            class_of = np.zeros(n, dtype=np.int64)
+            max_ident_af = max(max_ident_af, np.abs(afs32).max())
+        else:
+            quad = _quad_f32(size)
+            probe = quad[:: max(1, size // 64)]
+            rmax = np.rint(prods)  # the (negated) map value at max|quad|
+            order = np.argsort(afs32, kind="stable")
+            class_of = np.empty(n, dtype=np.int64)
+            cid = -1
+            prev_j = -1
+            prev_map = None
+            for j in order:
+                if prev_j < 0:
+                    new = True
+                elif afs32[j] == afs32[prev_j]:
+                    new = False
+                elif rmax[j] != rmax[prev_j] or not np.array_equal(
+                    np.rint(afs32[j] * probe), np.rint(afs32[prev_j] * probe)
+                ):
+                    new = True
+                    prev_map = None
+                else:
+                    if prev_map is None:
+                        prev_map = np.rint(afs32[prev_j] * quad)
+                    cur = np.rint(afs32[j] * quad)
+                    new = not np.array_equal(cur, prev_map)
+                    prev_map = cur
+                if new:
+                    cid += 1
+                class_of[j] = cid
+                prev_j = j
+        # representative = FIRST member (original order) of each class
+        first_of: dict[int, int] = {}
+        for i in range(n):
+            first_of.setdefault(int(class_of[i]), i)
+        if len(first_of) == n:
+            dispatch_lists.append(accs)
+            expand_maps.append(None)
+            continue
+        keep = sorted(first_of.values())
         pos = {full_i: j for j, full_i in enumerate(keep)}
         expand_maps.append(
             np.asarray(
-                [pos.get(i, pos[rep]) for i in range(len(accs))],
+                [pos[first_of[int(class_of[i])]] for i in range(n)],
                 dtype=np.int64,
             )
         )
         dispatch_lists.append(np.asarray([accs[i] for i in keep]))
     if max_ident_af > 0:
-        # belt-and-braces: replay the device's exact shift chain for the
-        # LARGEST deduped |af| (monotonicity covers the rest) and verify
-        # every rounded shift is zero
+        # belt-and-braces for the map-free identity fast path: replay
+        # the device's exact shift chain for the LARGEST deduped |af|
+        # (monotonicity covers the rest) and verify every shift is zero
         shifts = np.rint(max_ident_af * _quad_f32(size))
         assert not shifts.any(), (
             f"identity-dedupe invariant violated: af={max_ident_af!r} "
@@ -311,7 +350,7 @@ def _max_abs_quad_f32(size: int) -> np.float32:
 
 def _expand_accel_results(vi, vs, cc, emap, padded_full):
     """Replicate a deduped dispatch's ragged per-(lvl, accel) results
-    onto the full accel list (identity trials share their
+    onto the full accel list (map-equivalent trials share their
     representative's spectrum bitwise). Stream cell order is C-order
     over (nlev, padded) — lvl-major — matching the device pack.
     Vectorised: one fancy-index gather, no per-cell Python loop."""
@@ -673,8 +712,8 @@ class PeasoupSearch:
             n_disp = sum(len(a) for a in dispatch_lists)
             print(
                 f"accel dedupe: {n_disp}/{n_full} distinct resamplings "
-                "dispatched (identity trials share their "
-                "representative's spectrum bitwise)"
+                "dispatched (trials with coinciding rounded shift maps "
+                "share their representative's spectrum bitwise)"
             )
         bucket = cfg.accel_bucket
         by_bucket: dict[int, list[int]] = {}
