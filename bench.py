@@ -163,7 +163,7 @@ def bench_survey() -> int:
     def cfg(**kw):
         return SearchConfig(
             dm_end=SURVEY_DM_END, acc_start=0.0, acc_end=0.0,
-            nharmonics=4, npdmp=0, limit=100,
+            nharmonics=4, npdmp=10, limit=100,
             subbands=32, subband_smear=1.0,
             hbm_bytes=1_000_000_000,  # forces the host-spill trials path
             checkpoint_file=ckpt, **kw,
@@ -176,9 +176,11 @@ def bench_survey() -> int:
     wall = time.time() - t0
     t_search = res.timers["searching"]
     t_dedisp = res.timers["dedispersion"]
+    t_fold = res.timers.get("folding", 0.0)
     print(
         f"survey: {ndm} DM trials, dedisp {t_dedisp:.2f}s, search "
-        f"{t_search:.2f}s, wall {wall:.2f}s (first run incl. compile)",
+        f"{t_search:.2f}s, fold {t_fold:.2f}s (npdmp=10), wall "
+        f"{wall:.2f}s (first run incl. compile)",
         file=sys.stderr,
     )
     # resume: a fresh driver restores every trial from the checkpoint
@@ -211,6 +213,14 @@ def bench_survey() -> int:
                     "samples (subband+spill+checkpoint, dedisp+search)"
                 ),
                 "vs_baseline": 0.0,
+                "detail": {
+                    "ndm": ndm,
+                    "dedisp_s": round(t_dedisp, 2),
+                    "search_s": round(t_search, 2),
+                    "fold_s": round(t_fold, 2),
+                    "wall_s": round(wall, 2),
+                    "resume_search_s": round(t_resume, 2),
+                },
             }
         )
     )

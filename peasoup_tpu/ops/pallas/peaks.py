@@ -70,9 +70,18 @@ _BLOCK = PEAKS_BLOCK
 _SUB = int(_os.environ.get("PEASOUP_PEAKS_SUB", "8"))
 if _SUB <= 0 or _SUB % 8:
     raise ValueError(f"PEASOUP_PEAKS_SUB must be a positive multiple of 8: {_SUB}")
-# crossing-walk subblock width (lanes): full _BLOCK when it doesn't
-# divide evenly (tiny tuning blocks), else 512
-_SBW = 512 if _BLOCK % 512 == 0 else _BLOCK
+# crossing-walk subblock width (lanes). r3 chose 512 to shrink
+# per-TRIP vector work; with the r4 window-merged walk trips are few
+# and the per-SUBBLOCK guards (a sum reduction + scalar branch each,
+# x nlev per grid step) dominate instead, so the default is now the
+# full block (one guard per level per step; measured 41.1 -> 35.5 ms
+# at the dense tutorial grid).
+_SBW = int(_os.environ.get("PEASOUP_PEAKS_SBW", "0")) or _BLOCK
+if _SBW <= 0 or _SBW % 128 or _BLOCK % _SBW:
+    raise ValueError(
+        "PEASOUP_PEAKS_SBW must be a positive multiple of 128 dividing "
+        f"PEASOUP_PEAKS_BLOCK: {_SBW}"
+    )
 # unrolled machine steps per while-loop trip (the walk is trip-latency
 # bound; each step is one close/emit + one window merge); must be >= 1
 # or the walk loop would never clear crossings (infinite device loop)
@@ -155,7 +164,14 @@ def _kernel_multi(*refs, nlev, mx, nbins, threshold, min_gap, scales):
                 mask_sb = mask[:, lo_l : lo_l + _SBW]
                 gidx_sb = gidx[:, lo_l : lo_l + _SBW]
                 s_sb = s[:, lo_l : lo_l + _SBW]
-                tot_sb = jnp.sum(mask_sb.astype(jnp.int32))
+                # at full-block _SBW the enclosing cnt guard already
+                # established crossings exist: reuse its (cheaper,
+                # lane-reduced) sum instead of a second mask reduction
+                tot_sb = (
+                    jnp.sum(cnt)
+                    if _SBW == _BLOCK
+                    else jnp.sum(mask_sb.astype(jnp.int32))
+                )
 
                 @pl.when(tot_sb > 0)
                 def _(mask_sb=mask_sb, gidx_sb=gidx_sb, s_sb=s_sb,
