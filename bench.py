@@ -187,9 +187,11 @@ def bench_survey() -> int:
     t0 = time.time()
     res2 = PeasoupSearch(cfg()).run(fil)
     t_resume = res2.timers["searching"]
+    t_fold_warm = res2.timers.get("folding", 0.0)
     print(
-        f"survey resume: search {t_resume:.2f}s (restored from "
-        f"checkpoint; first search was {t_search:.2f}s)",
+        f"survey resume: search {t_resume:.2f}s, fold {t_fold_warm:.2f}s "
+        f"warm (restored from checkpoint; first search was "
+        f"{t_search:.2f}s)",
         file=sys.stderr,
     )
     top = res.candidates[0]
@@ -218,6 +220,7 @@ def bench_survey() -> int:
                     "dedisp_s": round(t_dedisp, 2),
                     "search_s": round(t_search, 2),
                     "fold_s": round(t_fold, 2),
+                    "fold_warm_s": round(t_fold_warm, 2),
                     "wall_s": round(wall, 2),
                     "resume_search_s": round(t_resume, 2),
                 },

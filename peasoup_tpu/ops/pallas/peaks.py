@@ -166,14 +166,20 @@ def _kernel_multi(*refs, nlev, mx, nbins, threshold, min_gap, scales):
                 s_sb = s[:, lo_l : lo_l + _SBW]
                 # at full-block _SBW the enclosing cnt guard already
                 # established crossings exist: reuse its (cheaper,
-                # lane-reduced) sum instead of a second mask reduction
+                # lane-reduced) sum as the loop seed and drop the
+                # (always-true) inner guard entirely at trace time
                 tot_sb = (
                     jnp.sum(cnt)
                     if _SBW == _BLOCK
                     else jnp.sum(mask_sb.astype(jnp.int32))
                 )
+                guard = (
+                    (lambda f: f())
+                    if _SBW == _BLOCK
+                    else pl.when(tot_sb > 0)
+                )
 
-                @pl.when(tot_sb > 0)
+                @guard
                 def _(mask_sb=mask_sb, gidx_sb=gidx_sb, s_sb=s_sb,
                       tot_sb=tot_sb, lo_l=lo_l, emit=emit, c0=c0):
                     def body(rem):
