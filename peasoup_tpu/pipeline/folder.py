@@ -105,7 +105,24 @@ class MultiFolder:
             if 0 <= local_dm < ndm_local:
                 dm_map.setdefault(local_dm, []).append(ii)
 
+        # pipelined dispatch: enqueue DM groups' deredden+resample+fold
+        # chains ahead of their fetches — on a high-latency link the
+        # first D2H absorbs the whole in-flight pipeline and the rest
+        # are nearly free, instead of one full round trip per DM group.
+        # The window is BOUNDED so peak HBM stays a few groups' worth
+        # of intermediates (each ~K_pad x nsamps f32); an unbounded
+        # queue could exhaust device memory at survey scale, and the
+        # search driver's OOM shrink-retry does not cover the folder.
+        max_inflight = 4
+        pending = []
         all_folds, all_periods, all_cand_idx = [], [], []
+
+        def drain_one():
+            folds, k, periods, cand_ids = pending.pop(0)
+            all_folds.append(np.asarray(folds)[:k])
+            all_periods.extend(periods[:k])
+            all_cand_idx.extend(cand_ids)
+
         for dm_idx, cand_ids in dm_map.items():
             xd = _deredden_tim(
                 jnp.asarray(self.trials[dm_idx]),
@@ -143,9 +160,11 @@ class MultiFolder:
                 nbins=self.nbins,
                 nints=self.nints,
             )
-            all_folds.append(np.asarray(folds)[:k])
-            all_periods.extend(periods[:k])
-            all_cand_idx.extend(cand_ids)
+            pending.append((folds, k, periods, cand_ids))
+            if len(pending) >= max_inflight:
+                drain_one()
+        while pending:
+            drain_one()
 
         if not all_cand_idx:
             return []
