@@ -222,6 +222,92 @@ def probe_pallas_interbin(size: int, block: int) -> bool:
         return False
 
 
+@lru_cache(maxsize=None)
+def probe_pallas_harmpeaks(nbins: int, nharms: int, max_peaks: int) -> bool:
+    """REAL compile+run probe of the harmonic+peaks mega-kernel
+    (ops/pallas/harmpeaks.py) at the production bin count, oracle-
+    checked BITWISE against harmonic_sums(method="take") + the jnp
+    find_peaks_device/cluster_peaks_device pair: the kernel's one-hot
+    MXU gathers and in-VMEM accumulation replay exactly the same f32
+    chain, so any difference means a broken lowering (bad stream index
+    map, inexact dot, mis-sliced window)."""
+    if not backend_supports_pallas():
+        return False
+    try:
+        import numpy as np
+        import jax.numpy as jnp
+
+        from .harmpeaks import find_harmonic_cluster_peaks
+        from .peaks import PEAKS_BLOCK
+        from ..harmonics import harmonic_sums
+        from ..peaks import cluster_peaks_device, find_peaks_device
+
+        nlev = nharms + 1
+        rng = np.random.default_rng(0)
+        # sub-threshold noise + planted combs (see probe_pallas_peaks);
+        # values vary across the full spectrum so every stream's gather
+        # path is data-sensitive
+        s = np.abs(rng.normal(size=(9, nbins))).astype(np.float32)
+        s[::3, :: max(1, nbins // 97)] += 30.0
+        s[1, nbins // 2 : nbins // 2 + 400 : 4] += 20.0
+        lo, hi = nbins // 10, nbins - nbins // 16
+        windows = np.tile(np.asarray([[lo, hi]], np.int32), (nlev, 1))
+        npad = -(-nbins // PEAKS_BLOCK) * PEAKS_BLOCK
+        # pad region: huge garbage, like the production fused path can
+        # carry past the true bins — must be masked by the hi clamp
+        sp = jnp.asarray(
+            np.pad(s, ((0, 0), (0, npad - nbins)), constant_values=1e9)
+        )
+        scales = tuple(
+            1.0 if lv == 0 else 2.0 ** (-lv / 2.0) for lv in range(nlev)
+        )
+        ci, cs, rc, cc = find_harmonic_cluster_peaks(
+            sp, jnp.asarray(windows), nharms=nharms, threshold=9.0,
+            max_peaks=max_peaks, scales=scales, nbins=nbins,
+        )
+        ci, cs, rc, cc = map(np.asarray, (ci, cs, rc, cc))
+        levels = [jnp.asarray(s)] + harmonic_sums(
+            jnp.asarray(s), nharms=nharms, method="take", scaled=True
+        )
+        ok = True
+        for lv in range(nlev):
+            if not ok:
+                break
+            i_, s_, c_ = find_peaks_device(
+                levels[lv], jnp.float32(9.0), jnp.int32(lo), jnp.int32(hi),
+                max_peaks=1 << 14,
+            )
+            ji, js, jc = cluster_peaks_device(i_, s_, jnp.int32(nbins))
+            ji, js, jc, c_ = map(np.asarray, (ji, js, jc, c_))
+            ok = np.array_equal(rc[:, lv], c_) and np.array_equal(
+                cc[:, lv], jc
+            )
+            for r in range(s.shape[0]):
+                if not ok:
+                    break
+                k = min(int(jc[r]), max_peaks)
+                ok = np.array_equal(
+                    ci[r, lv, :k], ji[r, :k]
+                ) and np.array_equal(cs[r, lv, :k], js[r, :k])
+        if not ok:
+            import warnings
+
+            warnings.warn(
+                f"Pallas harmonic+peaks mega-kernel FAILED the bitwise "
+                f"oracle check at nbins={nbins}; using the conv+peaks path"
+            )
+        return ok
+    except Exception as exc:  # any Mosaic/compile failure -> conv path
+        import warnings
+
+        warnings.warn(
+            f"Pallas harmonic+peaks mega-kernel unavailable at "
+            f"nbins={nbins}; using the conv+peaks path: "
+            f"{type(exc).__name__}: {exc}"
+        )
+        return False
+
+
 from .resample import resample_block_pallas, resample_block  # noqa: E402
 
 

@@ -91,13 +91,171 @@ if _WSTEPS < 1:
 _BIG = 1 << 30  # "no crossing" sentinel for the masked min reduction
 
 
+def _level_machine(
+    lvl, s, *, win_ref, idx_ref, snr_ref, cnt_ref, istate, fstate, mstate,
+    b, nb, gidx, slot, mx, threshold, min_gap, scale,
+):
+    """One harmonic level's threshold + identify_unique_peaks walk for
+    the current (stripe, block) grid step. ``s`` is the level's (VMEM-
+    resident) value block — loaded from an operand by the peaks kernel,
+    computed in VMEM by the harmonic mega-kernel (harmpeaks.py). State
+    lives in shared scratch columns [lvl*8, lvl*8+5); outputs go to
+    slices [lvl*mx, (lvl+1)*mx) of idx/snr and [2*lvl, 2*lvl+2) of
+    cnt."""
+    c0 = lvl * 8  # this level's state column base
+    o0, o1 = lvl * mx, (lvl + 1) * mx
+    lo = win_ref[lvl, 0]
+    hi = win_ref[lvl, 1]
+    if scale != 1.0:
+        s = s * jnp.float32(scale)
+    mask = (gidx >= lo) & (gidx < hi) & (s > jnp.float32(threshold))
+    cnt = jnp.sum(mask.astype(jnp.int32), axis=1, keepdims=True)
+    istate[:, c0 + 1 : c0 + 2] = istate[:, c0 + 1 : c0 + 2] + cnt
+
+    def emit(do, cursor, cpeakidx, cpeak):
+        hot = do & (slot == cursor) & (cursor < mx)
+        idx_ref[:, o0:o1] = jnp.where(hot, cpeakidx, idx_ref[:, o0:o1])
+        snr_ref[:, o0:o1] = jnp.where(hot, cpeak, snr_ref[:, o0:o1])
+
+    @pl.when(jnp.max(cnt) > 0)
+    def _(mask=mask, s=s, emit=emit, c0=c0):
+        mstate[:] = mask.astype(jnp.int32)
+
+        # walk the block's crossings SUBBLOCK by subblock (left to
+        # right, so the cluster machine sees the same ascending
+        # crossing sequence). All slices are STATIC (python
+        # unroll), so no dynamic lane indexing reaches Mosaic.
+        #
+        # WINDOW-MERGED walk (r4): the walk is TRIP-LATENCY-bound
+        # (~8.7 us/trip regardless of vector width — r3 measured
+        # subblock shrinking and block-size scans flat), so the
+        # lever is trip COUNT. Each trip processes the first
+        # remaining crossing through the full close/emit/take
+        # machine, then MERGES every further crossing j in the
+        # close-free window (idx, lastidx' + min_gap) in one vector
+        # step: for such j, close cannot fire (lastidx only
+        # advances, so j - lastidx_at_j < min_gap), and a close-free
+        # sequence of takes reduces to "final cpeak = max(cpeak,
+        # window max); lastidx/cpeakidx move to the FIRST position
+        # of the window max iff it strictly beats cpeak" — exactly
+        # the identify_unique_peaks quirk (lastidx advances only on
+        # new max, peakfinder.hpp:27-56), because intermediate
+        # non-emitting takes leave no other trace. A contiguous
+        # ~min_gap-wide cluster run collapses from ~30 trips to ~2.
+        for lo_l in range(0, _BLOCK, _SBW):
+            mask_sb = mask[:, lo_l : lo_l + _SBW]
+            gidx_sb = gidx[:, lo_l : lo_l + _SBW]
+            s_sb = s[:, lo_l : lo_l + _SBW]
+            # at full-block _SBW the enclosing cnt guard already
+            # established crossings exist: reuse its (cheaper,
+            # lane-reduced) sum as the loop seed and drop the
+            # (always-true) inner guard entirely at trace time
+            tot_sb = (
+                jnp.sum(cnt)
+                if _SBW == _BLOCK
+                else jnp.sum(mask_sb.astype(jnp.int32))
+            )
+            guard = (
+                (lambda f: f())
+                if _SBW == _BLOCK
+                else pl.when(tot_sb > 0)
+            )
+
+            @guard
+            def _(mask_sb=mask_sb, gidx_sb=gidx_sb, s_sb=s_sb,
+                  tot_sb=tot_sb, lo_l=lo_l, emit=emit, c0=c0):
+                def body(rem):
+                    msk = mstate[:, lo_l : lo_l + _SBW] > 0
+                    cursor = istate[:, c0 : c0 + 1]
+                    open_ = istate[:, c0 + 2 : c0 + 3]
+                    cpeakidx = istate[:, c0 + 3 : c0 + 4]
+                    lastidx = istate[:, c0 + 4 : c0 + 5]
+                    cpeak = fstate[:, c0 : c0 + 1]
+                    # _WSTEPS unrolled machine steps per trip: the
+                    # loop is trip-latency-bound, so more vector
+                    # work per trip is nearly free
+                    for _ in range(_WSTEPS):
+                        idx = jnp.min(
+                            jnp.where(msk, gidx_sb, jnp.int32(_BIG)),
+                            axis=1, keepdims=True,
+                        )
+                        act = idx < jnp.int32(_BIG)
+                        snr = jnp.max(
+                            jnp.where(
+                                msk & (gidx_sb == idx), s_sb, -jnp.inf
+                            ),
+                            axis=1,
+                            keepdims=True,
+                        )
+                        close = (
+                            act
+                            & (open_ == 1)
+                            & (idx - lastidx >= min_gap)
+                        )
+                        emit(close, cursor, cpeakidx, cpeak)
+                        cursor = jnp.where(close, cursor + 1, cursor)
+                        start = act & ((open_ == 0) | close)
+                        take = start | (act & (snr > cpeak))
+                        cpeakidx = jnp.where(take, idx, cpeakidx)
+                        lastidx = jnp.where(take, idx, lastidx)
+                        cpeak = jnp.where(take, snr, cpeak)
+                        open_ = jnp.where(act, 1, open_)
+                        # close-free window past the first element:
+                        # one masked max + first-argmax stands in
+                        # for every crossing the sequential machine
+                        # could only take, never close on
+                        wmask = (
+                            msk
+                            & (gidx_sb > idx)
+                            & (gidx_sb < lastidx + jnp.int32(min_gap))
+                        )
+                        wmax = jnp.max(
+                            jnp.where(wmask, s_sb, -jnp.inf),
+                            axis=1, keepdims=True,
+                        )
+                        wfirst = jnp.min(
+                            jnp.where(
+                                wmask & (s_sb == wmax), gidx_sb,
+                                jnp.int32(_BIG),
+                            ),
+                            axis=1, keepdims=True,
+                        )
+                        wtake = act & (wmax > cpeak)
+                        cpeakidx = jnp.where(wtake, wfirst, cpeakidx)
+                        lastidx = jnp.where(wtake, wfirst, lastidx)
+                        cpeak = jnp.where(wtake, wmax, cpeak)
+                        msk = msk & ~((gidx_sb == idx) | wmask)
+                    nst = msk.astype(jnp.int32)
+                    mstate[:, lo_l : lo_l + _SBW] = nst
+                    istate[:, c0 : c0 + 1] = cursor
+                    istate[:, c0 + 2 : c0 + 3] = open_
+                    istate[:, c0 + 3 : c0 + 4] = cpeakidx
+                    istate[:, c0 + 4 : c0 + 5] = lastidx
+                    fstate[:, c0 : c0 + 1] = cpeak
+                    return jnp.sum(nst)
+
+                jax.lax.while_loop(lambda rem: rem > 0, body, tot_sb)
+
+    @pl.when(b == nb - 1)
+    def _(emit=emit, c0=c0, lvl=lvl):
+        open_ = istate[:, c0 + 2 : c0 + 3]
+        emit(
+            open_ == 1, istate[:, c0 : c0 + 1],
+            istate[:, c0 + 3 : c0 + 4], fstate[:, c0 : c0 + 1],
+        )
+        cnt_ref[:, 2 * lvl : 2 * lvl + 1] = istate[:, c0 + 1 : c0 + 2]
+        cnt_ref[:, 2 * lvl + 1 : 2 * lvl + 2] = (
+            istate[:, c0 : c0 + 1] + open_
+        )
+
+
 def _kernel_multi(*refs, nlev, mx, nbins, threshold, min_gap, scales):
     """All nlev levels' threshold+cluster machines in ONE grid walk:
     each (stripe, block) step streams every level's block and runs nlev
-    independent identify_unique_peaks machines, state packed per level
-    in shared VMEM scratch (columns [lvl*8, lvl*8+5)). One kernel
-    dispatch and one fifth the grid steps of the per-level version —
-    the per-step DMA latency was the dominant cost, not the bytes."""
+    independent identify_unique_peaks machines via the shared
+    _level_machine. One kernel dispatch and one fifth the grid steps of
+    the per-level version — the per-step DMA latency was the dominant
+    cost, not the bytes."""
     win_ref = refs[0]
     s_refs = refs[1 : 1 + nlev]
     idx_ref, snr_ref, cnt_ref = refs[1 + nlev : 4 + nlev]
@@ -116,155 +274,12 @@ def _kernel_multi(*refs, nlev, mx, nbins, threshold, min_gap, scales):
     slot = jax.lax.broadcasted_iota(jnp.int32, (_SUB, mx), 1)
 
     for lvl in range(nlev):
-        c0 = lvl * 8  # this level's state column base
-        o0, o1 = lvl * mx, (lvl + 1) * mx
-        lo = win_ref[lvl, 0]
-        hi = win_ref[lvl, 1]
-        scale = scales[lvl]
-        s = (
-            s_refs[lvl][:]
-            if scale == 1.0
-            else s_refs[lvl][:] * jnp.float32(scale)
+        _level_machine(
+            lvl, s_refs[lvl][:], win_ref=win_ref, idx_ref=idx_ref,
+            snr_ref=snr_ref, cnt_ref=cnt_ref, istate=istate, fstate=fstate,
+            mstate=mstate, b=b, nb=nb, gidx=gidx, slot=slot, mx=mx,
+            threshold=threshold, min_gap=min_gap, scale=scales[lvl],
         )
-        mask = (gidx >= lo) & (gidx < hi) & (s > jnp.float32(threshold))
-        cnt = jnp.sum(mask.astype(jnp.int32), axis=1, keepdims=True)
-        istate[:, c0 + 1 : c0 + 2] = istate[:, c0 + 1 : c0 + 2] + cnt
-
-        def emit(do, cursor, cpeakidx, cpeak):
-            hot = do & (slot == cursor) & (cursor < mx)
-            idx_ref[:, o0:o1] = jnp.where(hot, cpeakidx, idx_ref[:, o0:o1])
-            snr_ref[:, o0:o1] = jnp.where(hot, cpeak, snr_ref[:, o0:o1])
-
-        @pl.when(jnp.max(cnt) > 0)
-        def _(mask=mask, s=s, emit=emit, c0=c0):
-            mstate[:] = mask.astype(jnp.int32)
-
-            # walk the block's crossings SUBBLOCK by subblock (left to
-            # right, so the cluster machine sees the same ascending
-            # crossing sequence). All slices are STATIC (python
-            # unroll), so no dynamic lane indexing reaches Mosaic.
-            #
-            # WINDOW-MERGED walk (r4): the walk is TRIP-LATENCY-bound
-            # (~8.7 us/trip regardless of vector width — r3 measured
-            # subblock shrinking and block-size scans flat), so the
-            # lever is trip COUNT. Each trip processes the first
-            # remaining crossing through the full close/emit/take
-            # machine, then MERGES every further crossing j in the
-            # close-free window (idx, lastidx' + min_gap) in one vector
-            # step: for such j, close cannot fire (lastidx only
-            # advances, so j - lastidx_at_j < min_gap), and a close-free
-            # sequence of takes reduces to "final cpeak = max(cpeak,
-            # window max); lastidx/cpeakidx move to the FIRST position
-            # of the window max iff it strictly beats cpeak" — exactly
-            # the identify_unique_peaks quirk (lastidx advances only on
-            # new max, peakfinder.hpp:27-56), because intermediate
-            # non-emitting takes leave no other trace. A contiguous
-            # ~min_gap-wide cluster run collapses from ~30 trips to ~2.
-            for lo_l in range(0, _BLOCK, _SBW):
-                mask_sb = mask[:, lo_l : lo_l + _SBW]
-                gidx_sb = gidx[:, lo_l : lo_l + _SBW]
-                s_sb = s[:, lo_l : lo_l + _SBW]
-                # at full-block _SBW the enclosing cnt guard already
-                # established crossings exist: reuse its (cheaper,
-                # lane-reduced) sum as the loop seed and drop the
-                # (always-true) inner guard entirely at trace time
-                tot_sb = (
-                    jnp.sum(cnt)
-                    if _SBW == _BLOCK
-                    else jnp.sum(mask_sb.astype(jnp.int32))
-                )
-                guard = (
-                    (lambda f: f())
-                    if _SBW == _BLOCK
-                    else pl.when(tot_sb > 0)
-                )
-
-                @guard
-                def _(mask_sb=mask_sb, gidx_sb=gidx_sb, s_sb=s_sb,
-                      tot_sb=tot_sb, lo_l=lo_l, emit=emit, c0=c0):
-                    def body(rem):
-                        msk = mstate[:, lo_l : lo_l + _SBW] > 0
-                        cursor = istate[:, c0 : c0 + 1]
-                        open_ = istate[:, c0 + 2 : c0 + 3]
-                        cpeakidx = istate[:, c0 + 3 : c0 + 4]
-                        lastidx = istate[:, c0 + 4 : c0 + 5]
-                        cpeak = fstate[:, c0 : c0 + 1]
-                        # _WSTEPS unrolled machine steps per trip: the
-                        # loop is trip-latency-bound, so more vector
-                        # work per trip is nearly free
-                        for _ in range(_WSTEPS):
-                            idx = jnp.min(
-                                jnp.where(msk, gidx_sb, jnp.int32(_BIG)),
-                                axis=1, keepdims=True,
-                            )
-                            act = idx < jnp.int32(_BIG)
-                            snr = jnp.max(
-                                jnp.where(
-                                    msk & (gidx_sb == idx), s_sb, -jnp.inf
-                                ),
-                                axis=1,
-                                keepdims=True,
-                            )
-                            close = (
-                                act
-                                & (open_ == 1)
-                                & (idx - lastidx >= min_gap)
-                            )
-                            emit(close, cursor, cpeakidx, cpeak)
-                            cursor = jnp.where(close, cursor + 1, cursor)
-                            start = act & ((open_ == 0) | close)
-                            take = start | (act & (snr > cpeak))
-                            cpeakidx = jnp.where(take, idx, cpeakidx)
-                            lastidx = jnp.where(take, idx, lastidx)
-                            cpeak = jnp.where(take, snr, cpeak)
-                            open_ = jnp.where(act, 1, open_)
-                            # close-free window past the first element:
-                            # one masked max + first-argmax stands in
-                            # for every crossing the sequential machine
-                            # could only take, never close on
-                            wmask = (
-                                msk
-                                & (gidx_sb > idx)
-                                & (gidx_sb < lastidx + jnp.int32(min_gap))
-                            )
-                            wmax = jnp.max(
-                                jnp.where(wmask, s_sb, -jnp.inf),
-                                axis=1, keepdims=True,
-                            )
-                            wfirst = jnp.min(
-                                jnp.where(
-                                    wmask & (s_sb == wmax), gidx_sb,
-                                    jnp.int32(_BIG),
-                                ),
-                                axis=1, keepdims=True,
-                            )
-                            wtake = act & (wmax > cpeak)
-                            cpeakidx = jnp.where(wtake, wfirst, cpeakidx)
-                            lastidx = jnp.where(wtake, wfirst, lastidx)
-                            cpeak = jnp.where(wtake, wmax, cpeak)
-                            msk = msk & ~((gidx_sb == idx) | wmask)
-                        nst = msk.astype(jnp.int32)
-                        mstate[:, lo_l : lo_l + _SBW] = nst
-                        istate[:, c0 : c0 + 1] = cursor
-                        istate[:, c0 + 2 : c0 + 3] = open_
-                        istate[:, c0 + 3 : c0 + 4] = cpeakidx
-                        istate[:, c0 + 4 : c0 + 5] = lastidx
-                        fstate[:, c0 : c0 + 1] = cpeak
-                        return jnp.sum(nst)
-
-                    jax.lax.while_loop(lambda rem: rem > 0, body, tot_sb)
-
-        @pl.when(b == nb - 1)
-        def _(emit=emit, c0=c0, lvl=lvl):
-            open_ = istate[:, c0 + 2 : c0 + 3]
-            emit(
-                open_ == 1, istate[:, c0 : c0 + 1],
-                istate[:, c0 + 3 : c0 + 4], fstate[:, c0 : c0 + 1],
-            )
-            cnt_ref[:, 2 * lvl : 2 * lvl + 1] = istate[:, c0 + 1 : c0 + 2]
-            cnt_ref[:, 2 * lvl + 1 : 2 * lvl + 2] = (
-                istate[:, c0 : c0 + 1] + open_
-            )
 
 
 @lru_cache(maxsize=None)

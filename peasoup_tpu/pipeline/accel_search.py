@@ -72,6 +72,7 @@ def _preprocess_trial(tim, zapmask, *, size, nsamps_valid, pos5, pos25):
 def _spectra_and_peaks(
     xr, mean, std, windows, *, threshold, nharms, max_peaks, stack_axis,
     cluster=True, pallas_peaks=False, fused_interbin=False,
+    mega_harm=False,
 ):
     """Post-resample stage: batched rfft, interbin, normalise, harmonic
     sums, per-level peak compaction (pipeline_multi.cu:216-234), and —
@@ -123,6 +124,36 @@ def _spectra_and_peaks(
         else:
             s = form_interpolated(jnp.fft.rfft(xr, axis=-1))
             s = normalise(s, mean, std)
+    if mega_harm and pallas_peaks and cluster:
+        # harmonic summing FUSED into the peaks walk: one Pallas
+        # dispatch gathers, accumulates, scales, thresholds and
+        # clusters every level in VMEM (ops/pallas/harmpeaks.py) —
+        # no conv val-chain HBM round trips, no level arrays, no
+        # layout copies. Bitwise-equal outputs (probe-gated).
+        with jax.named_scope("Harmonic summing"):
+            from ..ops.pallas.harmpeaks import find_harmonic_cluster_peaks
+            from ..ops.pallas.peaks import PEAKS_BLOCK
+
+            npad = -(-nbins // PEAKS_BLOCK) * PEAKS_BLOCK
+            if s.shape[-1] != npad:
+                s = jnp.pad(
+                    s, [(0, 0)] * (s.ndim - 1) + [(0, npad - s.shape[-1])]
+                )
+            scales = (1.0,) + tuple(
+                2.0 ** (-h / 2.0) for h in range(1, nharms + 1)
+            )
+            i_, s_, c_, cc_ = find_harmonic_cluster_peaks(
+                s, windows, nharms=nharms, threshold=threshold,
+                max_peaks=max_peaks, scales=scales, nbins=nbins,
+            )
+        nb = s.ndim - 1  # batch rank
+        return AccelSearchPeaks(
+            idxs=jnp.moveaxis(i_, nb, stack_axis),
+            snrs=jnp.moveaxis(s_, nb, stack_axis),
+            counts=jnp.moveaxis(c_, nb, stack_axis),
+            ccounts=jnp.moveaxis(cc_, nb, stack_axis),
+        )
+
     # the fused kernel applies the per-level rsqrt(2^h) factor in VMEM
     # (one fewer full HBM pass per level); the jnp path scales here.
     # For the kernel path the levels also come back pre-padded to the
@@ -262,6 +293,7 @@ def search_block_core(
     cluster: bool = True,
     pallas_peaks: bool = False,
     fused_interbin: bool = False,
+    mega_harm: bool = False,
 ) -> AccelSearchPeaks:
     """Block-batched search: all per-DM preprocessing vmapped, then the
     (D, A) accel grid processed as single batched array programs. With
@@ -305,7 +337,7 @@ def search_block_core(
         xr, mean[:, None], std[:, None], windows,
         threshold=threshold, nharms=nharms, max_peaks=max_peaks,
         stack_axis=1, cluster=cluster, pallas_peaks=pallas_peaks,
-        fused_interbin=fused_interbin,
+        fused_interbin=fused_interbin, mega_harm=mega_harm,
     )
 
 
@@ -313,6 +345,7 @@ def search_block_core(
 def make_batched_search_fn(
     threshold: float, pallas_block: int = 0, select_smax: int = 0,
     pallas_peaks: bool = False, fused_interbin: bool = False,
+    mega_harm: bool = False,
 ):
     """Jitted (D, ...) -> (D, ...) search over a block of DM trials.
 
@@ -336,7 +369,7 @@ def make_batched_search_fn(
             nharms=nharms, max_peaks=max_peaks, pos5=pos5, pos25=pos25,
             pallas_block=pallas_block, select_smax=select_smax,
             cluster=cluster, pallas_peaks=pallas_peaks,
-            fused_interbin=fused_interbin,
+            fused_interbin=fused_interbin, mega_harm=mega_harm,
         )
 
     return search_dm_block

@@ -776,6 +776,21 @@ class PeasoupSearch:
             ):
                 fused_interbin = probe_pallas_interbin(size, PEAKS_BLOCK)
         self._fused_interbin = fused_interbin
+        # harmonic+peaks mega-kernel (ops/pallas/harmpeaks.py): fuses
+        # the whole harmonic-summing val chain AND the peaks walk into
+        # one VMEM-resident Pallas dispatch — removes the conv chain's
+        # HBM round trips and the conv->peaks layout copies. Gated on
+        # the bitwise compile+run oracle; PEASOUP_MEGA_HARM=0 restores
+        # the conv+peaks pair.
+        mega_harm = False
+        if pallas_peaks and os.environ.get("PEASOUP_MEGA_HARM", "1") != "0":
+            from ..ops.pallas import probe_pallas_harmpeaks
+
+            mega_harm = probe_pallas_harmpeaks(
+                size_spec, cfg.nharmonics,
+                max(cfg.max_peaks, self._learned_max_peaks) or cfg.max_peaks,
+            )
+        self._mega_harm = mega_harm
 
         # --- search-side mesh wiring (mesh chosen before dedispersion) --
         if mesh is not None:
@@ -788,6 +803,7 @@ class PeasoupSearch:
                     mesh, cfg.min_snr, axis="dm", pallas_block=pb,
                     select_smax=select_smax if pb == 0 else 0,
                     pallas_peaks=pp, fused_interbin=fused_interbin and pp,
+                    mega_harm=self._mega_harm and pp,
                 )
 
             # stage blocks directly onto the mesh (no hop through chip 0)
@@ -799,6 +815,7 @@ class PeasoupSearch:
                 return make_batched_search_fn(
                     cfg.min_snr, pb, select_smax if pb == 0 else 0,
                     pallas_peaks=pp, fused_interbin=fused_interbin and pp,
+                    mega_harm=self._mega_harm and pp,
                 )
 
             self._dm_sharding = None
@@ -1492,21 +1509,41 @@ class PeasoupSearch:
                 self._learned_max_peaks = max(
                     self._learned_max_peaks, max_peaks
                 )
+                # the redispatch below runs on the CURRENT active search
+                # block, which an earlier chunk's escalation may have
+                # degraded after this chunk was dispatched — resync the
+                # entry-local flag so the overflow semantics (raw counts
+                # for the jnp path, cluster counts for the kernels) and
+                # the probe gate match the block actually used
+                fused = getattr(self, "_pallas_peaks", False)
                 if fused:
-                    # the kernel was only oracle-probed at the startup
+                    # the kernels were only oracle-probed at the startup
                     # compaction size; re-probe the escalated shape and
-                    # degrade to the jnp path rather than running an
-                    # unvalidated kernel
-                    from ..ops.pallas import probe_pallas_peaks
+                    # degrade (mega-kernel -> conv+peaks -> jnp) rather
+                    # than running an unvalidated kernel
+                    from ..ops.pallas import (
+                        probe_pallas_harmpeaks, probe_pallas_peaks,
+                    )
 
-                    if not probe_pallas_peaks(
+                    mega_was = getattr(self, "_mega_harm", False)
+                    if mega_was and not probe_pallas_harmpeaks(
+                        self._peaks_probe_nbins, self._peaks_probe_nlev - 1,
+                        max_peaks,
+                    ):
+                        self._mega_harm = False
+                    if not getattr(
+                        self, "_mega_harm", False
+                    ) and not probe_pallas_peaks(
                         self._peaks_probe_nbins, self._peaks_probe_nlev,
                         max_peaks,
                     ):
                         fused = False
                         self._pallas_peaks = False
+                    if not fused or mega_was != getattr(
+                        self, "_mega_harm", False
+                    ):
                         search_block = self._build_search(
-                            self._cur_pallas_block, False
+                            self._cur_pallas_block, fused
                         )
                         self._active_search_block = search_block
                         args = args[:5] + (search_block,)

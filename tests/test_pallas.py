@@ -335,6 +335,109 @@ class TestPeaksPaddedLevels:
         assert (ci[:, 0, 1:] == nbins).all()  # TRUE-nbins sentinel
 
 
+class TestHarmPeaks:
+    """Interpret-mode parity of the harmonic+peaks mega-kernel
+    (ops/pallas/harmpeaks.py) against harmonic_sums(method="take") +
+    the jnp find_peaks_device/cluster_peaks_device pair — BITWISE,
+    including the in-VMEM one-hot gather accumulation, per-level
+    scaling, garbage pad-tail masking, and row padding."""
+
+    def _oracle_levels(self, s, nharms):
+        import jax.numpy as jnp
+
+        from peasoup_tpu.ops.harmonics import harmonic_sums
+
+        return [jnp.asarray(s)] + harmonic_sums(
+            jnp.asarray(s), nharms=nharms, method="take", scaled=True
+        )
+
+    @pytest.mark.parametrize("nharms,nbins,rows", [(4, 6000, 9), (2, 4500, 3)])
+    def test_bitwise_vs_take_oracle(self, nharms, nbins, rows):
+        import jax.numpy as jnp
+
+        from peasoup_tpu.ops.pallas.harmpeaks import (
+            find_harmonic_cluster_peaks,
+        )
+        from peasoup_tpu.ops.pallas.peaks import PEAKS_BLOCK
+        from peasoup_tpu.ops.peaks import (
+            cluster_peaks_device,
+            find_peaks_device,
+        )
+
+        nlev = nharms + 1
+        mx = 64
+        rng = np.random.default_rng(0)
+        s = np.abs(rng.normal(size=(rows, nbins))).astype(np.float32)
+        s[::3, ::61] += 30.0
+        s[min(1, rows - 1), nbins // 2 : nbins // 2 + 400 : 4] += 20.0
+        lo, hi = nbins // 10, nbins - nbins // 16
+        windows = np.tile(np.asarray([[lo, hi]], np.int32), (nlev, 1))
+        npad = -(-nbins // PEAKS_BLOCK) * PEAKS_BLOCK
+        # garbage past the true bins, like the fused-interbin pad region
+        sp = jnp.asarray(
+            np.pad(s, ((0, 0), (0, npad - nbins)), constant_values=1e9)
+        )
+        scales = tuple(
+            1.0 if lv == 0 else 2.0 ** (-lv / 2.0) for lv in range(nlev)
+        )
+        ci, cs, rc, cc = find_harmonic_cluster_peaks(
+            sp, jnp.asarray(windows), nharms=nharms, threshold=9.0,
+            max_peaks=mx, scales=scales, nbins=nbins, interpret=True,
+        )
+        ci, cs, rc, cc = map(np.asarray, (ci, cs, rc, cc))
+        levels = self._oracle_levels(s, nharms)
+        for lv in range(nlev):
+            i_, s_, c_ = find_peaks_device(
+                levels[lv], jnp.float32(9.0), jnp.int32(lo), jnp.int32(hi),
+                max_peaks=1 << 14,
+            )
+            ji, js, jc = cluster_peaks_device(i_, s_, jnp.int32(nbins))
+            ji, js, jc, c_ = map(np.asarray, (ji, js, jc, c_))
+            np.testing.assert_array_equal(rc[:, lv], c_)
+            np.testing.assert_array_equal(cc[:, lv], jc)
+            for r in range(rows):
+                k = min(int(jc[r]), mx)
+                np.testing.assert_array_equal(ci[r, lv, :k], ji[r, :k])
+                np.testing.assert_array_equal(cs[r, lv, :k], js[r, :k])
+                if int(jc[r]) <= mx:
+                    assert (ci[r, lv, k:] == nbins).all()
+                    assert (cs[r, lv, k:] == 0).all()
+
+    def test_batched_shape_and_validation(self):
+        import jax.numpy as jnp
+
+        from peasoup_tpu.ops.pallas.harmpeaks import (
+            find_harmonic_cluster_peaks,
+        )
+        from peasoup_tpu.ops.pallas.peaks import PEAKS_BLOCK
+
+        rng = np.random.default_rng(3)
+        nbins = PEAKS_BLOCK  # exactly one block, no separate pad
+        s = np.abs(rng.normal(size=(2, 3, nbins))).astype(np.float32)
+        s[..., 500] = 40.0
+        windows = jnp.asarray(
+            np.tile(np.asarray([[10, nbins]], np.int32), (3, 1))
+        )
+        ci, cs, rc, cc = find_harmonic_cluster_peaks(
+            jnp.asarray(s), windows, nharms=2, threshold=9.0,
+            max_peaks=8, scales=(1.0, 0.5, 0.25), interpret=True,
+        )
+        assert ci.shape == (2, 3, 3, 8) and rc.shape == (2, 3, 3)
+        # the planted tone must be the top cluster everywhere on level 0
+        assert (np.asarray(ci)[..., 0, 0] == 500).all()
+        with pytest.raises(ValueError, match="multiple"):
+            find_harmonic_cluster_peaks(
+                jnp.asarray(s[..., : nbins - 4]), windows, nharms=2,
+                threshold=9.0, max_peaks=8, scales=(1.0, 0.5, 0.25),
+                interpret=True,
+            )
+        with pytest.raises(ValueError, match="levels"):
+            find_harmonic_cluster_peaks(
+                jnp.asarray(s), windows, nharms=3, threshold=9.0,
+                max_peaks=8, scales=(1.0, 0.5, 0.25, 0.1), interpret=True,
+            )
+
+
 class TestPallasDedisperse:
     """Interpret-mode parity of the Pallas dedispersion kernel
     (ops/pallas/dedisperse.py) against the jnp scan."""
