@@ -66,8 +66,15 @@ _BLOCK = PEAKS_BLOCK
 # rows per stripe (multiple of the f32 sublane quantum 8): taller
 # stripes cut the number of grid steps — the window-merged walk (r4)
 # made the per-step fixed work (per-level threshold mask + count) the
-# dominant cost, and it row-vectorises for free
-_SUB = int(_os.environ.get("PEASOUP_PEAKS_SUB", "8"))
+# dominant cost, and it row-vectorises for free. 24 measured best on
+# v5e with the harmonic mega-kernel (dense tutorial search 140.1 ->
+# 113.3 ms device; 16 gives 119.9, 8 gives 140.1). 32+ fails the
+# Mosaic compile: on THIS toolchain that surfaces as a catchable
+# remote-compile error the probes turn into a jnp fallback, but other
+# toolchains have SIGABRTed the whole process on bad _SUB values
+# (see probe_pallas_interbin's note) — treat overrides as unsafe to
+# ship without a probe run on the target toolchain
+_SUB = int(_os.environ.get("PEASOUP_PEAKS_SUB", "24"))
 if _SUB <= 0 or _SUB % 8:
     raise ValueError(f"PEASOUP_PEAKS_SUB must be a positive multiple of 8: {_SUB}")
 # crossing-walk subblock width (lanes). r3 chose 512 to shrink

@@ -91,6 +91,11 @@ def _spectra_and_peaks(
     size = 2 * xr[0].shape[-1] if packed else xr.shape[-1]
     nbins = size // 2 + 1
     kernel_scales = pallas_peaks and cluster
+    # per-level rsqrt(2^h) factors, applied in VMEM by the kernel paths
+    # and pre-applied by harmonic_sums(scaled=True) on the jnp path
+    lvl_scales = (1.0,) + tuple(
+        2.0 ** (-h / 2.0) for h in range(1, nharms + 1)
+    )
     with jax.named_scope("Acceleration-Loop"):
         from ..ops.fft import _use_matmul, rfft_pow2_matmul_parts
         from ..ops.spectrum import form_interpolated_parts
@@ -139,12 +144,9 @@ def _spectra_and_peaks(
                 s = jnp.pad(
                     s, [(0, 0)] * (s.ndim - 1) + [(0, npad - s.shape[-1])]
                 )
-            scales = (1.0,) + tuple(
-                2.0 ** (-h / 2.0) for h in range(1, nharms + 1)
-            )
             i_, s_, c_, cc_ = find_harmonic_cluster_peaks(
                 s, windows, nharms=nharms, threshold=threshold,
-                max_peaks=max_peaks, scales=scales, nbins=nbins,
+                max_peaks=max_peaks, scales=lvl_scales, nbins=nbins,
             )
         nb = s.ndim - 1  # batch rank
         return AccelSearchPeaks(
@@ -180,12 +182,9 @@ def _spectra_and_peaks(
         # machine together (ops/pallas/peaks.py:find_cluster_peaks_multi)
         from ..ops.pallas.peaks import find_cluster_peaks_multi
 
-        scales = (1.0,) + tuple(
-            2.0 ** (-h / 2.0) for h in range(1, nharms + 1)
-        )
         i_, s_, c_, cc_ = find_cluster_peaks_multi(
             levels, windows, threshold=threshold, max_peaks=max_peaks,
-            scales=scales, nbins=nbins,
+            scales=lvl_scales, nbins=nbins,
         )
         # kernel emits (..., nlev, ...); the NamedTuple wants the level
         # axis at stack_axis
