@@ -86,7 +86,24 @@ def packed_dft_z_parts(
     n = 2 * m
     p = _plan(n)
     n1, n2 = p["n1"], p["n2"]
-    P = jax.lax.Precision.HIGHEST
+    import os as _os
+
+    # measured trade (NOTES.md round-4 continuation): the chain is
+    # layout-bound, so HIGH buys only ~3 ms while perturbing the S/N
+    # chain the acc-tie parity analysis is anchored to — HIGHEST stays
+    # the default; the knob records the option
+    prec = _os.environ.get("PEASOUP_FFT_PRECISION", "highest").lower()
+    choices = {
+        "highest": jax.lax.Precision.HIGHEST,
+        "high": jax.lax.Precision.HIGH,
+        "default": jax.lax.Precision.DEFAULT,
+    }
+    if prec not in choices:
+        raise ValueError(
+            f"PEASOUP_FFT_PRECISION must be one of {sorted(choices)}, "
+            f"got {prec!r}"
+        )
+    P = choices[prec]
     d1r, d1i = jnp.asarray(p["d1r"]), jnp.asarray(p["d1i"])
     d2r, d2i = jnp.asarray(p["d2r"]), jnp.asarray(p["d2i"])
     twr, twi = jnp.asarray(p["twr"]), jnp.asarray(p["twi"])

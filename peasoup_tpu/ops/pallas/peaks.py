@@ -19,18 +19,20 @@ adaptive-size escalation only ever re-dispatches for cluster-count
 overflow (rare).
 
 Design:
-  rows are processed in stripes of ``_SUB`` = 8 (the f32 sublane
-  quantum): grid = (row stripes, bin blocks), sequential ("arbitrary")
-  order, so for each stripe the kernel sees blocks of ``_BLOCK`` bins
-  left to right. The identify_unique_peaks state machine runs as 8
-  independent lanes of (cursor, raw count, open, cpeak, cpeakidx,
-  lastidx) vectors living in VMEM scratch across grid steps. Per
-  block: vector threshold mask; a stripe whose block has no crossing
-  pays only the mask+check. Otherwise a fori_loop walks crossings
-  oldest-first in every row lane at once (masked min per sublane);
-  cluster emissions write the (8, mx) output block through a one-hot
-  select (no dynamic-index stores). Output blocks stay VMEM-resident
-  for the whole stripe (their BlockSpec index ignores the bin axis).
+  rows are processed in stripes of ``_SUB`` rows (a multiple of the
+  f32 sublane quantum 8; default 24 — see the tuning comment at the
+  definition): grid = (row stripes, bin blocks), sequential
+  ("arbitrary") order, so for each stripe the kernel sees blocks of
+  ``_BLOCK`` bins left to right. The identify_unique_peaks state
+  machine runs as _SUB independent rows of (cursor, raw count, open,
+  cpeak, cpeakidx, lastidx) vectors living in VMEM scratch across
+  grid steps. Per block: vector threshold mask; a stripe whose block
+  has no crossing pays only the mask+check. Otherwise a fori_loop
+  walks crossings oldest-first in every row at once (masked min per
+  sublane); cluster emissions write the (_SUB, mx) output block
+  through a one-hot select (no dynamic-index stores). Output blocks
+  stay VMEM-resident for the whole stripe (their BlockSpec index
+  ignores the bin axis).
 
 Outputs per row: cluster idxs (mx,) i32 ascending padded with
 ``nbins``; cluster snrs (mx,) f32 zero-padded; counts (2,) i32 =
