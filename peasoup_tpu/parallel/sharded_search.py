@@ -32,6 +32,7 @@ def make_sharded_search_fn(
     pallas_peaks: bool = False,
     fused_interbin: bool = False,
     mega_harm: bool = False,
+    fused_dft: bool = False,
 ):
     """Jitted (D, ...) -> (D, ...) search with D sharded over ``axis``.
 
@@ -68,7 +69,7 @@ def make_sharded_search_fn(
                 nharms=nharms, max_peaks=max_peaks, pos5=pos5, pos25=pos25,
                 pallas_block=pallas_block, select_smax=select_smax,
                 pallas_peaks=pallas_peaks, fused_interbin=fused_interbin,
-                mega_harm=mega_harm,
+                mega_harm=mega_harm, fused_dft=fused_dft,
             )
 
         return jax.shard_map(

@@ -72,7 +72,7 @@ def _preprocess_trial(tim, zapmask, *, size, nsamps_valid, pos5, pos25):
 def _spectra_and_peaks(
     xr, mean, std, windows, *, threshold, nharms, max_peaks, stack_axis,
     cluster=True, pallas_peaks=False, fused_interbin=False,
-    mega_harm=False,
+    mega_harm=False, fused_dft=False,
 ):
     """Post-resample stage: batched rfft, interbin, normalise, harmonic
     sums, per-level peak compaction (pipeline_multi.cu:216-234), and —
@@ -112,15 +112,32 @@ def _spectra_and_peaks(
 
             batch = xr[0].shape[:-1] if packed else xr.shape[:-1]
             npad = -(-nbins // PEAKS_BLOCK) * PEAKS_BLOCK
-            zr, zi = (
-                packed_dft_z_parts(*xr) if packed else packed_dft_z(xr)
-            )
-            s = untwist_interbin_normalise(
-                zr, zi,
-                jnp.broadcast_to(mean, batch).reshape(-1),
-                jnp.broadcast_to(std, batch).reshape(-1),
-                npad=npad, block=PEAKS_BLOCK,
-            ).reshape(*batch, npad)
+            if fused_dft and packed:
+                # one Pallas kernel does DFT + untwist + interbin +
+                # normalise per row stripe in VMEM (ops/pallas/
+                # dftspec.py): kills the einsum layout copies and the
+                # Z round trip. 3-pass HIGH-class accuracy, validated
+                # end to end by the golden-recall gate (probe-gated;
+                # PEASOUP_FUSED_DFT=0 restores this einsum chain)
+                from ..ops.pallas.dftspec import dft_untwist_interbin
+
+                half = xr[0].shape[-1]
+                s = dft_untwist_interbin(
+                    xr[0].reshape(-1, half), xr[1].reshape(-1, half),
+                    jnp.broadcast_to(mean, batch).reshape(-1),
+                    jnp.broadcast_to(std, batch).reshape(-1),
+                    npad=npad,
+                ).reshape(*batch, npad)
+            else:
+                zr, zi = (
+                    packed_dft_z_parts(*xr) if packed else packed_dft_z(xr)
+                )
+                s = untwist_interbin_normalise(
+                    zr, zi,
+                    jnp.broadcast_to(mean, batch).reshape(-1),
+                    jnp.broadcast_to(std, batch).reshape(-1),
+                    npad=npad, block=PEAKS_BLOCK,
+                ).reshape(*batch, npad)
         elif _use_matmul(xr.shape[-1]):
             # matmul four-step rfft as lazy (re, im) parts: the untwist
             # fuses into the interbin pass (no complex materialisation)
@@ -293,6 +310,7 @@ def search_block_core(
     pallas_peaks: bool = False,
     fused_interbin: bool = False,
     mega_harm: bool = False,
+    fused_dft: bool = False,
 ) -> AccelSearchPeaks:
     """Block-batched search: all per-DM preprocessing vmapped, then the
     (D, A) accel grid processed as single batched array programs. With
@@ -337,6 +355,7 @@ def search_block_core(
         threshold=threshold, nharms=nharms, max_peaks=max_peaks,
         stack_axis=1, cluster=cluster, pallas_peaks=pallas_peaks,
         fused_interbin=fused_interbin, mega_harm=mega_harm,
+        fused_dft=fused_dft,
     )
 
 
@@ -344,7 +363,7 @@ def search_block_core(
 def make_batched_search_fn(
     threshold: float, pallas_block: int = 0, select_smax: int = 0,
     pallas_peaks: bool = False, fused_interbin: bool = False,
-    mega_harm: bool = False,
+    mega_harm: bool = False, fused_dft: bool = False,
 ):
     """Jitted (D, ...) -> (D, ...) search over a block of DM trials.
 
@@ -369,6 +388,7 @@ def make_batched_search_fn(
             pallas_block=pallas_block, select_smax=select_smax,
             cluster=cluster, pallas_peaks=pallas_peaks,
             fused_interbin=fused_interbin, mega_harm=mega_harm,
+            fused_dft=fused_dft,
         )
 
     return search_dm_block
