@@ -109,6 +109,13 @@ def main(argv: list[str] | None = None) -> int:
     outdir = args.outdir or default_outdir()
     apply_platform_env()
 
+    # Resolve the peaks-kernel stripe height BEFORE anything creates
+    # this process's jax client: the subprocess-isolated _SUB=24 probe
+    # (ops/pallas/peaks.py) needs the TPU free to validate the fast
+    # default on single-client runtimes; once resolved the verdict is
+    # disk-cached and this import is free
+    from ..ops.pallas import peaks as _peaks  # noqa: F401
+
     # Heavy imports after arg parsing so --help stays fast
     from ..io.output import CandidateFileWriter, OutputFileWriter
     from ..io.sigproc import read_filterbank

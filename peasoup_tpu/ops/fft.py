@@ -20,6 +20,7 @@ pocketfft); everything else falls back to jnp.fft.rfft.
 
 from __future__ import annotations
 
+import os as _os
 from functools import lru_cache
 
 import jax
@@ -27,6 +28,27 @@ import jax.numpy as jnp
 import numpy as np
 
 _MIN_N = 1 << 14
+
+# Matmul precision for the packed four-step DFT einsums. Measured trade
+# (NOTES.md round-4 continuation): the chain is layout-bound, so HIGH
+# buys only ~3 ms while perturbing the S/N chain the acc-tie parity
+# analysis is anchored to — HIGHEST stays the default; the knob records
+# the option. Read and validated ONCE at import, like the module's
+# other knobs (PEASOUP_MATMUL_FFT, PEASOUP_PEAKS_SUB): it feeds traced
+# code, so a post-compile change could never take effect anyway — set
+# it before the first import.
+_PREC_CHOICES = {
+    "highest": jax.lax.Precision.HIGHEST,
+    "high": jax.lax.Precision.HIGH,
+    "default": jax.lax.Precision.DEFAULT,
+}
+_PREC_NAME = _os.environ.get("PEASOUP_FFT_PRECISION", "highest").lower()
+if _PREC_NAME not in _PREC_CHOICES:
+    raise ValueError(
+        f"PEASOUP_FFT_PRECISION must be one of {sorted(_PREC_CHOICES)}, "
+        f"got {_PREC_NAME!r}"
+    )
+_PRECISION = _PREC_CHOICES[_PREC_NAME]
 
 
 @lru_cache(maxsize=None)
@@ -86,24 +108,7 @@ def packed_dft_z_parts(
     n = 2 * m
     p = _plan(n)
     n1, n2 = p["n1"], p["n2"]
-    import os as _os
-
-    # measured trade (NOTES.md round-4 continuation): the chain is
-    # layout-bound, so HIGH buys only ~3 ms while perturbing the S/N
-    # chain the acc-tie parity analysis is anchored to — HIGHEST stays
-    # the default; the knob records the option
-    prec = _os.environ.get("PEASOUP_FFT_PRECISION", "highest").lower()
-    choices = {
-        "highest": jax.lax.Precision.HIGHEST,
-        "high": jax.lax.Precision.HIGH,
-        "default": jax.lax.Precision.DEFAULT,
-    }
-    if prec not in choices:
-        raise ValueError(
-            f"PEASOUP_FFT_PRECISION must be one of {sorted(choices)}, "
-            f"got {prec!r}"
-        )
-    P = choices[prec]
+    P = _PRECISION
     d1r, d1i = jnp.asarray(p["d1r"]), jnp.asarray(p["d1i"])
     d2r, d2i = jnp.asarray(p["d2r"]), jnp.asarray(p["d2i"])
     twr, twi = jnp.asarray(p["twr"]), jnp.asarray(p["twi"])

@@ -60,6 +60,43 @@ def _rev_lanes(x: jnp.ndarray, anti: jnp.ndarray, block: int) -> jnp.ndarray:
     return z.reshape(_SUB, block)
 
 
+def _untwist_block(zr, zi, zrv, ziv, carry, c, s, mean, std, lane, gk,
+                   m, roll):
+    """One (stripe, k-block) step's untwist + interbin + normalise at
+    FIXED term grouping — shared VERBATIM by the Pallas kernel
+    (roll=pltpu.roll) and the jnp twin (roll=jnp.roll), so the twin is
+    a contraction-order-exact replay (see dftspec.py's _row_spectrum
+    for the same pattern). ``carry`` = (zrv_last, ziv_last, xr_last,
+    xi_last, z0r, z0i) as values; returns (out, xr_last', xi_last').
+
+    Steps: forward term Z[k] (wrapping the Nyquist k == m to the
+    carried Z[0]); mirror term Z[M-k] = zrev[k-1] from the reversed
+    mirrored block via in-block right-shift + carried boundary lane;
+    the untwist X[k] = (Z[k]+conj(Zm))/2 - i/2 e^{-2pi i k/n}
+    (Z[k]-conj(Zm)) (ops/fft.py formulas); interbin X[k-1] by the same
+    shift pattern (kernels.cu:231-252); normalise (kernels.cu:469-494)
+    + zero the pad past the true bins."""
+    nyq = gk == m
+    zr = jnp.where(nyq, carry[4], zr)
+    zi = jnp.where(nyq, carry[5], zi)
+    zmr = jnp.where(lane == 0, carry[0], roll(zrv, 1, 1))
+    zmi = jnp.where(lane == 0, carry[1], roll(ziv, 1, 1))
+    arr = 0.5 * (zr + zmr)
+    aii = 0.5 * (zi - zmi)
+    br = zr - zmr
+    bi = zi + zmi
+    xr = arr + 0.5 * (c * bi - s * br)
+    xi = aii - 0.5 * (c * br + s * bi)
+    xr_l = jnp.where(lane == 0, carry[2], roll(xr, 1, 1))
+    xi_l = jnp.where(lane == 0, carry[3], roll(xi, 1, 1))
+    ampsq = xr * xr + xi * xi
+    dsq = 0.5 * ((xr - xr_l) ** 2 + (xi - xi_l) ** 2)
+    amp = jnp.sqrt(jnp.maximum(ampsq, dsq))
+    out = (amp - mean) / std
+    out = jnp.where(gk <= m, out, 0.0)
+    return out, xr[:, -1:], xi[:, -1:]
+
+
 def _kernel(
     anti_ref, unc_ref, uns_ref, mean_ref, std_ref, zr_ref, zi_ref,
     zmr_ref, zmi_ref, out_ref, state, *, block, m,
@@ -82,46 +119,22 @@ def _kernel(
 
     lane = jax.lax.broadcasted_iota(jnp.int32, (_SUB, block), 1)
     gk = b * block + lane  # global bin index
-    # forward term Z[k]: at the Nyquist bin k == m it wraps to Z[0]
-    # (carried from block 0); the mirror carry already holds the right
-    # value there (zrev[m-1] == Z[0]), so no result override is needed
-    # and the arithmetic below is bit-identical to the jnp untwist
-    nyq = gk == m
-    zr = jnp.where(nyq, state[:, 4:5], zr)
-    zi = jnp.where(nyq, state[:, 5:6], zi)
-    # mirror term Z[M-k] = zrev[k-1]: the mirrored-index FORWARD block
-    # (zm*_ref, block nbz-1-b) reversed in VMEM gives this block of
-    # zrev = flip(Z); then an in-block right-shift + carried boundary
-    # lane implements the k-1 offset exactly as before
+    # mirror operands: the mirrored-index FORWARD block (zm*_ref, block
+    # nbz-1-b) reversed in VMEM gives this block of zrev = flip(Z)
     zrv = _rev_lanes(zmr_ref[:], anti_ref[:], block)
     ziv = _rev_lanes(zmi_ref[:], anti_ref[:], block)
-    zmr = jnp.where(lane == 0, state[:, 0:1], pltpu.roll(zrv, 1, 1))
-    zmi = jnp.where(lane == 0, state[:, 1:2], pltpu.roll(ziv, 1, 1))
-    # untwist (ops/fft.py formulas):
-    # X[k] = (Z[k]+conj(Zm))/2 - i/2 e^{-2pi i k/n} (Z[k]-conj(Zm))
-    c = unc_ref[:]
-    s = uns_ref[:]
-    arr = 0.5 * (zr + zmr)
-    aii = 0.5 * (zi - zmi)
-    br = zr - zmr
-    bi = zi + zmi
-    xr = arr + 0.5 * (c * bi - s * br)
-    xi = aii - 0.5 * (c * br + s * bi)
-    # interbin (kernels.cu:231-252): X[k-1] via the same shift pattern
-    xr_l = jnp.where(lane == 0, state[:, 2:3], pltpu.roll(xr, 1, 1))
-    xi_l = jnp.where(lane == 0, state[:, 3:4], pltpu.roll(xi, 1, 1))
-    ampsq = xr * xr + xi * xi
-    dsq = 0.5 * ((xr - xr_l) ** 2 + (xi - xi_l) ** 2)
-    amp = jnp.sqrt(jnp.maximum(ampsq, dsq))
-    # normalise (kernels.cu:469-494) + zero the pad past the true bins
-    out = (amp - mean_ref[:, 0:1]) / std_ref[:, 0:1]
-    out_ref[:] = jnp.where(gk <= m, out, 0.0)
+    carry = tuple(state[:, i : i + 1] for i in range(6))
+    out, xr_last, xi_last = _untwist_block(
+        zr, zi, zrv, ziv, carry, unc_ref[:], uns_ref[:],
+        mean_ref[:, 0:1], std_ref[:, 0:1], lane, gk, m, roll=pltpu.roll,
+    )
+    out_ref[:] = out
     # advance carries: zrev's last lane == the mirrored forward block's
     # FIRST lane, so the carry needs no reversed value at all
     state[:, 0:1] = zmr_ref[:, 0:1]
     state[:, 1:2] = zmi_ref[:, 0:1]
-    state[:, 2:3] = xr[:, block - 1 : block]
-    state[:, 3:4] = xi[:, block - 1 : block]
+    state[:, 2:3] = xr_last
+    state[:, 3:4] = xi_last
 
 
 @lru_cache(maxsize=None)
@@ -190,3 +203,76 @@ def untwist_interbin_normalise(
     fn = _build(rpad, m, npad, block, interpret)
     out = fn(anti, unc, uns, mean2, std2, zr, zi, zr, zi)
     return out[:r]
+
+
+def untwist_interbin_normalise_twin(
+    zr: jnp.ndarray,
+    zi: jnp.ndarray,
+    mean: jnp.ndarray,
+    std: jnp.ndarray,
+    *,
+    npad: int,
+    block: int = 4096,
+) -> jnp.ndarray:
+    """Pure-jnp contraction-exact replay of
+    :func:`untwist_interbin_normalise`: the kernel's per-(stripe, block)
+    grid walk — mirrored-block fetch, _rev_lanes one-hot reversal,
+    carry lanes, untwist, interbin, normalise — run outside Pallas with
+    ``jnp.roll`` for ``pltpu.roll`` and Python loops for the grid, so
+    every expression tree matches the kernel term for term. Kernel and
+    twin agree bitwise when both compile fresh; when the persistent
+    compile cache serves a cross-host executable the residual is pure
+    FMA-contraction codegen (measured max 5.2e-6 rel), so the CI
+    oracle asserts a per-bin 1e-5 envelope that still fails every bin
+    a structural half-lane fault breaks — without TPU hardware (the
+    on-TPU probe gates bitwise against the differently-grouped jnp
+    chain instead). Test-only — O(grid) trace size."""
+    r, m = zr.shape
+    if m % block or npad % block or npad <= m:
+        raise ValueError(f"bad interbin kernel geometry {m=} {npad=} {block=}")
+    n = 2 * m
+    k = np.arange(npad, dtype=np.float64)
+    un = np.exp(-2j * np.pi * np.minimum(k, m) / n)
+    unc = jnp.asarray(un.real[None, :].astype(np.float32))
+    uns = jnp.asarray((-un.imag)[None, :].astype(np.float32))
+    rpad = -(-r // _SUB) * _SUB
+    mean2 = jnp.broadcast_to(mean.astype(jnp.float32)[:, None], (r, 1))
+    std2 = jnp.broadcast_to(std.astype(jnp.float32)[:, None], (r, 1))
+    if rpad != r:
+        pad = [(0, rpad - r), (0, 0)]
+        zr, zi = (jnp.pad(a, pad) for a in (zr, zi))
+        mean2 = jnp.pad(mean2, pad)
+        std2 = jnp.pad(std2, pad, constant_values=1.0)
+    anti = jnp.asarray(np.eye(128, dtype=np.float32)[::-1].copy())
+    nbz = m // block
+    stripes = []
+    for st in range(rpad // _SUB):
+        sl = slice(st * _SUB, (st + 1) * _SUB)
+        zrs, zis = zr[sl], zi[sl]
+        mean_s, std_s = mean2[sl], std2[sl]
+        # carries: [zrv_last, ziv_last, xr_last, xi_last, z0r, z0i]
+        zero = jnp.zeros((_SUB, 1), jnp.float32)
+        carry = [zrs[:, 0:1], zis[:, 0:1], zero, zero,
+                 zrs[:, 0:1], zis[:, 0:1]]
+        blocks = []
+        for b in range(npad // block):
+            # the kernel's BlockSpec index maps as python slices
+            zb = min(b, nbz - 1) * block
+            mb = max(nbz - 1 - b, 0) * block
+            zmr_b = zrs[:, mb : mb + block]
+            zmi_b = zis[:, mb : mb + block]
+            lane = jax.lax.broadcasted_iota(jnp.int32, (_SUB, block), 1)
+            zrv = _rev_lanes(zmr_b, anti, block)
+            ziv = _rev_lanes(zmi_b, anti, block)
+            out, xr_last, xi_last = _untwist_block(
+                zrs[:, zb : zb + block], zis[:, zb : zb + block],
+                zrv, ziv, tuple(carry),
+                unc[:, b * block : (b + 1) * block],
+                uns[:, b * block : (b + 1) * block],
+                mean_s, std_s, lane, b * block + lane, m, roll=jnp.roll,
+            )
+            blocks.append(out)
+            carry = [zmr_b[:, 0:1], zmi_b[:, 0:1], xr_last, xi_last,
+                     carry[4], carry[5]]
+        stripes.append(jnp.concatenate(blocks, axis=1))
+    return jnp.concatenate(stripes, axis=0)[:r]

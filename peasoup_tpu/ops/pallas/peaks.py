@@ -73,10 +73,193 @@ _BLOCK = PEAKS_BLOCK
 # 113.3 ms device; 16 gives 119.9, 8 gives 140.1). 32+ fails the
 # Mosaic compile: on THIS toolchain that surfaces as a catchable
 # remote-compile error the probes turn into a jnp fallback, but other
-# toolchains have SIGABRTed the whole process on bad _SUB values
-# (see probe_pallas_interbin's note) — treat overrides as unsafe to
-# ship without a probe run on the target toolchain
-_SUB = int(_os.environ.get("PEASOUP_PEAKS_SUB", "24"))
+# toolchains have SIGABRTed the whole process on bad _SUB values (see
+# probe_pallas_interbin's note) — an in-process probe CANNOT protect
+# against that, so the 24 default is resolved through a subprocess-
+# isolated, disk-cached probe (_sub24_default_safe below): a toolchain
+# that aborts on 24 kills the CHILD, and this process degrades to the
+# everywhere-validated 8. An explicit PEASOUP_PEAKS_SUB override skips
+# the probe (the operator owns the risk — and the fix, deleting
+# ~/.cache/peasoup_tpu/peaks_sub24.* after a transient probe failure).
+
+
+def _sub24_default_safe() -> bool:
+    """Can THIS toolchain compile+run the peaks kernel at the fast
+    default _SUB=24? Probed in a SUBPROCESS so a Mosaic SIGABRT lands
+    there, with the verdict cached on disk per (jax, jaxlib) so the
+    cost is once per machine, not per process. The child's compile
+    also lands in the persistent XLA cache, so the in-process oracle
+    probes that follow recompile from cache.
+
+    The PARENT never initialises jax here — on standard TPU runtimes
+    holding the client would starve the child of the device and turn
+    every probe into a false 'bad'. The CHILD decides the platform,
+    and distinguishes a machine with NO TPU hardware (exit 3: no
+    Mosaic compile risk anywhere, 24 is safe — persisted as 'ok' so
+    non-TPU machines pay the child exactly once) from a TPU that
+    exists but could not be acquired, e.g. the parent's client
+    already holds it (exit 4: the probe CANNOT validate the fast
+    default, so it must not ship it). Verdicts: exit 0 -> 'ok'
+    persisted; signal death (SIGABRT-class, the failure this probe
+    exists for) -> 'bad' persisted; exit 4 / other nonzero (locked
+    TPU, import error, timeout) is INCONCLUSIVE — fall back to 8 for
+    this process only, warn, persist nothing, so a transient failure
+    can't pin the slow path forever. (Production drivers import this
+    module via the oracle probes AFTER the parent client exists; on
+    single-client runtimes they land on exit 4 unless a verdict was
+    cached earlier — run any CLI once, or `python -c "import
+    peasoup_tpu.ops.pallas.peaks"`, to seed the cache, or set
+    PEASOUP_PEAKS_SUB explicitly.)"""
+    import hashlib
+    import subprocess
+    import sys
+    import warnings
+
+    # raw env forms of the geometry knobs (the module constants _SBW/
+    # _WSTEPS are defined below this resolution point; the child
+    # inherits the same env, so these pin the probed geometry)
+    _SBW_ENV = _os.environ.get("PEASOUP_PEAKS_SBW", "0")
+    _WSTEPS_ENV = _os.environ.get("PEASOUP_PEAKS_WSTEPS", "2")
+
+    # explicit cpu-only env (the test suite's conftest) — same verdict
+    # the child would return, without paying its jax import
+    if _os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return True
+    def _ver(pkg):
+        try:
+            from importlib.metadata import version
+
+            return version(pkg)
+        except Exception:
+            return "none"
+
+    def _tpu_hw_markers() -> bool:
+        # cheap jax-free TPU-hardware sniff, IDENTICAL to the child's:
+        # libtpu wheel, accelerator device nodes, or a TPU env
+        import glob
+        import importlib.util
+
+        return bool(
+            importlib.util.find_spec("libtpu") is not None
+            or glob.glob("/dev/accel*")
+            or glob.glob("/dev/vfio/*")
+            or _os.environ.get("TPU_NAME")
+        )
+
+    # libtpu ships as its own wheel: the Mosaic toolchain can change
+    # under a fixed jax/jaxlib, so it must be part of the verdict key —
+    # as must the kernel-geometry knobs the child compiles with
+    # (PEASOUP_PEAKS_BLOCK/SBW/WSTEPS): a verdict probed at one block
+    # geometry says nothing about another
+    key = (
+        f"24-{_ver('jax')}-{_ver('jaxlib')}-{_ver('libtpu')}"
+        f"-{PEAKS_BLOCK}-{_SBW_ENV}-{_WSTEPS_ENV}"
+    )
+    cache_dir = _os.path.join(
+        _os.environ.get(
+            "XDG_CACHE_HOME", _os.path.expanduser("~/.cache")
+        ),
+        "peasoup_tpu",
+    )
+    path = _os.path.join(
+        cache_dir,
+        "peaks_sub24." + hashlib.sha1(key.encode()).hexdigest()[:12],
+    )
+    try:
+        with open(path) as fh:
+            verdict = fh.read().strip()
+        if verdict == "ok":
+            return True
+        if verdict == "bad":
+            return False
+        # 'notpu' was recorded on a machine with no TPU hardware: honor
+        # it only while that is still true (a shared/NFS cache reaching
+        # a real TPU machine must re-probe, not ship 24 unvalidated)
+        if verdict == "notpu" and not _tpu_hw_markers():
+            return True
+    except OSError:
+        pass
+    pkg_root = _os.path.dirname(  # .../peasoup_tpu/ops/pallas -> repo
+        _os.path.dirname(_os.path.dirname(_os.path.dirname(__file__)))
+    )
+    script = (
+        "import os, sys, glob\n"
+        "os.environ['PEASOUP_PEAKS_SUB'] = '24'\n"
+        "import importlib.util\n"
+        "import jax\n"
+        "if jax.default_backend() != 'tpu':\n"
+        "    # no-TPU machine (exit 3) vs TPU hardware present but\n"
+        "    # unacquirable, e.g. locked by the parent (exit 4): the\n"
+        "    # latter must stay inconclusive — libtpu/accel devices or\n"
+        "    # a TPU-ish plugin env mean a tpu backend was expected\n"
+        "    has_hw = (\n"
+        "        importlib.util.find_spec('libtpu') is not None\n"
+        "        or glob.glob('/dev/accel*') or glob.glob('/dev/vfio/*')\n"
+        "        or os.environ.get('TPU_NAME')\n"
+        "    )\n"
+        "    sys.exit(4 if has_hw else 3)\n"
+        "import numpy as np, jax.numpy as jnp\n"
+        "from peasoup_tpu.utils.cache import enable_compilation_cache\n"
+        "enable_compilation_cache()\n"
+        "from peasoup_tpu.ops.pallas.peaks import find_cluster_peaks_multi\n"
+        "s = jnp.asarray(np.zeros((24, %d), np.float32))\n"
+        "w = jnp.asarray(np.asarray([[0, 100]], np.int32))\n"
+        "out = find_cluster_peaks_multi(\n"
+        "    [s], w, threshold=5.0, max_peaks=32, scales=(1.0,),\n"
+        "    nbins=%d,\n"
+        ")\n"
+        "[np.asarray(a) for a in out]\n" % (PEAKS_BLOCK, PEAKS_BLOCK - 7)
+    )
+    env = dict(_os.environ)
+    env["PYTHONPATH"] = (
+        pkg_root + _os.pathsep + env["PYTHONPATH"]
+        if env.get("PYTHONPATH")
+        else pkg_root
+    )
+    err_tail = ""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", script],
+            timeout=900, capture_output=True, env=env,
+        )
+        rc = proc.returncode
+        err_tail = proc.stderr.decode("utf-8", "replace")[-400:]
+    except Exception as exc:
+        rc = 1
+        err_tail = f"{type(exc).__name__}: {exc}"
+    if rc > 0 and rc != 3:
+        # inconclusive (locked TPU / import error / timeout):
+        # conservative for this process, nothing persisted; the child's
+        # stderr tail makes the cause diagnosable from logs
+        warnings.warn(
+            "PEASOUP_PEAKS_SUB probe subprocess could not validate the "
+            f"fast stripe height (exit {rc}); using the conservative 8 "
+            "for this process. Seed the verdict cache from a process "
+            "that does not yet hold the TPU (e.g. `python -c \"import "
+            "peasoup_tpu.ops.pallas.peaks\"`) or set "
+            f"PEASOUP_PEAKS_SUB=24 explicitly. Child stderr: {err_tail}"
+        )
+        return False
+    # rc 0: validated on TPU -> 'ok'. rc 3: no TPU hardware on this
+    # machine -> 'notpu' (24 is risk-free here — compiled Mosaic
+    # kernels are gated off by backend_supports_pallas — but a TPU
+    # machine reading this cache re-probes; see the read side). rc < 0:
+    # signal death -> 'bad'.
+    ok = rc in (0, 3)
+    try:
+        _os.makedirs(cache_dir, exist_ok=True)
+        with open(path, "w") as fh:
+            fh.write("ok" if rc == 0 else "notpu" if rc == 3 else "bad")
+    except OSError:
+        pass  # read-only home: re-probe per process
+    return ok
+
+
+_sub_env = _os.environ.get("PEASOUP_PEAKS_SUB")
+if _sub_env is not None:
+    _SUB = int(_sub_env)
+else:
+    _SUB = 24 if _sub24_default_safe() else 8
 if _SUB <= 0 or _SUB % 8:
     raise ValueError(f"PEASOUP_PEAKS_SUB must be a positive multiple of 8: {_SUB}")
 # crossing-walk subblock width (lanes). r3 chose 512 to shrink

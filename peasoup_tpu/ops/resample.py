@@ -133,6 +133,67 @@ def resample_select_packed(
     return oute, outo
 
 
+@partial(jax.jit, static_argnames=("smax", "n1", "n2"))
+def resample_select_packed_planes(
+    x: jnp.ndarray,  # (D, N) f32 time series per DM trial
+    afs: jnp.ndarray,  # (D, A) f32 acceleration factors a*tsamp/2c
+    *,
+    smax: int,
+    n1: int,
+    n2: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """:func:`resample_select_packed` emitted directly as the fused DFT
+    kernel's (D, A, n1, n2) input planes (flat sample j = j1*n2 + j2,
+    row-major — ops/pallas/dftspec.py). Computing the select IN the
+    4-D shape matters: a reshape between the 3-D select output and the
+    kernel operand changes the XLA tile layout, which materialises as
+    two full-plane relayout copy passes (~25 ms at the dense tutorial
+    grid, traced r5 — the whole einsum-chain win eaten); here the
+    select's one fused loop writes the kernel's tiled layout directly.
+    Every arm must stay an index-map view (not a materialised array):
+    a per-arm reshape of the flat slice makes XLA materialise the arm
+    AND its (D, A, n1, n2) broadcast (traced r5: 12 broadcast passes,
+    +12 ms), so the arms are instead STATIC slices of one overlapped-
+    window base XB[d, j1, t] = plane[d, j1*n2 + t] (t < n2 + smax;
+    built once per parity, ~plane-sized) — two small fusion operands,
+    nineteen offsets. Values are BITWISE those of resample_select:
+    out_even[..., j1, j2] == out[..., 2*(j1*n2+j2)], odd likewise."""
+    n = x.shape[-1]
+    m = n // 2
+    if n1 * n2 != m:
+        raise ValueError(f"bad plane factorisation {n1}x{n2} != {m}")
+    idx = jnp.arange(n, dtype=jnp.float32)
+    quad = idx * (idx - jnp.float32(n))  # exact inputs, one f32 rounding
+    q4e = quad[0::2].reshape(n1, n2)
+    q4o = quad[1::2].reshape(n1, n2)
+    she = jnp.rint(afs[..., None, None] * q4e).astype(jnp.int32)
+    sho = jnp.rint(afs[..., None, None] * q4o).astype(jnp.int32)
+    xp = jnp.pad(x.astype(jnp.float32), ((0, 0), (smax, smax)), mode="edge")
+    # overlapped row windows: arm offsets o = (smax+s[+1])//2 <= smax,
+    # so XB width n2+smax covers every arm's [o, o+n2) row slice and
+    # the build's max flat index is m+smax-1 — exactly the plane length
+    win = jnp.arange(n1)[:, None] * n2 + jnp.arange(n2 + smax)[None, :]
+    xbs = tuple(
+        jnp.take(xp[:, par::2], win, axis=1) for par in (0, 1)
+    )  # (D, n1, n2+smax) each
+    oute = jnp.zeros(she.shape, jnp.float32)
+    outo = jnp.zeros(sho.shape, jnp.float32)
+    for s in range(-smax, smax + 1):
+        # even output j reads xp[smax + s + 2j]: parity of (smax+s)
+        # picks the plane, its half-index the slice offset
+        p = smax + s
+        arm = xbs[p % 2][:, :, p // 2 : p // 2 + n2]
+        oute = jnp.where(she == jnp.int32(s), arm[:, None], oute)
+        p = smax + s + 1  # odd output j reads xp[smax + s + 2j + 1]
+        arm = xbs[p % 2][:, :, p // 2 : p // 2 + n2]
+        outo = jnp.where(sho == jnp.int32(s), arm[:, None], outo)
+    # one joint barrier, like packed_dft_z_parts': without it XLA's
+    # priority fusion pre-materialises several arm broadcasts as
+    # full-size (D, A, n1, n2) passes instead of emitting ONE select
+    # loop (traced r5: 16.8 -> ~5 ms)
+    return jax.lax.optimization_barrier((oute, outo))
+
+
 def select_span(af_max: float, n: int, limit: int = 64) -> int:
     """Static shift bound for :func:`resample_select`: ceil of
     max|af|*N^2/4 plus one guard sample, or 0 when the span exceeds

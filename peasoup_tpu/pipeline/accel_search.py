@@ -88,7 +88,14 @@ def _spectra_and_peaks(
     # program (pipeline_multi.cu:207, harmonicfolder.hpp:28): ops carry
     # the scope in their metadata, so profiler traces group them
     packed = isinstance(xr, tuple)  # pre-deinterleaved (even, odd) planes
-    size = 2 * xr[0].shape[-1] if packed else xr.shape[-1]
+    # 4-D packed planes are pre-shaped (.., n1, n2) for the fused DFT
+    # kernel (resample_select_packed_planes): flat sample count is the
+    # product of the two plane dims
+    shaped = packed and xr[0].ndim == 4
+    if shaped:
+        size = 2 * xr[0].shape[-2] * xr[0].shape[-1]
+    else:
+        size = 2 * xr[0].shape[-1] if packed else xr.shape[-1]
     nbins = size // 2 + 1
     kernel_scales = pallas_peaks and cluster
     # per-level rsqrt(2^h) factors, applied in VMEM by the kernel paths
@@ -110,7 +117,10 @@ def _spectra_and_peaks(
             from ..ops.pallas.interbin import untwist_interbin_normalise
             from ..ops.pallas.peaks import PEAKS_BLOCK
 
-            batch = xr[0].shape[:-1] if packed else xr.shape[:-1]
+            batch = (
+                xr[0].shape[:-2] if shaped
+                else xr[0].shape[:-1] if packed else xr.shape[:-1]
+            )
             npad = -(-nbins // PEAKS_BLOCK) * PEAKS_BLOCK
             if fused_dft and packed:
                 # one Pallas kernel does DFT + untwist + interbin +
@@ -118,12 +128,22 @@ def _spectra_and_peaks(
                 # dftspec.py): kills the einsum layout copies and the
                 # Z round trip. 3-pass HIGH-class accuracy, validated
                 # end to end by the golden-recall gate (probe-gated;
-                # PEASOUP_FUSED_DFT=0 restores this einsum chain)
+                # PEASOUP_FUSED_DFT=0 restores this einsum chain).
+                # Producers send (.., n1, n2) pre-shaped planes so the
+                # select writes the kernel's tile layout directly
+                # (flat planes would relayout-copy here)
                 from ..ops.pallas.dftspec import dft_untwist_interbin
 
-                half = xr[0].shape[-1]
+                if shaped:
+                    n1, n2 = xr[0].shape[-2:]
+                    pe = xr[0].reshape(-1, n1, n2)
+                    po = xr[1].reshape(-1, n1, n2)
+                else:
+                    half = xr[0].shape[-1]
+                    pe = xr[0].reshape(-1, half)
+                    po = xr[1].reshape(-1, half)
                 s = dft_untwist_interbin(
-                    xr[0].reshape(-1, half), xr[1].reshape(-1, half),
+                    pe, po,
                     jnp.broadcast_to(mean, batch).reshape(-1),
                     jnp.broadcast_to(std, batch).reshape(-1),
                     npad=npad,
@@ -337,10 +357,22 @@ def search_block_core(
         if fused_interbin and cluster and pallas_peaks:
             # the packed-DFT consumer wants even/odd planes: selecting
             # straight into them skips the stride-2 deinterleave
-            # relayout (bitwise-equal elements, ops/resample.py)
-            from ..ops.resample import resample_select_packed
+            # relayout (bitwise-equal elements, ops/resample.py). The
+            # fused-DFT kernel additionally wants them PRE-SHAPED
+            # (.., n1, n2) so the select writes its tile layout with
+            # no relayout pass (resample_select_packed_planes)
+            if fused_dft:
+                from ..ops.pallas.dftspec import plane_factors
+                from ..ops.resample import resample_select_packed_planes
 
-            xr = resample_select_packed(xd, afs, smax=select_smax)
+                n1, n2 = plane_factors(size // 2)
+                xr = resample_select_packed_planes(
+                    xd, afs, smax=select_smax, n1=n1, n2=n2
+                )
+            else:
+                from ..ops.resample import resample_select_packed
+
+                xr = resample_select_packed(xd, afs, smax=select_smax)
         else:
             from ..ops.resample import resample_select
 

@@ -791,6 +791,32 @@ class PeasoupSearch:
                 max(cfg.max_peaks, self._learned_max_peaks) or cfg.max_peaks,
             )
         self._mega_harm = mega_harm
+        # fused four-step DFT + untwist + interbin + normalise kernel
+        # (ops/pallas/dftspec.py): one Pallas dispatch replaces the DFT
+        # einsums, XLA's relayout copies around them, AND the interbin
+        # kernel for the packed select-resample path. 3-pass HIGH-class
+        # accuracy, gated by probe_pallas_dftspec's two-layer oracle
+        # (per-bin envelope vs the contraction-exact twin + the
+        # documented accuracy-class bound vs the HIGHEST chain);
+        # shape-gated here so survey-scale m falls back to the einsum
+        # chain instead of raising at trace time. PEASOUP_FUSED_DFT=0
+        # restores the einsum + interbin-kernel chain (exact HIGHEST).
+        # RESIDUAL RISK, shared with the peaks/harmpeaks probes at
+        # escalated shapes: this probe compiles a Mosaic kernel
+        # in-process at the production (n, npad); a toolchain that
+        # SIGABRTs (rather than raising) on a bad compile kills the
+        # process here instead of degrading — the env kill switch is
+        # the documented escape hatch on such toolchains.
+        fused_dft = False
+        if fused_interbin and os.environ.get("PEASOUP_FUSED_DFT", "1") != "0":
+            from ..ops.pallas import probe_pallas_dftspec
+            from ..ops.pallas.dftspec import dftspec_supported
+            from ..ops.pallas.peaks import PEAKS_BLOCK
+
+            npad_spec = -(-size_spec // PEAKS_BLOCK) * PEAKS_BLOCK
+            if dftspec_supported(size, npad_spec):
+                fused_dft = probe_pallas_dftspec(size, npad_spec)
+        self._fused_dft = fused_dft
 
         # --- search-side mesh wiring (mesh chosen before dedispersion) --
         if mesh is not None:
@@ -804,6 +830,7 @@ class PeasoupSearch:
                     select_smax=select_smax if pb == 0 else 0,
                     pallas_peaks=pp, fused_interbin=fused_interbin and pp,
                     mega_harm=self._mega_harm and pp,
+                    fused_dft=self._fused_dft and pp,
                 )
 
             # stage blocks directly onto the mesh (no hop through chip 0)
@@ -816,6 +843,7 @@ class PeasoupSearch:
                     cfg.min_snr, pb, select_smax if pb == 0 else 0,
                     pallas_peaks=pp, fused_interbin=fused_interbin and pp,
                     mega_harm=self._mega_harm and pp,
+                    fused_dft=self._fused_dft and pp,
                 )
 
             self._dm_sharding = None

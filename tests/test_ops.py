@@ -736,3 +736,39 @@ def test_resample_select_packed_bitwise():
     ev, od = resample_select_packed(x, afs, smax=smax)
     np.testing.assert_array_equal(np.asarray(ev), full[..., 0::2])
     np.testing.assert_array_equal(np.asarray(od), full[..., 1::2])
+
+
+def test_resample_select_packed_planes_bitwise():
+    """resample_select_packed_planes' (.., n1, n2) planes are BITWISE
+    the row-major reshape of resample_select's even/odd lanes — the
+    zero-relayout producer for the fused DFT kernel
+    (ops/pallas/dftspec.py plane_factors order j = j1*n2 + j2)."""
+    import jax.numpy as jnp
+
+    from peasoup_tpu.ops.pallas.dftspec import plane_factors
+    from peasoup_tpu.ops.resample import (
+        resample_select, resample_select_packed_planes,
+    )
+
+    rng = np.random.default_rng(8)
+    n, smax = 1 << 13, 5
+    n1, n2 = plane_factors(n // 2)
+    x = jnp.asarray(rng.normal(size=(3, n)).astype(np.float32))
+    afs = jnp.asarray(
+        np.asarray(
+            [[0.0, 2.9e-8, -2.9e-8, 1.3e-8]] * 3, dtype=np.float32
+        )
+    )
+    full = np.asarray(resample_select(x, afs, smax=smax))
+    ev, od = resample_select_packed_planes(x, afs, smax=smax, n1=n1, n2=n2)
+    assert ev.shape == (3, 4, n1, n2)
+    np.testing.assert_array_equal(
+        np.asarray(ev).reshape(3, 4, -1), full[..., 0::2]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(od).reshape(3, 4, -1), full[..., 1::2]
+    )
+    import pytest
+
+    with pytest.raises(ValueError):
+        resample_select_packed_planes(x, afs, smax=smax, n1=n1, n2=n2 * 2)
