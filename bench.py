@@ -175,8 +175,27 @@ def bench_survey() -> int:
 
     search = PeasoupSearch(cfg())
     ndm = search.build_dm_plan(fil).ndm
+    # Device anchor (VERDICT r4 item 2): trace the main run and split
+    # device-busy seconds per phase by top-level jit name, so the
+    # survey record stops encoding tunnel weather — the wall numbers
+    # keep the old series (now measured WITH trace overhead; the trace
+    # only collects device events, the dominant wall terms are still
+    # upload + dispatch + compile)
+    phase_dev: dict = {}
+    res = None
     t0 = time.time()
-    res = search.run(fil)
+    try:
+        from peasoup_tpu.tools.scope_trace import scope_trace
+
+        with scope_trace() as tr:
+            res = search.run(fil)
+        phase_dev = tr.phase_seconds()
+        phase_dev["total"] = tr.device_s
+    except Exception as exc:  # tracing is best-effort
+        print(f"survey device trace failed: {exc!r}", file=sys.stderr)
+        if res is None:  # the SEARCH failed, not the trace parse:
+            res = search.run(fil)  # rerun; a parse failure keeps res
+        phase_dev = {}
     wall = time.time() - t0
     t_search = res.timers["searching"]
     t_dedisp = res.timers["dedispersion"]
@@ -187,6 +206,12 @@ def bench_survey() -> int:
         f"{wall:.2f}s (first run incl. compile)",
         file=sys.stderr,
     )
+    if phase_dev:
+        print(
+            "survey device-busy (s): "
+            + ", ".join(f"{k} {v:.3f}" for k, v in phase_dev.items()),
+            file=sys.stderr,
+        )
     # resume: a fresh driver restores every trial from the checkpoint
     t0 = time.time()
     res2 = PeasoupSearch(cfg()).run(fil)
@@ -227,11 +252,124 @@ def bench_survey() -> int:
                     "fold_warm_s": round(t_fold_warm, 2),
                     "wall_s": round(wall, 2),
                     "resume_search_s": round(t_resume, 2),
+                    # device-anchored per-phase seconds (scope_trace
+                    # classification; 'other' kept visible): the
+                    # honest chip-work record — wall minus these is
+                    # upload + dispatch + compile + tunnel
+                    "dedisp_device_s": round(phase_dev.get("dedisp", 0.0), 3),
+                    "search_device_s": round(phase_dev.get("search", 0.0), 3),
+                    "fold_device_s": round(phase_dev.get("fold", 0.0), 3),
+                    "other_device_s": round(phase_dev.get("other", 0.0), 3),
+                    "total_device_s": round(phase_dev.get("total", 0.0), 3),
                 },
             }
         )
     )
     return 0
+
+
+BIG_FIL = os.environ.get("PEASOUP_BIG_FIL", "/tmp/peasoup_big_r5.fil")
+
+
+def _ensure_big_fil(path: str) -> None:
+    """Synthesize the secondary pinned-grid filterbank once (BASELINE.md
+    "Big grid, round 5"): 64 chans x 2^21+8192 samples, 2-bit, 64 us,
+    with a P=31.4 ms pulsar at DM 10 — 16x the tutorial grid's series
+    length, so the searching chain runs at a scale where the harness
+    overhead of the 90 ms tutorial anchor no longer dominates.
+    Small channel count keeps dedispersion/upload out of the way: this
+    grid exists to measure the SEARCH chain."""
+    if os.path.exists(path):
+        return
+    from peasoup_tpu.io.sigproc import (
+        Filterbank, SigprocHeader, write_filterbank,
+    )
+    from peasoup_tpu.plan.dm_plan import delay_table
+
+    nchans, nsamps = 64, (1 << 21) + 8192
+    tsamp, fch1 = 64e-6, 1500.0
+    foff = -300.0 / nchans
+    rng = np.random.default_rng(7)
+    print(f"synthesizing big-grid filterbank -> {path}", file=sys.stderr)
+    delays = np.rint(
+        np.float32(10.0) * np.abs(delay_table(fch1, foff, nchans, tsamp))
+    ).astype(np.int64)
+    P = 0.0314
+    t = np.arange(nsamps, dtype=np.float64)
+    pulse = ((t * tsamp / P) % 1.0) < 0.08
+    data = rng.integers(0, 3, size=(nsamps, nchans), dtype=np.uint8)
+    for c in range(nchans):
+        src = np.clip(t - delays[c], 0, nsamps - 1).astype(np.int64)
+        data[:, c] += pulse[src]
+    hdr = SigprocHeader(
+        source_name="big_grid_synth", data_type=1, nchans=nchans, nbits=2,
+        nifs=1, tsamp=tsamp, tstart=51000.0, fch1=fch1, foff=foff,
+    )
+    write_filterbank(path, Filterbank(header=hdr, data=data))
+
+
+def _bench_big_grid(force_wall: bool) -> dict:
+    """Secondary pinned grid (VERDICT r4 item 7): 2^21-sample series,
+    54 DM x 43-accel dense grid, single chip, device-anchored, brute
+    force (dedupe off) like the primary anchor. The tutorial grid at
+    ~90 ms device is approaching harness-dominated; this grid gives
+    future rounds headroom to differentiate while the r01-comparable
+    grid stays unchanged. Fused-DFT is shape-gated OFF here (m = 2^20
+    > the kernel's 2^17 VMEM gate) — the einsum + interbin-kernel
+    chain is the measured path, which is exactly the production path
+    at this scale."""
+    from peasoup_tpu.io import read_filterbank
+    from peasoup_tpu.pipeline import PeasoupSearch, SearchConfig
+
+    _ensure_big_fil(BIG_FIL)
+    fil = read_filterbank(BIG_FIL)
+    search = PeasoupSearch(
+        SearchConfig(
+            dm_end=20.0, acc_start=-0.5, acc_end=0.5,
+            acc_pulse_width=0.064, npdmp=0, limit=1000,
+            dedupe_accel=False,
+        )
+    )
+    search.run(fil)
+    warm = search.run(fil)
+    walls = sorted(search.run(fil).timers["searching"] for _ in range(3))
+    if force_wall:
+        dev = []
+    else:
+        dev = sorted(
+            d
+            for d in (
+                _device_busy_seconds(lambda: search.run(fil))
+                for _ in range(3)
+            )
+            if d > 0
+        )
+    device_s = _median(dev)
+    top = warm.candidates[0]
+    assert abs(1.0 / top.freq - 0.0314) / 0.0314 < 2e-3, 1.0 / top.freq
+    n = warm.n_accel_trials
+    return {
+        "big_grid_trials": n,
+        "big_grid_device_busy_s": round(device_s, 3),
+        "big_grid_device_all_s": [round(d, 4) for d in dev],
+        "big_grid_wall_median_s": round(_median(walls), 3),
+        "big_grid_trials_per_sec_device": (
+            round(n / device_s, 2) if device_s else 0.0
+        ),
+        "big_grid_trials_per_sec_min_wall": round(n / walls[0], 2),
+    }
+
+
+def _median(xs: list) -> float:
+    """True median (mean of the middle pair for even counts — a failed
+    trace can shrink an odd sample set to an even one, and the
+    upper-middle element would then be a max mislabeled as a median)."""
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    n = len(xs)
+    mid = n // 2
+    return xs[mid] if n % 2 else 0.5 * (xs[mid - 1] + xs[mid])
 
 
 def _device_busy_seconds(run) -> float:
@@ -295,8 +433,10 @@ def main() -> int:
     n_trials = res.n_accel_trials
     baseline = 59 * 3 / 0.3088  # 2014 golden run (BASELINE.md)
 
-    # PRIMARY record: DEVICE-busy time of one steady-state run via a
-    # profiler trace. The chip sits behind a shared tunnel whose sync
+    # PRIMARY record: DEVICE-busy time of steady-state runs via
+    # profiler traces — MEDIAN of 3 (VERDICT r4 item 6: one-sample
+    # device rows are not statistically defensible; the spread is
+    # recorded). The chip sits behind a shared tunnel whose sync
     # latency varies by the HOUR (r3 weather log: same code, wall
     # 0.98 -> 2.64 s over 8 h while device busy moved 0.7%), so wall
     # rates measure the tunnel, not the chip — BENCH_r01..r03 headline
@@ -304,24 +444,63 @@ def main() -> int:
     # definition in BASELINE.md ("Official benchmark definition,
     # round 4"), `value` is the device-anchored rate, with min-wall
     # across the 5 timed runs as the fallback when tracing fails.
-    device_s = _device_busy_seconds(lambda: search.run(fil))
+    # PEASOUP_BENCH_ANCHOR=wall forces the fallback path (used once to
+    # archive a fallback-format record; trace overhead on device time
+    # is nil — the profiler only collects device events).
+    force_wall = os.environ.get("PEASOUP_BENCH_ANCHOR") == "wall"
+    if force_wall:
+        dev_samples = []
+    else:
+        dev_samples = sorted(
+            d
+            for d in (
+                _device_busy_seconds(lambda: search.run(fil))
+                for _ in range(3)
+            )
+            if d > 0
+        )
+    device_s = _median(dev_samples)
 
     # PRODUCTION configuration (first-class, BASELINE.md row): identity-
     # trial dedupe ON — the shipped default; bitwise-identical
     # candidates, only DISTINCT resamplings dispatched (this grid is one
-    # identity class per DM, so ~44x less device work)
+    # identity class per DM, so ~44x less device work). Median of 5
+    # device traces (VERDICT r4 item 6): the 21 ms device sample is
+    # small, so the spread is part of the record.
     dsearch = PeasoupSearch(SearchConfig(**grid))
     dsearch.run(fil)
     dsearch.run(fil)
     dtimes = sorted(dsearch.run(fil).timers["searching"] for _ in range(3))
     dedupe_median = dtimes[1]
-    dedupe_device_s = _device_busy_seconds(lambda: dsearch.run(fil))
+    if force_wall:
+        ddev_samples = []
+    else:
+        ddev_samples = sorted(
+            d
+            for d in (
+                _device_busy_seconds(lambda: dsearch.run(fil))
+                for _ in range(5)
+            )
+            if d > 0
+        )
+    dedupe_device_s = _median(ddev_samples)
 
     # sanity: the search must still find the pulsar, else the number is void
     top = res.candidates[0]
     assert abs(1.0 / top.freq - 0.25) < 0.001 and top.snr > 80, (
         "benchmark run failed to recover the golden candidate"
     )
+
+    # secondary pinned grid (2^21-sample series; BASELINE.md "Big
+    # grid, round 5") — best-effort: a failure voids its fields, not
+    # the primary record
+    big: dict = {}
+    if os.environ.get("PEASOUP_BENCH_BIG", "1") != "0":
+        try:
+            big = _bench_big_grid(force_wall)
+            print(f"big grid: {big}", file=sys.stderr)
+        except Exception as exc:
+            print(f"big-grid bench failed: {exc!r}", file=sys.stderr)
 
     # weather-proof primary (BASELINE.md "Official benchmark
     # definition, round 4"): the chip's brute-force rate by device-busy
@@ -351,11 +530,15 @@ def main() -> int:
                 "vs_baseline": round(value / baseline, 4),
                 "value_anchor": anchor,
                 "device_busy_s": round(device_s, 3),
+                "device_busy_all_s": [round(d, 4) for d in dev_samples],
                 "wall_median_s": round(searching, 3),
                 "wall_all_s": [round(t, 3) for t in times],
                 "wall_trials_per_sec": round(wall_value, 2),
                 "production_dedupe_wall_median_s": round(dedupe_median, 3),
                 "production_dedupe_device_busy_s": round(dedupe_device_s, 3),
+                "production_dedupe_device_all_s": [
+                    round(d, 4) for d in ddev_samples
+                ],
                 "production_dedupe_trials_per_sec_effective": round(
                     n_trials / dedupe_median, 2
                 ),
@@ -364,6 +547,7 @@ def main() -> int:
                     if dedupe_device_s
                     else 0.0
                 ),
+                **big,
             }
         )
     )

@@ -243,8 +243,23 @@ def _sub24_default_safe() -> bool:
     # rc 0: validated on TPU -> 'ok'. rc 3: no TPU hardware on this
     # machine -> 'notpu' (24 is risk-free here — compiled Mosaic
     # kernels are gated off by backend_supports_pallas — but a TPU
-    # machine reading this cache re-probes; see the read side). rc < 0:
-    # signal death -> 'bad'.
+    # machine reading this cache re-probes; see the read side).
+    # Signal death: only ABORT-class signals (the Mosaic fault this
+    # probe exists for) persist 'bad' — an operator's Ctrl-C or the
+    # OOM-killer mid-probe must stay inconclusive, or it would pin the
+    # slow path on this machine forever.
+    import signal
+
+    if rc < 0 and -rc not in (
+        signal.SIGABRT, signal.SIGSEGV, signal.SIGILL, signal.SIGFPE,
+        signal.SIGBUS,
+    ):
+        warnings.warn(
+            f"PEASOUP_PEAKS_SUB probe subprocess was killed (signal "
+            f"{-rc}); treating as inconclusive — using 8 for this "
+            "process, nothing persisted."
+        )
+        return False
     ok = rc in (0, 3)
     try:
         _os.makedirs(cache_dir, exist_ok=True)

@@ -1273,6 +1273,30 @@ class PeasoupSearch:
         seg_off0 = np.concatenate(
             [np.zeros(1, np.int64), np.cumsum(seg_counts)]
         )
+        # per-row acceleration lookup, built ONCE here and reused by
+        # both the tie capture below and the post-distill s_acc lookup
+        max_a = max((len(a) for a in accel_lists[: dm_plan.ndm]), default=1)
+        acc_tab = np.zeros((dm_plan.ndm, max(max_a, 1)))
+        for di, accs in enumerate(accel_lists[: dm_plan.ndm]):
+            acc_tab[di, : len(accs)] = accs
+        if os.environ.get("PEASOUP_TIE_CAPTURE"):
+            # tie-stability capture (tools/tie_mc.py): the raw pre-sort
+            # rows + segment structure — everything needed to replay
+            # the full distill chain offline under S/N perturbations
+            # (PARITY.md acc-tie analysis). Written, not kept: the
+            # analysis runs in its own process.
+            np.savez(
+                os.environ["PEASOUP_TIE_CAPTURE"],
+                freqs=freqs_all, snr=snr_all, lvl=lvl_all, a=a_all,
+                seg_counts=seg_counts, dm_of_seg=dm_of_seg,
+                acc_tab=acc_tab, dm_list=dm_plan.dm_list,
+                harm_tol=harm_finder.tolerance,
+                harm_max=harm_finder.max_harm,
+                harm_frac=harm_finder.fractional_harms,
+                acc_tobs_over_c=acc_still.tobs_over_c,
+                acc_tol=acc_still.tolerance,
+                freq_tol=cfg.freq_tol, max_harm=cfg.max_harm,
+            )
         order = native.snr_sort_perm_seg(
             snr_all.astype(np.float32), seg_off0
         )
@@ -1292,11 +1316,8 @@ class PeasoupSearch:
         s_snr = snr_all[surv]
         s_freq = freqs_all[surv]
 
-        # per-row acceleration values via a padded (ndm, maxA) lookup
-        max_a = max((len(a) for a in accel_lists[: dm_plan.ndm]), default=1)
-        acc_tab = np.zeros((dm_plan.ndm, max(max_a, 1)))
-        for di, accs in enumerate(accel_lists[: dm_plan.ndm]):
-            acc_tab[di, : len(accs)] = accs
+        # per-row acceleration values via the padded (ndm, maxA) lookup
+        # built above (shared with the tie capture)
         s_acc = acc_tab[s_dm, s_a]
 
         # the acceleration distill runs as ONE segmented native call

@@ -52,6 +52,34 @@ class ScopeResult:
         for scope, s, gb in self.table(depth, top):
             print(f"  {s * 1e3:8.1f} ms  {gb:8.2f} GB  {scope}")
 
+    # top-level jit names per pipeline phase (bench.py --survey's
+    # device anchor): the driver's phases dispatch distinct jitted
+    # programs, so the trace's tf_op head classifies device time even
+    # though the phases share one traced run
+    PHASES = (
+        ("search", ("search_dm_block", "compact_peaks", "pack_chunk",
+                    "resample_select", "search_trial")),
+        ("dedisp", ("jit(run)", "dedisperse", "subband", "unpack_fil",
+                    "_stage1", "_stage2", "tims")),
+        ("fold", ("fold", "deredden", "_optimise", "pack_subints")),
+    )
+
+    def phase_seconds(self) -> dict:
+        """Device-busy seconds per pipeline phase + 'other' for
+        anything unclassified (kept visible so mis-attribution can't
+        hide)."""
+        out = {name: 0.0 for name, _ in self.PHASES}
+        out["other"] = 0.0
+        for op, us, _ in self.events:
+            head = op.split("/")[0] if op else ""
+            for name, pats in self.PHASES:
+                if any(p in head for p in pats):
+                    out[name] += us / 1e6
+                    break
+            else:
+                out["other"] += us / 1e6
+        return out
+
 
 @contextlib.contextmanager
 def scope_trace():

@@ -20,9 +20,12 @@ tools/divergence.py):
   three accel trials produce BITWISE-IDENTICAL spectra).  The
   reference crowns a tie member via std::sort's unstable arrangement;
   we replay the same libstdc++ introsort (native ps_snr_sort_perm) and
-  match the crowned member on >= 6 of 10 — the rest flip on
-  sub-1e-3-S/N comparator outcomes between UNRELATED candidates, which
-  no independent FFT implementation can pin down (PARITY.md).
+  match the crowned member on exactly 6 of 10.  Round 5 CLOSED the
+  question of the other four: the Monte-Carlo proof
+  (test_acc_tie_crowns_are_noise, PARITY.md r5) shows ALL TEN crowns
+  flip under S/N perturbations 40x below the combined FFT-rounding
+  bound — crown identity is comparator noise, and 6/10 is within
+  chance of the 10/3 a uniform 3-way draw expects.
 """
 
 import os
@@ -41,20 +44,28 @@ pytestmark = pytest.mark.skipif(
 
 @pytest.fixture(scope="session")
 def golden_run_outdir(tutorial_fil, tmp_path_factory):
-    """One full golden-flags CLI run per test session (~100 s on CPU)."""
+    """One full golden-flags CLI run per test session (~100 s on CPU).
+    Also captures the raw pre-sort distill rows (PEASOUP_TIE_CAPTURE)
+    so the acc-tie Monte-Carlo proof reuses this run."""
     from peasoup_tpu.cli.peasoup import main
 
     outdir = str(tmp_path_factory.mktemp("golden_run"))
-    rc = main(
-        [
-            "-i", tutorial_fil,
-            "-o", outdir,
-            "--dm_end", "250",
-            "--acc_start", "-5",
-            "--acc_end", "5",
-            "--npdmp", "10",
-        ]
+    os.environ["PEASOUP_TIE_CAPTURE"] = os.path.join(
+        outdir, "tie_capture.npz"
     )
+    try:
+        rc = main(
+            [
+                "-i", tutorial_fil,
+                "-o", outdir,
+                "--dm_end", "250",
+                "--acc_start", "-5",
+                "--acc_end", "5",
+                "--npdmp", "10",
+            ]
+        )
+    finally:
+        os.environ.pop("PEASOUP_TIE_CAPTURE", None)
     assert rc == 0
     return outdir
 
@@ -70,10 +81,11 @@ def test_golden_recall_100pct(golden_run_outdir):
 
 def test_golden_matches_are_tight(golden_run_outdir):
     """Beyond recall: frequency and DM bit-exact, nh exact, S/N within
-    5e-4 (measured 2e-4), acc within the exact-tie cluster with >= 6/10
-    winners matching the reference's std::sort arrangement (measured
-    6/10; the rest flip on sub-ULP comparator outcomes, PARITY.md), and
-    the ten golden candidates occupy the top ten ranks of our list.
+    5e-4 (measured 2e-4), acc within the exact-tie cluster with the
+    crowned winner matching the reference's std::sort arrangement on
+    exactly the measured 6/10 (crown identity is PROVEN comparator
+    noise — test_acc_tie_crowns_are_noise / PARITY.md r5), and the ten
+    golden candidates occupy the top ten ranks of our list.
 
     Gates are set to the round-3 MEASURED state, not loose floors, so
     any drift is caught.  The CLI run under test uses the production
@@ -92,7 +104,12 @@ def test_golden_matches_are_tight(golden_run_outdir):
         # under half a sample): any crowned member is value-identical
         assert m.golden_acc + m.dacc in (-5.0, 0.0, 5.0), m
         n_acc_exact += m.dacc == 0.0
-    assert n_acc_exact >= 6, [m.dacc for m in rep.matches]
+    # EXACT measured state (r5): crown identity is PROVEN comparator
+    # noise for all ten candidates (test_acc_tie_crowns_are_noise /
+    # PARITY.md r5 closure) — any value in 0..10 would be equally
+    # "correct"; this equality is a numerics-drift tripwire only.
+    # If a deliberate numeric change flips it, re-measure and repin.
+    assert n_acc_exact == 6, [m.dacc for m in rep.matches]
     # every golden candidate at its EXACT golden rank: the final order
     # is max(snr, folded_snr) desc (folder.hpp:25-31), so this also
     # pins fold-S/N parity at the rank-deciding level (the r3 f32-tsamp
@@ -176,6 +193,49 @@ def test_golden_fold_parity(golden_run_outdir):
         assert abs(ofs - gfs) / max(gfs, 1.0) < 0.02, (key, ofs, gfs)
         n_checked += 1
     assert n_checked >= 10
+
+
+def test_acc_tie_crowns_are_noise(golden_run_outdir):
+    """The acc-tie closure proof (PARITY.md round 5, VERDICT r4 item
+    4): every golden candidate's crowned acceleration flips under iid
+    S/N perturbations of 1e-5 — 40x below the combined FFT-rounding
+    bound of the two implementations (ours <= 4.2e-3, CUDA ~1e-4) —
+    so crown identity is comparator noise, not a reproducible target.
+    Also checks the offline replay is faithful: unperturbed replay
+    crowns == the actual CLI run's crowns."""
+    from peasoup_tpu.tools.parsers import OverviewFile
+    from peasoup_tpu.tools.tie_mc import (
+        crowns_for_golden, load_capture, mc_crown_stability, replay,
+    )
+
+    cap_path = os.path.join(golden_run_outdir, "tie_capture.npz")
+    assert os.path.exists(cap_path), "driver capture hook did not fire"
+    cap = load_capture(cap_path)
+    g = OverviewFile(GOLDEN_OVERVIEW).candidates
+    golden_freqs = 1.0 / np.asarray([float(r["period"]) for r in g])
+
+    # replay fidelity: same crowns as the real run
+    ours = OverviewFile(
+        os.path.join(golden_run_outdir, "overview.xml")
+    ).candidates
+    base = crowns_for_golden(replay(cap, cap["snr"]), golden_freqs)
+    assert all(b is not None for b in base)
+    our_by_freq = {}
+    for r in ours:
+        our_by_freq[round(1.0 / float(r["period"]), 4)] = float(r["acc"])
+    for gf, b in zip(golden_freqs, base):
+        key = round(float(gf), 4)
+        assert key in our_by_freq, (key, sorted(our_by_freq))
+        assert abs(our_by_freq[key] - b[0]) < 1e-9, (key, our_by_freq[key], b)
+
+    # the proof: at delta ONE-FORTIETH of the combined bound, every
+    # crown is unstable (measured: ~uniform over the {0,-5,+5} tie
+    # cluster at 200 draws; 30 draws make P(all-same-by-chance) ~ 3e-14
+    # per candidate, so this cannot flake)
+    res = mc_crown_stability(
+        cap, golden_freqs, n_draws=30, delta=1e-5, seed=2
+    )
+    assert sum(res["unstable"]) == 10, res["histograms"]
 
 
 # ---- fast unit tests of the matcher itself (no pipeline run) ----------

@@ -47,3 +47,27 @@ def test_bench_device_busy_helper_returns_float():
 
     v = bench._device_busy_seconds(lambda: None)
     assert isinstance(v, float) and v >= 0.0
+
+
+def test_phase_seconds_classifies_pipeline_jits():
+    """bench.py --survey's device anchor: the per-phase split must
+    route each pipeline jit to its phase and keep the rest visible in
+    'other' (mis-attribution may never hide)."""
+    r = ScopeResult()
+    r.events = [
+        ("jit(search_dm_block)/Harmonic summing", 1e6, 0),
+        ("jit(compact_peaks_device)/jit(_take)", 2e6, 0),
+        ("jit(resample_select_packed_planes)/select_n", 1e6, 0),
+        ("jit(run)/pallas_call:", 3e6, 0),       # dedispersion wrapper
+        ("jit(unpack_fil_device)/and:", 1e6, 0),
+        ("jit(dedisperse_block)/while", 1e6, 0),
+        ("jit(_deredden_tim)/fft", 2e6, 0),
+        ("jit(fold_bins)/scatter", 1e6, 0),
+        ("jit(mystery_op)/mul", 5e5, 0),
+    ]
+    ph = r.phase_seconds()
+    assert ph["search"] == pytest.approx(4.0)
+    assert ph["dedisp"] == pytest.approx(5.0)
+    assert ph["fold"] == pytest.approx(3.0)
+    assert ph["other"] == pytest.approx(0.5)
+    assert sum(ph.values()) == pytest.approx(r.device_s)
