@@ -261,6 +261,21 @@ def bench_survey() -> int:
                     "fold_device_s": round(phase_dev.get("fold", 0.0), 3),
                     "other_device_s": round(phase_dev.get("other", 0.0), 3),
                     "total_device_s": round(phase_dev.get("total", 0.0), 3),
+                    # at survey trace durations (20+ min) the profiler
+                    # can drop per-op tf_op attribution, landing a
+                    # phase's device time in 'other' — flag it so a
+                    # zero phase under a large wall is never read as
+                    # "no device work" (total_device_s stays honest).
+                    # Complete = the trace exists AND every phase with
+                    # substantial wall got SOME attributed device time.
+                    "device_attrib_complete": bool(phase_dev) and all(
+                        phase_dev.get(ph, 0.0) > 0.0 or wall_ph < 60.0
+                        for ph, wall_ph in (
+                            ("dedisp", t_dedisp),
+                            ("search", t_search),
+                            ("fold", t_fold),
+                        )
+                    ),
                 },
             }
         )
