@@ -49,6 +49,18 @@ def test_bench_device_busy_helper_returns_float():
     assert isinstance(v, float) and v >= 0.0
 
 
+def test_bench_median_is_a_true_median():
+    """Even-count sample sets (a failed trace shrinks odd to even) must
+    average the middle pair, not report the upper element as 'median'."""
+    import bench
+
+    assert bench._median([]) == 0.0
+    assert bench._median([3.0]) == 3.0
+    assert bench._median([5.0, 1.0, 3.0]) == 3.0
+    assert bench._median([4.0, 1.0]) == pytest.approx(2.5)
+    assert bench._median([1.0, 9.0, 2.0, 4.0]) == pytest.approx(3.0)
+
+
 def test_phase_seconds_classifies_pipeline_jits():
     """bench.py --survey's device anchor: the per-phase split must
     route each pipeline jit to its phase and keep the rest visible in
