@@ -193,16 +193,9 @@ def _rev_rows2(z, anti_rows):
     return _bd(a, zs[0], dn) + _bd(a, zs[1], dn)
 
 
-def _row_dft(ar, ai, w1s, w1is, w2s, w2is, twtr, twti):
-    """One plane's packed four-step DFT at the 3-pass class: (n1, n2)
-    even/odd planes -> Z as (k2, k1) with flat bin k = k1 + n1*k2.
-    Shared VERBATIM by the kernel and the jnp twin so the twin is a
-    contraction-order-exact oracle."""
-    ars = _split2_b16(ar)
-    ais = _split2_b16(ai)
-    # step 1 (contract j1): Ct (j2, l) — complex (W1r + iW1i)(ar + i*ai)
-    ctr = _dot3(ars, w1s) - _dot3(ais, w1is)
-    cti = _dot3(ais, w1s) + _dot3(ars, w1is)
+def _row_dft_tail(ctr, cti, w2s, w2is, twtr, twti):
+    """Steps 2+3 of one plane's DFT from its step-1 result Ct (j2, l):
+    twiddle, then the j2 contraction emitting Z as (k2, k1)."""
     # step 2 twiddle in transposed (j2, l) space
     ttr = ctr * twtr - cti * twti
     tti = ctr * twti + cti * twtr
@@ -212,6 +205,24 @@ def _row_dft(ar, ai, w1s, w1is, w2s, w2is, twtr, twti):
     zr = _dot3(w2s, ttrs) - _dot3(w2is, ttis)
     zi = _dot3(w2s, ttis) + _dot3(w2is, ttrs)
     return zr, zi
+
+
+_DNB = (((1,), (0,)), ((), ()))  # contract j1 of (S, n1, n2) with w dim0
+
+
+def _stripe_dft_step1(xe3, xo3, w1s, w1is):
+    """Step 1 for a whole (S, n1, n2) stripe, BATCHED: contracting j1
+    against W1 makes each dot M = S*n2 rows instead of n2 (better MXU
+    utilisation at these small tiles), with the complex
+    (W1r + iW1i)(ar + i*ai) result emitted naturally (S, j2, l).
+    Shared VERBATIM by the kernel and the twin (same _dot3 contract,
+    just the _DNB dimension numbers) so batched-matmul accumulation
+    blocking can never open a kernel/twin gap."""
+    ars = _split2_b16(xe3)
+    ais = _split2_b16(xo3)
+    ctr = _dot3(ars, w1s, _DNB) - _dot3(ais, w1is, _DNB)
+    cti = _dot3(ais, w1s, _DNB) + _dot3(ars, w1is, _DNB)
+    return ctr, cti
 
 
 def _row_spectrum(
@@ -287,9 +298,12 @@ def _kernel(
     twtr = twtr_ref[:]
     twti = twti_ref[:]
 
+    ctr3, cti3 = _stripe_dft_step1(
+        xe_ref[:], xo_ref[:], w1s, w1is
+    )  # (S, n2, n1) each
     for r in range(_SUB):
-        zr3[r], zi3[r] = _row_dft(
-            xe_ref[r], xo_ref[r], w1s, w1is, w2s, w2is, twtr, twti
+        zr3[r], zi3[r] = _row_dft_tail(
+            ctr3[r], cti3[r], w2s, w2is, twtr, twti
         )
 
     # ---- untwist + interbin + normalise over the whole stripe ----
@@ -522,10 +536,10 @@ def dft_untwist_interbin_twin(
     npad: int,
 ) -> jnp.ndarray:
     """Pure-jnp contraction-exact replay of :func:`dft_untwist_interbin`:
-    the SAME helper functions (_row_dft / _row_spectrum) run outside
-    Pallas, with ``jnp.roll`` standing in for ``pltpu.roll`` (identical
-    circular semantics) and a Python loop over rows so every dot has
-    the kernel's exact operand shapes. On a given backend the op
+    the SAME helper functions (_stripe_dft_step1 / _row_dft_tail /
+    _row_spectrum) run outside Pallas, with ``jnp.roll`` standing in
+    for ``pltpu.roll`` (identical circular semantics) and the kernel's
+    exact stripe batching so every dot has the kernel's operand shapes. On a given backend the op
     sequence — bf16 splits, three-pass dots, one-hot flips, rolls —
     is identical term for term, so beyond accumulation-order noise
     (Mosaic MXU vs XLA dots: measured <= 8.9e-6 of the 3e-5 per-bin
@@ -552,17 +566,32 @@ def dft_untwist_interbin_twin(
     xo3 = xo3.astype(jnp.float32)
     mean2 = mean.astype(jnp.float32)
     std2 = std.astype(jnp.float32)
+    # replicate the kernel's _SUB-row stripes exactly, including the
+    # BATCHED step-1 dot per stripe (shared _stripe_dft_step1): the
+    # batched matmul's accumulation blocking is then identical by
+    # construction, not by hope
+    rpad = -(-r // _SUB) * _SUB
+    if rpad != r:
+        pad3 = [(0, rpad - r), (0, 0), (0, 0)]
+        xe3 = jnp.pad(xe3, pad3)
+        xo3 = jnp.pad(xo3, pad3)
     rows = []
-    for i in range(r):
-        zr, zi = _row_dft(
-            xe3[i], xo3[i], w1s, w1is, w2s, w2is, twtr, twti
-        )
-        main, nyq = _row_spectrum(
-            zr, zi, unc, uns, anti_n, anti128, mean2[i], std2[i],
-            n1=n1, n2=n2, roll=jnp.roll,
-        )
-        blk = jnp.zeros((kpad, n1), jnp.float32)
-        blk = blk.at[:n2].set(main)
-        blk = blk.at[n2, 0].set(nyq[0, 0])
-        rows.append(blk.reshape(npad))
+    for st in range(rpad // _SUB):
+        sl = slice(st * _SUB, (st + 1) * _SUB)
+        ctr3, cti3 = _stripe_dft_step1(xe3[sl], xo3[sl], w1s, w1is)
+        for i in range(_SUB):
+            gr = st * _SUB + i
+            if gr >= r:
+                break
+            zr, zi = _row_dft_tail(
+                ctr3[i], cti3[i], w2s, w2is, twtr, twti
+            )
+            main, nyq = _row_spectrum(
+                zr, zi, unc, uns, anti_n, anti128, mean2[gr], std2[gr],
+                n1=n1, n2=n2, roll=jnp.roll,
+            )
+            blk = jnp.zeros((kpad, n1), jnp.float32)
+            blk = blk.at[:n2].set(main)
+            blk = blk.at[n2, 0].set(nyq[0, 0])
+            rows.append(blk.reshape(npad))
     return jnp.stack(rows)

@@ -660,7 +660,8 @@ class TestPallasDftspec:
     oracle design as probe_pallas_dftspec:
 
     1. per-bin vs dft_untwist_interbin_twin — the kernel's helpers
-       (_row_dft/_row_spectrum) run outside Pallas with identical term
+       (_stripe_dft_step1/_row_dft_tail/_row_spectrum) run outside
+       Pallas with identical term
        grouping, asserted at the FMA-codegen envelope
        (_assert_per_bin_twin; bitwise when both compile fresh).
     2. accuracy class vs the exact Precision.HIGHEST einsum chain:
