@@ -539,9 +539,10 @@ def dft_untwist_interbin_twin(
     the SAME helper functions (_stripe_dft_step1 / _row_dft_tail /
     _row_spectrum) run outside Pallas, with ``jnp.roll`` standing in
     for ``pltpu.roll`` (identical circular semantics) and the kernel's
-    exact stripe batching so every dot has the kernel's operand shapes. On a given backend the op
-    sequence — bf16 splits, three-pass dots, one-hot flips, rolls —
-    is identical term for term, so beyond accumulation-order noise
+    exact stripe batching so every dot has the kernel's operand
+    shapes. On a given backend the op sequence — bf16 splits,
+    three-pass dots, one-hot flips, rolls — is identical term for
+    term, so beyond accumulation-order noise
     (Mosaic MXU vs XLA dots: measured <= 8.9e-6 of the 3e-5 per-bin
     envelope on v5e; bitwise 0 under fresh same-backend CPU compiles)
     any kernel/twin difference is a broken Mosaic lowering. Used by

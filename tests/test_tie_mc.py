@@ -1,0 +1,100 @@
+"""Unit tests for the acc-tie Monte-Carlo replay (tools/tie_mc.py) on a
+SYNTHETIC capture — the golden-run integration proof lives in
+tests/test_recall.py::test_acc_tie_crowns_are_noise; these tests pin the
+replay mechanics themselves (distill chain wiring, crown lookup,
+perturbation plumbing) without the ~100 s pipeline fixture."""
+
+import numpy as np
+import pytest
+
+from peasoup_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native runtime required for the replay"
+)
+
+
+def _capture(snrs, freqs, dm_of_seg, seg_counts, accs):
+    """Minimal capture dict: one accel trial per DM (segments == DMs),
+    all rows harmonic level 0, accel index 0."""
+    n = len(snrs)
+    return {
+        "freqs": np.asarray(freqs, np.float64),
+        "snr": np.asarray(snrs, np.float64),
+        "lvl": np.zeros(n, np.int32),
+        "a": np.zeros(n, np.int32),
+        "seg_counts": np.asarray(seg_counts, np.int64),
+        "dm_of_seg": np.asarray(dm_of_seg, np.int64),
+        "acc_tab": np.asarray(accs, np.float64).reshape(-1, 1),
+        "dm_list": np.linspace(0.0, 10.0, len(accs)),
+        "harm_tol": np.float64(1e-4),
+        "harm_max": np.int64(16),
+        "harm_frac": np.bool_(False),
+        "acc_tobs_over_c": np.float64(1e-7),
+        "acc_tol": np.float64(1e-4),
+        "freq_tol": np.float64(1e-4),
+        "max_harm": np.int64(16),
+    }
+
+
+def test_replay_crowns_strongest_and_absorbs_related():
+    from peasoup_tpu.tools.tie_mc import crowns_for_golden, replay
+
+    # two DMs, same frequency, different S/N: the DM distiller must
+    # crown the stronger row; an unrelated frequency survives alongside
+    cap = _capture(
+        snrs=[12.0, 20.0, 9.5],
+        freqs=[100.0, 100.0, 37.0],
+        dm_of_seg=[0, 1],
+        seg_counts=[2, 1],  # rows 0,1 -> DM 0; row 2 -> DM 1
+        accs=[1.0, -2.0],
+    )
+    # seg 0 (dm 0) holds the two equal-frequency rows — the harmonic
+    # distill inside the segment absorbs the weaker one; seg 1 (dm 1)
+    # holds the unrelated 37.0 Hz row
+    cands = replay(cap, cap["snr"])
+    got = {round(c.freq, 3): (c.snr, c.dm_idx) for c in cands}
+    assert got[100.0][0] == 20.0  # strongest equal-freq row crowned
+    assert 37.0 in got
+    crowns = crowns_for_golden(cands, np.asarray([100.0, 37.0]))
+    assert crowns[0] is not None and crowns[0][1] == 20.0
+    assert crowns[1] is not None and crowns[1][1] == 9.5
+
+
+def test_replay_responds_to_snr_vector():
+    """The same capture replayed with a different S/N vector must crown
+    the other row — the perturbation plumbing the MC relies on."""
+    from peasoup_tpu.tools.tie_mc import crowns_for_golden, replay
+
+    cap = _capture(
+        snrs=[12.0, 20.0],
+        freqs=[100.0, 100.0],
+        dm_of_seg=[0, 1],
+        seg_counts=[1, 1],
+        accs=[1.0, -2.0],
+    )
+    base = crowns_for_golden(replay(cap, cap["snr"]), np.asarray([100.0]))
+    flipped = crowns_for_golden(
+        replay(cap, np.asarray([30.0, 20.0])), np.asarray([100.0])
+    )
+    assert base[0][1] == 20.0 and base[0][0] == -2.0
+    assert flipped[0][1] == 30.0 and flipped[0][0] == 1.0
+
+
+def test_mc_reports_stable_when_gaps_exceed_delta():
+    """Well-separated S/N values must NOT flag as unstable at a delta
+    far below the gap — the converse of the golden-run noise proof."""
+    from peasoup_tpu.tools.tie_mc import mc_crown_stability
+
+    cap = _capture(
+        snrs=[12.0, 20.0],
+        freqs=[100.0, 100.0],
+        dm_of_seg=[0, 1],
+        seg_counts=[1, 1],
+        accs=[1.0, -2.0],
+    )
+    res = mc_crown_stability(
+        cap, np.asarray([100.0]), n_draws=20, delta=1e-3, seed=0
+    )
+    assert res["unstable"] == [False]
+    assert res["baseline"][0][1] == 20.0
