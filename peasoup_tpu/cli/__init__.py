@@ -1,0 +1,46 @@
+"""CLI entry points.
+
+Shared observability wiring: every CLI (`peasoup`, `peasoup-ffa`,
+`coincidencer`) grows the same three flags — ``--log-level`` (stderr
+library logging), ``--metrics-json`` (the telemetry.json run manifest),
+``--capture-device-trace`` (per-scope device attribution folded into
+the manifest) — resolved here so flag names and semantics can't drift
+between tools.
+"""
+
+from __future__ import annotations
+
+
+def add_observability_args(p) -> None:
+    g = p.add_argument_group("observability")
+    g.add_argument(
+        "--log-level", dest="log_level", default=None,
+        choices=["debug", "info", "warning", "error"],
+        help="library log threshold (messages go to stderr; default "
+        "warning, or info with -v; PEASOUP_LOG_LEVEL also works)",
+    )
+    g.add_argument(
+        "--metrics-json", dest="metrics_json", default=None,
+        help="path for the telemetry.json run manifest (peasoup "
+        "defaults to <outdir>/telemetry.json; the other tools write "
+        "one only when this flag is given). Render/diff with "
+        "python -m peasoup_tpu.tools.report",
+    )
+    g.add_argument(
+        "--capture-device-trace", dest="capture_device_trace",
+        action="store_true",
+        help="profile the run with jax.profiler and fold per-scope "
+        "device-time/bytes attribution into the manifest (opt-in: "
+        "tracing costs wall time and memory)",
+    )
+
+
+def init_observability(args):
+    """Configure the library logger from parsed flags and return the
+    run's RunTelemetry (activate it around the pipeline call)."""
+    from ..obs import RunTelemetry, configure_logging
+
+    configure_logging(args.log_level, getattr(args, "verbose", False))
+    return RunTelemetry(
+        capture_device_trace=getattr(args, "capture_device_trace", False)
+    )

@@ -21,6 +21,8 @@ import sys
 
 import numpy as np
 
+from . import add_observability_args, init_observability
+
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
@@ -44,6 +46,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-b", "--max_harm", type=int, default=16)
     p.add_argument("-f", "--freq_tol", type=float, default=0.0001)
     p.add_argument("-v", "--verbose", action="store_true")
+    add_observability_args(p)
     return p
 
 
@@ -86,6 +89,10 @@ def main(argv: list[str] | None = None) -> int:
     from .peasoup import apply_platform_env
 
     apply_platform_env()
+    tel = init_observability(args)
+    tel.set_context(
+        command="coincidencer", n_beams=len(args.filterbanks)
+    )
 
     import jax.numpy as jnp
 
@@ -96,23 +103,25 @@ def main(argv: list[str] | None = None) -> int:
 
     tims = []
     tsamp = None
-    for path in args.filterbanks:
-        if args.verbose:
-            print(f"Reading and dedispersing {path}")
-        fil = read_filterbank(path)
-        plan = DMPlan.create(
-            nsamps=fil.nsamps, nchans=fil.nchans, tsamp=fil.tsamp,
-            fch1=fil.fch1, foff=fil.foff, dm_start=0.0, dm_end=0.0,
-            pulse_width=0.4, tol=1.1,
-        )
-        from ..ops.dedisperse import dedisperse, output_scale
+    with tel.stage("reading"):
+        for path in args.filterbanks:
+            if args.verbose:
+                print(f"Reading and dedispersing {path}")
+            fil = read_filterbank(path)
+            plan = DMPlan.create(
+                nsamps=fil.nsamps, nchans=fil.nchans, tsamp=fil.tsamp,
+                fch1=fil.fch1, foff=fil.foff, dm_start=0.0, dm_end=0.0,
+                pulse_width=0.4, tol=1.1,
+            )
+            from ..ops.dedisperse import dedisperse, output_scale
 
-        trial = dedisperse(
-            fil.data, plan.delay_samples(), plan.killmask, plan.out_nsamps,
-            scale=output_scale(fil.nbits, fil.nchans),
-        )[0]
-        tims.append(trial)
-        tsamp = fil.tsamp
+            trial = dedisperse(
+                fil.data, plan.delay_samples(), plan.killmask,
+                plan.out_nsamps,
+                scale=output_scale(fil.nbits, fil.nchans),
+            )[0]
+            tims.append(trial)
+            tsamp = fil.tsamp
     sizes = {len(t) for t in tims}
     if len(sizes) != 1:
         raise SystemExit("Not all filterbanks the same length")
@@ -125,24 +134,33 @@ def main(argv: list[str] | None = None) -> int:
     pos25 = int(args.boundary_25_freq / bin_width)
 
     specs, series = [], []
-    for t in tims:
-        if args.verbose:
-            print("Baselining beam")
-        spec, tim = baseline_beam(jnp.asarray(t[:size]), size=size, pos5=pos5,
-                                  pos25=pos25)
-        specs.append(np.asarray(spec))
-        series.append(np.asarray(tim))
+    with tel.activate(), tel.device_capture():
+        with tel.stage("baselining"):
+            for t in tims:
+                if args.verbose:
+                    print("Baselining beam")
+                spec, tim = baseline_beam(jnp.asarray(t[:size]), size=size,
+                                          pos5=pos5, pos25=pos25)
+                specs.append(np.asarray(spec))
+                series.append(np.asarray(tim))
 
-    if args.verbose:
-        print("Performing cross beam coincidence matching")
-    samp_mask = np.asarray(
-        coincidence_mask(jnp.asarray(np.stack(series)), args.thresh, args.beam_thresh)
-    )
-    spec_mask = np.asarray(
-        coincidence_mask(jnp.asarray(np.stack(specs)), args.thresh, args.beam_thresh)
-    )
+        if args.verbose:
+            print("Performing cross beam coincidence matching")
+        with tel.stage("coincidence"):
+            samp_mask = np.asarray(
+                coincidence_mask(jnp.asarray(np.stack(series)), args.thresh,
+                                 args.beam_thresh)
+            )
+            spec_mask = np.asarray(
+                coincidence_mask(jnp.asarray(np.stack(specs)), args.thresh,
+                                 args.beam_thresh)
+            )
     write_samp_mask(samp_mask, args.samp_outfilename)
     write_birdie_list(spec_mask, bin_width, args.spec_outfilename)
+    tel.gauge("mask.samples_flagged", int((samp_mask == 0).sum()))
+    tel.gauge("mask.bins_flagged", int((spec_mask == 0).sum()))
+    if args.metrics_json:
+        tel.write(args.metrics_json)
     if args.verbose:
         print(f"Wrote {args.samp_outfilename} and {args.spec_outfilename}")
     return 0

@@ -18,6 +18,8 @@ import time
 
 import numpy as np
 
+from . import add_observability_args, init_observability
+
 
 def get_default_ffa_output_filename() -> str:
     """UTC-stamped default like the reference's search CLI
@@ -60,6 +62,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Maximum candidates to write")
     p.add_argument("-v", "--verbose", action="store_true")
     p.add_argument("-p", "--progress_bar", action="store_true")
+    add_observability_args(p)
     return p
 
 
@@ -69,6 +72,10 @@ def main(argv=None) -> int:
     from .peasoup import apply_platform_env
 
     apply_platform_env()
+    tel = init_observability(args)
+    tel.set_context(
+        command="peasoup-ffa", inputfile=args.inputfile, outfile=out
+    )
 
     from ..io import read_filterbank
     from ..io.masks import read_killfile
@@ -78,8 +85,9 @@ def main(argv=None) -> int:
     from ..plan.dm_plan import DMPlan
     from ..utils import ProgressBar
 
-    t0 = time.time()
-    fil = read_filterbank(args.inputfile)
+    t0 = time.perf_counter()
+    with tel.stage("reading"):
+        fil = read_filterbank(args.inputfile)
     killmask = (
         read_killfile(args.killfile, fil.nchans) if args.killfile else None
     )
@@ -89,34 +97,40 @@ def main(argv=None) -> int:
         dm_end=args.dm_end, pulse_width=args.dm_pulse_width,
         tol=args.dm_tol, killmask=killmask,
     )
+    tel.gauge("search.n_dm_trials", int(dm_plan.ndm))
     if args.verbose:
         print(f"FFA search: {dm_plan.ndm} DM trials, periods "
               f"{args.p_start}-{args.p_end} s, min_dc {args.min_dc}")
     # trials are consumed on the host (one FFA per DM trial), so use
     # the host-resident dedisperse variant: HBM holds one block at a
     # time (packed upload + on-device unpack still apply)
-    trials = dedisperse(
-        fil_to_device(fil), dm_plan.delay_samples(), dm_plan.killmask,
-        dm_plan.out_nsamps,
-        scale=output_scale(fil.nbits, int(dm_plan.killmask.sum())),
-    )
+    with tel.activate(), tel.device_capture():
+        with tel.stage("dedispersion"):
+            trials = dedisperse(
+                fil_to_device(fil), dm_plan.delay_samples(),
+                dm_plan.killmask, dm_plan.out_nsamps,
+                scale=output_scale(fil.nbits, int(dm_plan.killmask.sum())),
+            )
+        tel.capture_device_memory("dedispersion")
 
-    progress = ProgressBar() if args.progress_bar else None
-    if progress:
-        progress.start()
-    if progress:
-        on_progress = progress.update
-    elif args.verbose:
-        on_progress = lambda f: print(f"FFA octaves: {f * 100:5.1f}% done")
-    else:
-        on_progress = None
-    # every octave folds the whole DM-trial block in a handful of
-    # batched dispatches (ops/ffa.py: ffa_search_block)
-    cands = ffa_search_block(
-        trials, fil.tsamp, args.p_start, args.p_end,
-        args.min_dc, dm_plan.dm_list, snr_min=args.min_snr,
-        progress=on_progress,
-    )
+        progress = ProgressBar() if args.progress_bar else None
+        if progress:
+            progress.start()
+        if progress:
+            on_progress = progress.update
+        elif args.verbose:
+            on_progress = lambda f: print(f"FFA octaves: {f * 100:5.1f}% done")
+        else:
+            on_progress = None
+        # every octave folds the whole DM-trial block in a handful of
+        # batched dispatches (ops/ffa.py: ffa_search_block)
+        with tel.stage("ffa_search"):
+            cands = ffa_search_block(
+                trials, fil.tsamp, args.p_start, args.p_end,
+                args.min_dc, dm_plan.dm_list, snr_min=args.min_snr,
+                progress=on_progress,
+            )
+        tel.capture_device_memory("ffa_search")
     if progress:
         progress.stop()
     if args.verbose:
@@ -141,12 +155,18 @@ def main(argv=None) -> int:
         el.append(Element("snr", c.snr))
         el.append(Element("width", c.width))
         el.append(Element("duty_cycle", c.dc))
+    total = time.perf_counter() - t0
+    tel.add_timer("total", total)
+    tel.gauge("candidates.final", len(unique))
     times = root.append(Element("execution_times"))
-    times.append(Element("total", time.time() - t0))
+    for key in sorted(tel.timers):
+        times.append(Element(key, float(tel.timers[key])))
     with open(out, "w") as f:
         f.write(root.to_string(header=True))
+    if args.metrics_json:
+        tel.write(args.metrics_json)
     print(f"Done: {len(unique)} FFA candidates -> {out} "
-          f"(total {time.time()-t0:.2f}s)")
+          f"(total {total:.2f}s)")
     return 0
 
 

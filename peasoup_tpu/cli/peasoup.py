@@ -8,8 +8,11 @@ Usage mirrors the CUDA original:
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
+
+from . import add_observability_args, init_observability
 
 
 def default_outdir() -> str:
@@ -82,6 +85,7 @@ def build_parser() -> argparse.ArgumentParser:
         "share their entire rounded resample-shift map (the dedupe is "
         "bitwise-output-equal; this flag exists for timing comparisons)",
     )
+    add_observability_args(p)
     return p
 
 
@@ -108,6 +112,10 @@ def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     outdir = args.outdir or default_outdir()
     apply_platform_env()
+    tel = init_observability(args)
+    tel.set_context(
+        command="peasoup", inputfile=args.inputfile, outdir=outdir
+    )
 
     # Resolve the peaks-kernel stripe height BEFORE anything creates
     # this process's jax client: the subprocess-isolated _SUB=24 probe
@@ -153,17 +161,18 @@ def main(argv: list[str] | None = None) -> int:
         subbands=args.subbands,
         subband_smear=args.subband_smear,
     )
-    t0 = time.time()
+    t0 = time.perf_counter()
     if args.progress_bar:
         print(f"Reading data from {args.inputfile}")
     fil = read_filterbank(args.inputfile)
-    reading = time.time() - t0
+    reading = time.perf_counter() - t0
 
     # multi-host aware (JAX_COORDINATOR_ADDRESS & co.): each process
     # searches its DM slice; single-process this is PeasoupSearch.run
     from ..parallel.multihost import run_search
 
-    result = run_search(fil, cfg)
+    with tel.activate(), tel.device_capture():
+        result = run_search(fil, cfg)
     result.timers["reading"] = reading
 
     import jax
@@ -171,8 +180,10 @@ def main(argv: list[str] | None = None) -> int:
     if jax.process_index() != 0:
         return 0  # every process holds the identical result; rank 0 writes
 
+    t0 = time.perf_counter()
     writer = CandidateFileWriter(outdir)
     writer.write_binary(result.candidates, "candidates.peasoup")
+    result.timers["writing"] = time.perf_counter() - t0
 
     stats = OutputFileWriter()
     stats.add_misc_info()
@@ -184,6 +195,15 @@ def main(argv: list[str] | None = None) -> int:
     stats.add_candidates(result.candidates, writer.byte_mapping)
     stats.add_timing_info(result.timers)
     stats.to_file(f"{outdir.rstrip('/')}/overview.xml")
+
+    # the machine-readable twin of overview.xml, written beside it
+    # unless --metrics-json redirects it
+    tel.merge_timers(result.timers)
+    tel.gauge("candidates.written", len(result.candidates))
+    tel.write(
+        args.metrics_json
+        or os.path.join(outdir.rstrip("/"), "telemetry.json")
+    )
     if args.verbose or args.progress_bar:
         print(
             f"Done: {len(result.candidates)} candidates -> {outdir} "

@@ -56,5 +56,5 @@ def build(force: bool = False) -> str | None:
 
 if __name__ == "__main__":
     path = build(force="--force" in sys.argv)
-    print(path or "BUILD FAILED")
+    sys.stdout.write(f"{path or 'BUILD FAILED'}\n")
     sys.exit(0 if path else 1)

@@ -1,0 +1,26 @@
+"""Run-scoped observability: structured logging (obs/log.py) and the
+telemetry subsystem (obs/telemetry.py) behind the versioned
+``telemetry.json`` run manifest. See README "Observability"."""
+
+from .log import configure as configure_logging
+from .log import get_logger, resolve_level
+from .telemetry import (
+    MANIFEST_SCHEMA,
+    MANIFEST_VERSION,
+    NOOP,
+    RunTelemetry,
+    current,
+    load_manifest,
+)
+
+__all__ = [
+    "configure_logging",
+    "get_logger",
+    "resolve_level",
+    "MANIFEST_SCHEMA",
+    "MANIFEST_VERSION",
+    "NOOP",
+    "RunTelemetry",
+    "current",
+    "load_manifest",
+]

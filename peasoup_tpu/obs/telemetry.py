@@ -1,0 +1,317 @@
+"""Run-scoped telemetry: the measurement layer under every BENCH entry.
+
+One :class:`RunTelemetry` object lives for the duration of a pipeline
+run (`PeasoupSearch.run`, the FFA search, the coincidencer). It
+collects:
+
+- **stage timers** — monotonic (``perf_counter``) per-stage wall time;
+  the keys mirror the ``<execution_times>`` table in overview.xml,
+- **counters / gauges** — trial counts, candidate counts per stage,
+  per-device memory high-water marks (``device.memory_stats()`` where
+  the backend reports them),
+- **events** — every adaptive decision the driver takes (OOM
+  shrink-retry with old/new ``dm_block``, Pallas-disable fallback,
+  peak-compaction escalation, wave/chunk geometry, checkpoint resume)
+  as structured records with a monotonic offset, replacing bare
+  warnings that used to vanish with the terminal scrollback,
+- **JIT stats** — compile/lowering counts and durations via
+  ``jax.monitoring`` listeners,
+- **device trace** (opt-in, ``--capture-device-trace``) — per-scope
+  device-time and bytes-accessed attribution folded in from
+  ``tools/scope_trace.py``'s profiler parsing.
+
+The result serialises to a versioned ``telemetry.json`` run manifest
+(written next to overview.xml by the `peasoup` CLI); render or diff
+manifests with ``python -m peasoup_tpu.tools.report``.
+
+Propagation is ambient: the driver calls :func:`current` to get the
+run's telemetry (activated by the CLI via ``RunTelemetry.activate``),
+so deep pipeline code records events without threading the object
+through every signature. When nothing is active, :data:`NOOP` absorbs
+every call at near-zero cost — library users who never asked for
+telemetry pay nothing and no file is written.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import os
+import socket
+import sys
+import time
+
+MANIFEST_SCHEMA = "peasoup_tpu.telemetry"
+MANIFEST_VERSION = 1
+
+_ACTIVE: contextvars.ContextVar["RunTelemetry | None"] = (
+    contextvars.ContextVar("peasoup_tpu_telemetry", default=None)
+)
+
+# jax.monitoring event-name substrings worth keeping (compile +
+# lowering); everything else (tracing cache misses etc.) is noise here
+_JIT_EVENT_KEYS = ("compile", "lower")
+_jit_listener_installed = False
+
+
+def current() -> "RunTelemetry":
+    """The active run's telemetry, or the module-level no-op sink."""
+    return _ACTIVE.get() or NOOP
+
+
+def _install_jit_listener() -> None:
+    """One process-wide jax.monitoring listener forwarding to whatever
+    telemetry is active at event time (the registry has no unregister,
+    so per-run listeners would accumulate)."""
+    global _jit_listener_installed
+    if _jit_listener_installed:
+        return
+    _jit_listener_installed = True
+    try:
+        from jax import monitoring
+
+        def _on_duration(event: str, duration: float, **kw) -> None:
+            tel = _ACTIVE.get()
+            if tel is not None and any(
+                k in event for k in _JIT_EVENT_KEYS
+            ):
+                tel.record_jit(event, float(duration))
+
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:
+        pass  # no monitoring API: manifests simply lack jit stats
+
+
+class RunTelemetry:
+    """Counters, gauges, stage timers and an event log for one run."""
+
+    def __init__(
+        self,
+        run_id: str | None = None,
+        capture_device_trace: bool = False,
+        enabled: bool = True,
+    ) -> None:
+        self.enabled = enabled
+        self.run_id = run_id or (
+            time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+            + f"-{os.getpid()}"
+        )
+        self.capture_device_trace = capture_device_trace
+        self.created_unix = time.time()
+        self._t0 = time.perf_counter()
+        self.context: dict = {}
+        self.counters: dict[str, float] = {}
+        self.gauges: dict[str, float] = {}
+        self.timers: dict[str, float] = {}
+        self.events: list[dict] = []
+        self.jit: dict[str, list] = {}  # event -> [count, total_s]
+        self.device_trace: dict | None = None
+        if enabled:
+            _install_jit_listener()
+
+    # --- recording ----------------------------------------------------
+    def set_context(self, **fields) -> None:
+        """Free-form run context (command, input file, config knobs)."""
+        if self.enabled:
+            self.context.update(fields)
+
+    def incr(self, name: str, by: float = 1) -> None:
+        if self.enabled:
+            self.counters[name] = self.counters.get(name, 0) + by
+
+    def gauge(self, name: str, value: float) -> None:
+        """Last-write-wins point-in-time value."""
+        if self.enabled:
+            self.gauges[name] = value
+
+    def gauge_max(self, name: str, value: float) -> None:
+        """High-water-mark gauge."""
+        if self.enabled:
+            self.gauges[name] = max(self.gauges.get(name, value), value)
+
+    def event(self, kind: str, **fields) -> dict | None:
+        """Append a structured record to the adaptive-event log. Field
+        values must be JSON-serialisable (stringify exceptions)."""
+        if not self.enabled:
+            return None
+        rec = {
+            "t": round(time.perf_counter() - self._t0, 6),
+            "kind": kind,
+            **fields,
+        }
+        self.events.append(rec)
+        return rec
+
+    @contextlib.contextmanager
+    def stage(self, name: str):
+        """Accumulating monotonic stage timer (same key space as the
+        overview.xml ``<execution_times>`` table)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if self.enabled:
+                self.timers[name] = self.timers.get(name, 0.0) + (
+                    time.perf_counter() - t0
+                )
+
+    def add_timer(self, name: str, seconds: float) -> None:
+        """Merge an externally measured duration into a stage timer."""
+        if self.enabled:
+            self.timers[name] = self.timers.get(name, 0.0) + seconds
+
+    def merge_timers(self, timers: dict[str, float]) -> None:
+        for k, v in timers.items():
+            self.add_timer(k, float(v))
+
+    def record_jit(self, event: str, seconds: float) -> None:
+        if self.enabled:
+            st = self.jit.setdefault(event, [0, 0.0])
+            st[0] += 1
+            st[1] += seconds
+
+    def capture_device_memory(self, tag: str) -> None:
+        """Per-device memory high-water marks where the backend reports
+        them (``memory_stats`` is absent on some backends, e.g. CPU)."""
+        if not self.enabled:
+            return
+        try:
+            import jax
+
+            devs = jax.local_devices()
+        except Exception:
+            return
+        peak = 0
+        for d in devs:
+            try:
+                stats = d.memory_stats() or {}
+            except Exception:
+                stats = {}
+            peak = max(
+                peak,
+                int(
+                    stats.get("peak_bytes_in_use")
+                    or stats.get("bytes_in_use")
+                    or 0
+                ),
+            )
+        if peak:
+            self.gauge_max(f"memory.{tag}.peak_bytes", peak)
+            self.gauge_max("memory.peak_bytes", peak)
+
+    # --- activation ---------------------------------------------------
+    @contextlib.contextmanager
+    def activate(self):
+        """Make this object the run's ambient telemetry (``current()``)
+        for the duration of the with-block."""
+        token = _ACTIVE.set(self if self.enabled else None)
+        try:
+            yield self
+        finally:
+            _ACTIVE.reset(token)
+
+    @contextlib.contextmanager
+    def device_capture(self):
+        """Opt-in profiler capture: wrap the block in a
+        ``jax.profiler.trace`` and fold the parsed per-scope
+        device-time/bytes attribution (tools/scope_trace.py) into the
+        manifest. No-op unless ``capture_device_trace`` was requested —
+        tracing costs memory and wall time."""
+        if not (self.enabled and self.capture_device_trace):
+            yield
+            return
+        from ..tools.scope_trace import scope_trace
+
+        with scope_trace() as res:
+            yield
+        self.device_trace = {
+            "device_s": res.device_s,
+            "phases": res.phase_seconds(),
+            "table": [
+                {"scope": k, "seconds": s, "gigabytes": gb}
+                for k, s, gb in res.table()
+            ],
+        }
+
+    # --- serialisation ------------------------------------------------
+    def _platform(self) -> dict:
+        info: dict = {"python": sys.version.split()[0]}
+        try:
+            import jax
+
+            info["jax"] = jax.__version__
+            info["backend"] = jax.default_backend()
+            info["process_index"] = jax.process_index()
+            info["process_count"] = jax.process_count()
+            info["devices"] = [
+                {
+                    "id": d.id,
+                    "platform": str(d.platform),
+                    "kind": str(d.device_kind),
+                }
+                for d in jax.local_devices()
+            ]
+        except Exception:
+            pass  # platform info must never fail a run
+        return info
+
+    def to_manifest(self) -> dict:
+        """The versioned run manifest. Key order is fixed (schema and
+        version lead) so manifests diff cleanly in text tools too."""
+        return {
+            "schema": MANIFEST_SCHEMA,
+            "version": MANIFEST_VERSION,
+            "run_id": self.run_id,
+            "created_unix": self.created_unix,
+            "duration_s": round(time.perf_counter() - self._t0, 6),
+            "hostname": socket.gethostname(),
+            "pid": os.getpid(),
+            "platform": self._platform(),
+            "context": self.context,
+            "timers": {k: self.timers[k] for k in sorted(self.timers)},
+            "counters": {
+                k: self.counters[k] for k in sorted(self.counters)
+            },
+            "gauges": {k: self.gauges[k] for k in sorted(self.gauges)},
+            "jit": {
+                k: {"count": v[0], "seconds": v[1]}
+                for k, v in sorted(self.jit.items())
+            },
+            "events": self.events,
+            "device_trace": self.device_trace,
+        }
+
+    def write(self, path: str) -> dict:
+        """Serialise the manifest to ``path`` (atomic replace) and
+        return it."""
+        man = self.to_manifest()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(man, f, indent=2)
+            f.write("\n")
+        os.replace(tmp, path)
+        return man
+
+
+NOOP = RunTelemetry(enabled=False)
+
+
+def load_manifest(path: str) -> dict:
+    """Load + validate a telemetry.json manifest."""
+    with open(path) as f:
+        man = json.load(f)
+    if man.get("schema") != MANIFEST_SCHEMA:
+        raise ValueError(
+            f"{path}: not a {MANIFEST_SCHEMA} manifest "
+            f"(schema={man.get('schema')!r})"
+        )
+    if int(man.get("version", 0)) > MANIFEST_VERSION:
+        raise ValueError(
+            f"{path}: manifest version {man.get('version')} is newer "
+            f"than this reader (supports <= {MANIFEST_VERSION})"
+        )
+    return man

@@ -32,7 +32,11 @@ import os
 import jax
 from jax.sharding import Mesh
 
+from ..obs import get_logger
+from ..obs.telemetry import current as current_telemetry
 from .mesh import make_mesh
+
+log = get_logger("parallel.multihost")
 
 
 def initialize(
@@ -145,6 +149,15 @@ def run_search(fil, config):
 
     plan = search.build_dm_plan(fil)
     lo, hi = dm_slice_for_process(plan.ndm, nproc, jax.process_index())
+    log.info(
+        "multi-host search: process %d/%d owns DM trials [%d, %d) of %d",
+        jax.process_index(), nproc, lo, hi, plan.ndm,
+    )
+    current_telemetry().event(
+        "multihost_slice", processes=nproc,
+        process=jax.process_index(), dm_lo=lo, dm_hi=hi,
+        ndm=int(plan.ndm),
+    )
     part = search.run(fil, dm_slice=(lo, hi), finalize=False)
 
     blobs = _allgather_pickled(

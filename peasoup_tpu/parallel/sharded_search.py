@@ -19,7 +19,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..obs import get_logger
+from ..obs.telemetry import current as current_telemetry
 from ..pipeline.accel_search import AccelSearchPeaks, search_block_core
+
+log = get_logger("parallel.sharded_search")
 
 
 @lru_cache(maxsize=None)
@@ -43,6 +47,16 @@ def make_sharded_search_fn(
     Cached (mesh/threshold/axis/block are hashable) so repeat runs reuse
     the compiled executable like make_batched_search_fn.
     """
+    log.debug(
+        "building sharded search: %d-chip '%s' mesh, pallas_block=%d, "
+        "pallas_peaks=%s", mesh.shape[axis], axis, pallas_block,
+        pallas_peaks,
+    )
+    current_telemetry().event(
+        "sharded_search_built", n_chips=int(mesh.shape[axis]), axis=axis,
+        pallas_block=int(pallas_block), pallas_peaks=bool(pallas_peaks),
+        mega_harm=bool(mega_harm), fused_dft=bool(fused_dft),
+    )
 
     @partial(
         jax.jit,

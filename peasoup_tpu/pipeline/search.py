@@ -23,6 +23,8 @@ import numpy as np
 
 from ..core.candidates import Candidate, CandidateCollection
 from ..io.masks import read_killfile, read_zapfile
+from ..obs import get_logger
+from ..obs.telemetry import current as current_telemetry
 from ..io.sigproc import Filterbank
 from ..ops.dedisperse import (
     dedisperse,
@@ -42,6 +44,8 @@ from .checkpoint import SearchCheckpoint
 from .distill import AccelerationDistiller, DMDistiller, HarmonicDistiller
 from .folder import MultiFolder
 from .score import CandidateScorer
+
+log = get_logger("pipeline.search")
 
 
 @dataclass
@@ -488,11 +492,14 @@ class PeasoupSearch:
         returns a PartialSearchResult for the multi-host merge
         (parallel/multihost.py:run_search)."""
         cfg = self.config
+        tel = current_telemetry()
         timers: dict[str, float] = {}
-        t_total = time.time()
+        t_total = time.perf_counter()
 
         # --- dedispersion plan + execution ---------------------------------
+        t0 = time.perf_counter()
         dm_plan = self.build_dm_plan(fil)
+        timers["plan"] = time.perf_counter() - t0
         global_ndm = dm_plan.ndm
         dm_lo = 0
         if dm_slice is not None:
@@ -524,7 +531,7 @@ class PeasoupSearch:
                 t_total_start=t_total,
             )
             return part if not finalize else self.finalize(fil, part)
-        t0 = time.time()
+        t0 = time.perf_counter()
         # --- device selection: shard DM trials over local chips --------
         # (the reference's analogue: one worker per GPU up to -t,
         # pipeline_multi.cu:276-277). Selected BEFORE dedispersion so the
@@ -551,6 +558,11 @@ class PeasoupSearch:
         )
         n_shard = len(devices) if shardable else 1
         spill = trials_bytes > self.TRIALS_DEVICE_LIMIT * n_shard
+        tel.event(
+            "device_plan", n_devices=len(devices),
+            sharded=mesh is not None, trials_spill=bool(spill),
+            trials_bytes=int(trials_bytes), ndm=int(dm_plan.ndm),
+        )
 
         # --- checkpoint store (one construction + ONE load, shared by
         # the resume fast path below and the wave loop later) ---------
@@ -578,12 +590,12 @@ class PeasoupSearch:
             and dm_plan.ndm > 0
             and all(d in restored for d in range(dm_plan.ndm))
         )
-        if skip_dedisp and cfg.verbose:
-            print(
+        if skip_dedisp:
+            log.info(
                 "Resume fast path: all trials checkpointed and "
                 "npdmp=0 — skipping dedispersion"
             )
-        if skip_dedisp:
+            tel.event("resume_fast_path", ndm=int(dm_plan.ndm))
             trials = np.zeros((0, dm_plan.out_nsamps), dtype=np.uint8)
             spill = True  # host ndarray semantics; nothing device-resident
             self._trials_sharded = False
@@ -637,7 +649,8 @@ class PeasoupSearch:
                 # sync so the phase timer means what it says — await
                 # completion only, no D2H round trip
                 jax.block_until_ready(trials)
-        timers["dedispersion"] = time.time() - t0
+        timers["dedispersion"] = time.perf_counter() - t0
+        tel.capture_device_memory("dedispersion")
 
         # --- search setup ---------------------------------------------------
         size = choose_fft_size(fil.nsamps, cfg.size)
@@ -690,7 +703,7 @@ class PeasoupSearch:
         # every chunk of a wave is DISPATCHED asynchronously, then the
         # wave's counts come back in ONE packed D2H, and the peak arrays
         # in ONE more, trimmed to the observed per-chunk maximum count.
-        t0 = time.time()
+        t0 = time.perf_counter()
         accel_lists = [
             acc_plan.generate_accel_list(float(dm)) for dm in dm_plan.dm_list
         ]
@@ -707,14 +720,15 @@ class PeasoupSearch:
         self._accel_full_pad = [
             _accel_pad(len(a), cfg.accel_bucket) for a in accel_lists
         ]
-        if cfg.verbose and any(m is not None for m in self._accel_expand):
+        if any(m is not None for m in self._accel_expand):
             n_full = sum(len(a) for a in accel_lists)
             n_disp = sum(len(a) for a in dispatch_lists)
-            print(
-                f"accel dedupe: {n_disp}/{n_full} distinct resamplings "
-                "dispatched (trials with coinciding rounded shift maps "
-                "share their representative's spectrum bitwise)"
+            log.info(
+                "accel dedupe: %d/%d distinct resamplings dispatched "
+                "(trials with coinciding rounded shift maps share their "
+                "representative's spectrum bitwise)", n_disp, n_full,
             )
+            tel.event("accel_dedupe", dispatched=n_disp, full=n_full)
         bucket = cfg.accel_bucket
         by_bucket: dict[int, list[int]] = {}
         for dm_idx, accs in enumerate(dispatch_lists):
@@ -861,10 +875,14 @@ class PeasoupSearch:
         # ANY other with zero re-searched trials
         # (tests/test_pipeline.py::test_checkpoint_process_count_independent)
         per_dm_results: dict[int, tuple] = restored
-        if cfg.verbose and per_dm_results:
-            print(
-                f"Resuming: {len(per_dm_results)}/{dm_plan.ndm} DM "
-                f"trials restored from {cfg.checkpoint_file}"
+        if per_dm_results:
+            log.info(
+                "Resuming: %d/%d DM trials restored from %s",
+                len(per_dm_results), dm_plan.ndm, cfg.checkpoint_file,
+            )
+            tel.event(
+                "checkpoint_resume", restored=len(per_dm_results),
+                ndm=int(dm_plan.ndm),
             )
 
         # chunk sizing: a PER-CHIP block of d_local trials, auto-sized
@@ -964,9 +982,15 @@ class PeasoupSearch:
         shrink = 1
         while True:
             chunks = build_chunks(shrink)
+            waves = build_waves(chunks)
+            tel.event(
+                "wave_plan", n_waves=len(waves), n_chunks=len(chunks),
+                shrink=shrink,
+                max_dm_block=max((d for _, d in chunks), default=0),
+            )
             try:
                 self._run_waves(
-                    build_waves(chunks), len(chunks), per_dm_results, ckpt,
+                    waves, len(chunks), per_dm_results, ckpt,
                     progress, build_search, dispatch_lists,
                     trials, tim_len, zapmask_dev, windows,
                     size=size, nsamps_valid=nsamps_valid, pos5=pos5,
@@ -980,16 +1004,21 @@ class PeasoupSearch:
                 max_blk = max(d for _, d in chunks)
                 if not _is_oom(exc) or max_blk <= len(devices):
                     raise
-                import warnings
-
-                warnings.warn(
-                    f"device OOM at dm_block={max_blk}; retrying with "
-                    f"half-size blocks ({exc!s:.200})"
-                )
                 shrink *= 2
+                new_blk = max(d for _, d in build_chunks(shrink))
+                log.warning(
+                    "device OOM at dm_block=%d; retrying with half-size "
+                    "blocks (dm_block=%d): %.200s", max_blk, new_blk, exc,
+                )
+                tel.event(
+                    "oom_shrink_retry", dm_block_old=max_blk,
+                    dm_block_new=new_blk, shrink=shrink,
+                    error=f"{exc!s:.200}",
+                )
         if progress:
             progress.stop()
-        timers["search_device"] = time.time() - t0
+        timers["search_device"] = time.perf_counter() - t0
+        tel.capture_device_memory("search")
 
         # --- host candidate bookkeeping (ascending DM order) ----------------
         # idxs/snrs arrive ALREADY clustered (identify_unique_peaks ran
@@ -998,7 +1027,7 @@ class PeasoupSearch:
         # call over every (dm, accel) trial of the run — Candidate
         # objects exist only for its survivors (the reference builds one
         # struct per raw detection, pipeline_multi.cu:233-238).
-        t_host = time.time()
+        t_host = time.perf_counter()
         from .. import native
 
         dm_trial_cands = CandidateCollection()
@@ -1037,14 +1066,17 @@ class PeasoupSearch:
                             )
                     accel_trial_cands.append(harm_finder.distill(trial_cands))
                 dm_trial_cands.append(acc_still.distill(accel_trial_cands.cands))
-                if cfg.verbose:
-                    print(
-                        f"DM {dm:.3f} ({dm_idx+1}/{dm_plan.ndm}): "
-                        f"{len(accs)} accel trials, "
-                        f"{len(dm_trial_cands)} cands so far"
-                    )
-        timers["search_host"] = time.time() - t_host
-        timers["searching"] = time.time() - t0
+                log.debug(
+                    "DM %.3f (%d/%d): %d accel trials, %d cands so far",
+                    dm, dm_idx + 1, dm_plan.ndm, len(accs),
+                    len(dm_trial_cands),
+                )
+        timers["search_host"] = time.perf_counter() - t_host
+        timers["searching"] = time.perf_counter() - t0
+        tel.gauge("search.n_dm_trials", int(dm_plan.ndm))
+        tel.gauge("search.n_accel_trials", sum(len(a) for a in accel_lists))
+        tel.gauge("search.fft_size", int(size))
+        tel.gauge("candidates.per_dm_distill", len(dm_trial_cands))
 
         if dm_lo:
             _offset_dm_idx(dm_trial_cands.cands, dm_lo)
@@ -1080,20 +1112,28 @@ class PeasoupSearch:
         (parallel/multihost.py wires an allgather; None = single
         process)."""
         cfg = self.config
+        tel = current_telemetry()
         timers = part.timers
+        t0 = time.perf_counter()
         dm_still = DMDistiller(cfg.freq_tol, keep_related=True)
         harm_still = HarmonicDistiller(
             cfg.freq_tol, cfg.max_harm, keep_related=True, fractional_harms=False
         )
+        tel.gauge("candidates.per_dm_total", len(part.cands))
         cands = dm_still.distill(part.cands)
+        tel.gauge("candidates.post_dm_distill", len(cands))
         cands = harm_still.distill(cands)
+        tel.gauge("candidates.post_harmonic_distill", len(cands))
+        timers["distilling"] = time.perf_counter() - t0
 
+        t0 = time.perf_counter()
         scorer = CandidateScorer(
             fil.tsamp, fil.cfreq, fil.foff, abs(fil.foff) * fil.nchans
         )
         scorer.score_all(cands)
+        timers["scoring"] = time.perf_counter() - t0
 
-        t0 = time.time()
+        t0 = time.perf_counter()
         if cfg.npdmp > 0:
             folder = MultiFolder(
                 part.trials, part.trials_nsamps, fil.tsamp,
@@ -1104,10 +1144,12 @@ class PeasoupSearch:
             if fold_exchange is not None:
                 outcomes = fold_exchange(outcomes)
             cands = folder.apply_outcomes(cands, outcomes)
-        timers["folding"] = time.time() - t0
+            tel.gauge("candidates.folded", min(cfg.npdmp, len(cands)))
+        timers["folding"] = time.perf_counter() - t0
 
         cands = cands[: cfg.limit]
-        timers["total"] = time.time() - part.t_total_start
+        tel.gauge("candidates.final", len(cands))
+        timers["total"] = time.perf_counter() - part.t_total_start
         return SearchResult(
             candidates=cands,
             dm_list=part.dm_list,
@@ -1154,11 +1196,14 @@ class PeasoupSearch:
                         # an error re-raised immediately
                         if self._cur_pallas_block == 0:
                             raise
-                        import warnings
-
-                        warnings.warn(
+                        log.warning(
                             "search wave failed with the Pallas resample "
-                            f"enabled ({exc!r}); retrying without Pallas"
+                            "enabled (%r); retrying without Pallas", exc,
+                        )
+                        current_telemetry().event(
+                            "pallas_resample_disabled",
+                            pallas_block=self._cur_pallas_block,
+                            error=f"{exc!r:.200}",
                         )
                         self._cur_pallas_block = 0
                         self._active_search_block = build_search(
@@ -1362,13 +1407,11 @@ class PeasoupSearch:
                 dm_trial_cands.append(
                     [row_cands[r] for r in range(lo, hi) if unique2[r]]
                 )
-                if cfg.verbose:
-                    print(
-                        f"DM {float(dm_vals[dm_idx]):.3f} "
-                        f"({dm_idx+1}/{dm_plan.ndm}): "
-                        f"{len(accel_lists[dm_idx])} accel trials, "
-                        f"{len(dm_trial_cands)} cands so far"
-                    )
+                log.debug(
+                    "DM %.3f (%d/%d): %d accel trials, %d cands so far",
+                    float(dm_vals[dm_idx]), dm_idx + 1, dm_plan.ndm,
+                    len(accel_lists[dm_idx]), len(dm_trial_cands),
+                )
             return
 
         bounds = np.searchsorted(s_dm, np.arange(dm_plan.ndm + 1))
@@ -1388,12 +1431,11 @@ class PeasoupSearch:
                 for r in range(lo, hi)
             ]
             dm_trial_cands.append(acc_still.distill(accel_trial_cands))
-            if cfg.verbose:
-                print(
-                    f"DM {dm:.3f} ({dm_idx+1}/{dm_plan.ndm}): "
-                    f"{len(accs)} accel trials, "
-                    f"{len(dm_trial_cands)} cands so far"
-                )
+            log.debug(
+                "DM %.3f (%d/%d): %d accel trials, %d cands so far",
+                dm, dm_idx + 1, dm_plan.ndm, len(accs),
+                len(dm_trial_cands),
+            )
 
     def _dispatch_chunk(
         self, chunk, dispatch_lists, trials, tim_len, zapmask_dev, windows,
@@ -1554,9 +1596,19 @@ class PeasoupSearch:
             # path) or clusters outgrew it (fused-kernel path)
             ov = ccounts if fused else counts
             while ov.max() > max_peaks:
+                old_mp = max_peaks
                 max_peaks = 1 << int(np.ceil(np.log2(ov.max())))
                 self._learned_max_peaks = max(
                     self._learned_max_peaks, max_peaks
+                )
+                log.debug(
+                    "peak compaction overflow: escalating max_peaks "
+                    "%d -> %d (observed %d)", old_mp, max_peaks,
+                    int(ov.max()),
+                )
+                current_telemetry().event(
+                    "max_peaks_escalated", old=int(old_mp),
+                    new=int(max_peaks), observed=int(ov.max()),
                 )
                 # the redispatch below runs on the CURRENT active search
                 # block, which an earlier chunk's escalation may have
@@ -1580,6 +1632,9 @@ class PeasoupSearch:
                         max_peaks,
                     ):
                         self._mega_harm = False
+                        current_telemetry().event(
+                            "mega_harm_disabled", max_peaks=int(max_peaks)
+                        )
                     if not getattr(
                         self, "_mega_harm", False
                     ) and not probe_pallas_peaks(
@@ -1588,6 +1643,9 @@ class PeasoupSearch:
                     ):
                         fused = False
                         self._pallas_peaks = False
+                        current_telemetry().event(
+                            "pallas_peaks_disabled", max_peaks=int(max_peaks)
+                        )
                     if not fused or mega_was != getattr(
                         self, "_mega_harm", False
                     ):

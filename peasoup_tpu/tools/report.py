@@ -1,0 +1,175 @@
+"""Render (or diff) ``telemetry.json`` run manifests.
+
+The manifest is the machine-readable record a run writes next to
+overview.xml (peasoup_tpu/obs/telemetry.py). This tool is the human
+end of that pipe:
+
+    python -m peasoup_tpu.tools.report run/telemetry.json
+    python -m peasoup_tpu.tools.report before.json after.json   # diff
+
+One manifest renders the stage-timer table (the superset of
+overview.xml's <execution_times>), counters/gauges (candidate counts
+per stage, memory high-water marks), JIT compile stats, the
+adaptive-event log, and — when the run was captured with
+``--capture-device-trace`` — the per-scope device-time/bytes table
+from tools/scope_trace.py. Two manifests render aligned timers and
+counters with absolute and relative deltas: the explainability layer
+under bench.py's BENCH_*.json wall-clock numbers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from ..obs.telemetry import load_manifest
+
+
+def _fmt_val(v) -> str:
+    if isinstance(v, bool):
+        return str(v)
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def _section(title: str) -> list[str]:
+    return ["", title, "-" * len(title)]
+
+
+def render(man: dict, max_events: int = 30) -> str:
+    """Pretty-print one manifest."""
+    lines = [
+        f"telemetry manifest v{man['version']}  run_id={man['run_id']}",
+        f"  created: {time.strftime('%Y-%m-%d %H:%M:%SZ', time.gmtime(man['created_unix']))}"
+        f"  host={man.get('hostname', '?')}  pid={man.get('pid', '?')}",
+    ]
+    plat = man.get("platform") or {}
+    if plat:
+        devs = plat.get("devices") or []
+        lines.append(
+            f"  platform: jax {plat.get('jax', '?')} "
+            f"backend={plat.get('backend', '?')} "
+            f"devices={len(devs)} "
+            f"process {plat.get('process_index', 0)}/"
+            f"{plat.get('process_count', 1)}"
+        )
+    ctx = man.get("context") or {}
+    for k in sorted(ctx):
+        lines.append(f"  {k}: {_fmt_val(ctx[k])}")
+
+    timers = man.get("timers") or {}
+    if timers:
+        lines += _section("stage timers")
+        width = max(len(k) for k in timers)
+        for k, v in sorted(timers.items(), key=lambda kv: -kv[1]):
+            lines.append(f"  {k:<{width}}  {v:10.3f} s")
+
+    for name in ("counters", "gauges"):
+        table = man.get(name) or {}
+        if table:
+            lines += _section(name)
+            width = max(len(k) for k in table)
+            for k in sorted(table):
+                lines.append(f"  {k:<{width}}  {_fmt_val(table[k])}")
+
+    jit = man.get("jit") or {}
+    if jit:
+        lines += _section("jit compile/lowering")
+        width = max(len(k) for k in jit)
+        for k in sorted(jit):
+            st = jit[k]
+            lines.append(
+                f"  {k:<{width}}  {st['count']:5d} x  "
+                f"{st['seconds']:8.3f} s"
+            )
+
+    events = man.get("events") or []
+    if events:
+        lines += _section(f"adaptive events ({len(events)})")
+        for rec in events[:max_events]:
+            extra = " ".join(
+                f"{k}={_fmt_val(v)}"
+                for k, v in rec.items()
+                if k not in ("t", "kind")
+            )
+            lines.append(f"  [{rec['t']:10.3f}s] {rec['kind']}  {extra}")
+        if len(events) > max_events:
+            lines.append(f"  ... {len(events) - max_events} more")
+
+    dt = man.get("device_trace")
+    if dt:
+        lines += _section("device trace (per-scope attribution)")
+        lines.append(f"  device busy: {dt.get('device_s', 0.0) * 1e3:.1f} ms")
+        phases = dt.get("phases") or {}
+        for k in sorted(phases, key=lambda k: -phases[k]):
+            lines.append(f"    phase {k:<8} {phases[k] * 1e3:10.1f} ms")
+        for row in dt.get("table") or []:
+            lines.append(
+                f"    {row['seconds'] * 1e3:10.1f} ms  "
+                f"{row['gigabytes']:8.2f} GB  {row['scope']}"
+            )
+    return "\n".join(lines) + "\n"
+
+
+def diff(a: dict, b: dict, max_events: int = 0) -> str:
+    """Aligned comparison of two manifests (timers + counters/gauges):
+    the 'why did this BENCH number move' view."""
+    lines = [
+        f"diff: {a['run_id']}  ->  {b['run_id']}",
+        f"  duration: {a.get('duration_s', 0.0):.3f} s -> "
+        f"{b.get('duration_s', 0.0):.3f} s",
+    ]
+    for name in ("timers", "counters", "gauges"):
+        ta, tb = a.get(name) or {}, b.get(name) or {}
+        keys = sorted(set(ta) | set(tb))
+        if not keys:
+            continue
+        lines += _section(name)
+        width = max(len(k) for k in keys)
+        for k in keys:
+            va, vb = ta.get(k), tb.get(k)
+            if va is None:
+                lines.append(f"  {k:<{width}}  (new) -> {_fmt_val(vb)}")
+            elif vb is None:
+                lines.append(f"  {k:<{width}}  {_fmt_val(va)} -> (gone)")
+            else:
+                delta = vb - va
+                pct = f" ({delta / va * 100.0:+.1f}%)" if va else ""
+                lines.append(
+                    f"  {k:<{width}}  {_fmt_val(va)} -> {_fmt_val(vb)}"
+                    f"  [{delta:+.6g}{pct}]"
+                )
+    ea, eb = len(a.get("events") or []), len(b.get("events") or [])
+    lines += _section("events")
+    lines.append(f"  count: {ea} -> {eb}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="peasoup-report",
+        description="Render or diff telemetry.json run manifests",
+    )
+    p.add_argument(
+        "manifests", nargs="+",
+        help="one manifest to render, or two to diff (old new)",
+    )
+    p.add_argument(
+        "--events", type=int, default=30,
+        help="max adaptive events to render (default 30)",
+    )
+    args = p.parse_args(argv)
+    if len(args.manifests) > 2:
+        p.error("expected one manifest (render) or two (diff)")
+    mans = [load_manifest(m) for m in args.manifests]
+    if len(mans) == 1:
+        sys.stdout.write(render(mans[0], max_events=args.events))
+    else:
+        sys.stdout.write(diff(mans[0], mans[1]))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -81,6 +81,44 @@ class ScopeResult:
         return out
 
 
+def parse_trace_events(tr: dict) -> list[tuple[str, float, int]]:
+    """(tf_op, duration us, bytes) rows from a loaded trace document's
+    TPU device tracks (X events carrying ``hlo_category`` under a
+    process whose name mentions TPU)."""
+    pids = {
+        e["pid"]
+        for e in tr["traceEvents"]
+        if e.get("ph") == "M"
+        and e.get("name") == "process_name"
+        and "TPU" in (e.get("args") or {}).get("name", "")
+    }
+    rows: list[tuple[str, float, int]] = []
+    for e in tr["traceEvents"]:
+        args = e.get("args") or {}
+        if (
+            e.get("ph") == "X"
+            and e.get("pid") in pids
+            and "hlo_category" in args
+        ):
+            rows.append(
+                (
+                    args.get("tf_op", ""),
+                    float(e.get("dur", 0)),
+                    int(args.get("raw_bytes_accessed", 0) or 0),
+                )
+            )
+    return rows
+
+
+def result_from_trace_file(path: str) -> ScopeResult:
+    """Parse one ``*.trace.json.gz`` (as written by jax.profiler) into a
+    ScopeResult — no TPU needed, just the file."""
+    res = ScopeResult()
+    with gzip.open(path, "rt") as f:
+        res.events = parse_trace_events(json.load(f))
+    return res
+
+
 @contextlib.contextmanager
 def scope_trace():
     """Trace the with-block and populate a ScopeResult from the TPU
@@ -94,29 +132,9 @@ def scope_trace():
         paths = glob.glob(tdir + "/**/*.trace.json.gz", recursive=True)
         if not paths:
             return
-        with gzip.open(max(paths, key=os.path.getmtime), "rt") as f:
-            tr = json.load(f)
-        pids = {
-            e["pid"]
-            for e in tr["traceEvents"]
-            if e.get("ph") == "M"
-            and e.get("name") == "process_name"
-            and "TPU" in (e.get("args") or {}).get("name", "")
-        }
-        for e in tr["traceEvents"]:
-            args = e.get("args") or {}
-            if (
-                e.get("ph") == "X"
-                and e.get("pid") in pids
-                and "hlo_category" in args
-            ):
-                res.events.append(
-                    (
-                        args.get("tf_op", ""),
-                        float(e.get("dur", 0)),
-                        int(args.get("raw_bytes_accessed", 0) or 0),
-                    )
-                )
+        res.events = result_from_trace_file(
+            max(paths, key=os.path.getmtime)
+        ).events
 
 
 def main() -> int:

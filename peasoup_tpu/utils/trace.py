@@ -22,20 +22,22 @@ import jax
 
 
 class Stopwatch:
-    """Accumulating wall-clock timer (stopwatch.hpp:9-144 semantics:
-    stop() adds to the running total; reset() clears)."""
+    """Accumulating monotonic timer (stopwatch.hpp:9-144 semantics:
+    stop() adds to the running total; reset() clears). Durations come
+    from ``perf_counter``, not the wall clock — NOTES.md documents 2-3x
+    tunnel wall-clock swings that would corrupt accumulated times."""
 
     def __init__(self) -> None:
         self._total = 0.0
         self._t0: float | None = None
 
     def start(self) -> None:
-        self._t0 = time.time()
+        self._t0 = time.perf_counter()
 
     def stop(self) -> None:
         if self._t0 is None:
             raise RuntimeError("Stopwatch stopped before being started")
-        self._total += time.time() - self._t0
+        self._total += time.perf_counter() - self._t0
         self._t0 = None
 
     def reset(self) -> None:

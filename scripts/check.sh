@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Fast pre-commit gate: lint + the no-print contract + the quick test
+# subset. The full tier-1 suite stays `pytest tests/ -m 'not slow'`.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if command -v ruff >/dev/null 2>&1; then
+    ruff check .
+else
+    echo "check.sh: ruff not installed; skipping lint" >&2
+fi
+
+# T201 equivalent that needs no tooling: library code never print()s
+# (CLI and tools entry points own their stdout and are exempt)
+if grep -rn "print(" peasoup_tpu --include='*.py' \
+        | grep -vE "^peasoup_tpu/(cli|tools)/"; then
+    echo "check.sh: print() found in library code — use the" \
+         "peasoup_tpu logger (peasoup_tpu/obs/log.py)" >&2
+    exit 1
+fi
+
+# fast subset: observability, aux units, output writers, scope-trace
+JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
+    tests/test_obs.py tests/test_scope_trace.py tests/test_aux.py \
+    tests/test_output.py
+echo "check.sh: OK"

@@ -247,10 +247,11 @@ class TestOOMContract:
         )
         assert not _is_oom(ValueError("unrelated"))
 
-    def test_search_shrinks_blocks_on_device_oom(self, monkeypatch):
+    def test_search_shrinks_blocks_on_device_oom(self, monkeypatch, caplog):
         """First dispatch at full block size raises an OOM-shaped error;
         the driver must halve the blocks and complete the search with
-        identical candidates."""
+        identical candidates — logging the retry and recording it as a
+        structured telemetry event (old/new dm_block)."""
         from test_pipeline import make_synthetic_fil
 
         import tempfile
@@ -282,8 +283,22 @@ class TestOOMContract:
                 return orig(self, chunk, *a, **k)
 
             monkeypatch.setattr(PS, "_dispatch_chunk", flaky)
-            with pytest.warns(UserWarning, match="retrying with"):
-                got = search.run(fil)
+            import logging
+
+            from peasoup_tpu import obs
+
+            tel = obs.RunTelemetry()
+            with caplog.at_level(logging.WARNING, logger="peasoup_tpu"):
+                with tel.activate():
+                    got = search.run(fil)
+            assert any(
+                "retrying with" in r.getMessage() for r in caplog.records
+            )
+            ooms = [
+                e for e in tel.events if e["kind"] == "oom_shrink_retry"
+            ]
+            assert ooms and ooms[0]["dm_block_old"] == 8
+            assert ooms[0]["dm_block_new"] == 4
             assert fails["n"] >= 1
             assert len(got.candidates) == len(want.candidates) > 0
             # halved blocks change the batched-FFT shape, which nudges

@@ -101,6 +101,15 @@ INVENTORY = [
     ("peasoup_tpu.utils.progress", ["ProgressBar"]),
     ("peasoup_tpu.utils.debug", ["dump_buffer"]),
     ("peasoup_tpu.native", ["available"]),
+    # observability: run telemetry manifest + structured logging
+    ("peasoup_tpu.obs.telemetry", [
+        "RunTelemetry", "current", "load_manifest",
+    ]),
+    ("peasoup_tpu.obs.log", ["get_logger", "configure", "resolve_level"]),
+    ("peasoup_tpu.tools.report", ["render", "diff"]),
+    ("peasoup_tpu.tools.scope_trace", [
+        "scope_trace", "parse_trace_events", "result_from_trace_file",
+    ]),
 ]
 
 

@@ -2,12 +2,73 @@
 (tools/scope_trace.py) — the source of NOTES.md's device-time numbers
 and bench.py's official value anchor."""
 
+import gzip
+import json
+
 import numpy as np
 import pytest
 
 jax = pytest.importorskip("jax")
 
-from peasoup_tpu.tools.scope_trace import ScopeResult, scope_trace
+from peasoup_tpu.tools.scope_trace import (
+    ScopeResult,
+    parse_trace_events,
+    result_from_trace_file,
+    scope_trace,
+)
+
+
+def _synthetic_trace() -> dict:
+    """A minimal profiler trace document: one TPU device track, one
+    host track (must be ignored), X events with/without hlo_category."""
+    return {
+        "traceEvents": [
+            {"ph": "M", "pid": 7, "name": "process_name",
+             "args": {"name": "/device:TPU:0"}},
+            {"ph": "M", "pid": 9, "name": "process_name",
+             "args": {"name": "python host"}},
+            # device op with scope + bytes
+            {"ph": "X", "pid": 7, "dur": 1500.0,
+             "args": {"hlo_category": "convolution",
+                      "tf_op": "jit(search_dm_block)/Harmonic summing/conv",
+                      "raw_bytes_accessed": 2 * 10**9}},
+            # device op without bytes (field absent -> 0)
+            {"ph": "X", "pid": 7, "dur": 500.0,
+             "args": {"hlo_category": "fusion"}},
+            # host-track op: same shape, wrong pid -> excluded
+            {"ph": "X", "pid": 9, "dur": 9999.0,
+             "args": {"hlo_category": "fusion", "tf_op": "host/op"}},
+            # device-track metadata event (not ph=X) -> excluded
+            {"ph": "C", "pid": 7, "dur": 123.0,
+             "args": {"hlo_category": "copy"}},
+        ]
+    }
+
+
+def test_parse_trace_events_filters_device_tracks():
+    rows = parse_trace_events(_synthetic_trace())
+    assert rows == [
+        ("jit(search_dm_block)/Harmonic summing/conv", 1500.0, 2 * 10**9),
+        ("", 500.0, 0),
+    ]
+
+
+def test_result_from_trace_file_round_trip(tmp_path):
+    """The scope_trace parser runs against a trace.json.gz on disk —
+    no TPU needed, which is exactly how the telemetry subsystem's
+    --capture-device-trace output gets unit-tested."""
+    path = tmp_path / "t.trace.json.gz"
+    with gzip.open(path, "wt") as f:
+        json.dump(_synthetic_trace(), f)
+    res = result_from_trace_file(str(path))
+    assert res.device_s == pytest.approx(2e-3)
+    rows = dict((k, (s, gb)) for k, s, gb in res.table(depth=2))
+    assert rows["jit(search_dm_block)/Harmonic summing"][0] == pytest.approx(1.5e-3)
+    assert rows["jit(search_dm_block)/Harmonic summing"][1] == pytest.approx(2.0)
+    assert rows["<unscoped>"][0] == pytest.approx(5e-4)
+    ph = res.phase_seconds()
+    assert ph["search"] == pytest.approx(1.5e-3)
+    assert ph["other"] == pytest.approx(5e-4)
 
 
 def test_table_aggregates_by_scope_prefix():
