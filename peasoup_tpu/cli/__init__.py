@@ -1,14 +1,19 @@
 """CLI entry points.
 
 Shared observability wiring: every CLI (`peasoup`, `peasoup-ffa`,
-`coincidencer`) grows the same three flags — ``--log-level`` (stderr
-library logging), ``--metrics-json`` (the telemetry.json run manifest),
+`coincidencer`) grows the same flags — ``--log-level`` (stderr library
+logging), ``--metrics-json`` (the telemetry.json run manifest),
 ``--capture-device-trace`` (per-scope device attribution folded into
-the manifest) — resolved here so flag names and semantics can't drift
-between tools.
+the manifest), ``--status-json`` / ``--heartbeat-interval`` (the live
+status.json heartbeat + stall watchdog), ``--no-flight-recorder``
+(the crash flight recorder is ON by default) — resolved here so flag
+names and semantics can't drift between tools.
 """
 
 from __future__ import annotations
+
+import contextlib
+import os
 
 
 def add_observability_args(p) -> None:
@@ -33,6 +38,27 @@ def add_observability_args(p) -> None:
         "device-time/bytes attribution into the manifest (opt-in: "
         "tracing costs wall time and memory)",
     )
+    g.add_argument(
+        "--status-json", dest="status_json", default=None,
+        help="write a live status.json heartbeat here (current stage, "
+        "progress/rate/ETA, memory gauges, event tail), atomically "
+        "rewritten every --heartbeat-interval seconds. Tail it with "
+        "python -m peasoup_tpu.tools.watch",
+    )
+    g.add_argument(
+        "--heartbeat-interval", dest="heartbeat_interval", type=float,
+        default=5.0,
+        help="seconds between status.json heartbeats (default 5); the "
+        "stall watchdog fires after PEASOUP_STALL_TIMEOUT (default "
+        "300) seconds without progress",
+    )
+    g.add_argument(
+        "--no-flight-recorder", dest="no_flight_recorder",
+        action="store_true",
+        help="disable the crash flight recorder (on by default: "
+        "SIGTERM/SIGINT/fatal exceptions dump flight.json plus a "
+        "partial telemetry manifest marked aborted)",
+    )
 
 
 def init_observability(args):
@@ -44,3 +70,55 @@ def init_observability(args):
     return RunTelemetry(
         capture_device_trace=getattr(args, "capture_device_trace", False)
     )
+
+
+@contextlib.contextmanager
+def live_observability(tel, args, workdir, manifest_path=None):
+    """Arm the live layer around a pipeline call: install the crash
+    flight recorder (unless ``--no-flight-recorder``) and start the
+    status.json heartbeat (when ``--status-json``).
+
+    The flight recorder is installed BEFORE the heartbeat's first
+    snapshot, so an external watcher that waits for status.json to
+    appear can rely on abort forensics being armed. A propagating
+    exception dumps flight.json + the partial manifest before the
+    stack unwinds; a clean exit writes neither (the heartbeat's final
+    ``"done": true`` snapshot is the only trace left behind)."""
+    from ..obs.flight import FlightRecorder
+    from ..obs.heartbeat import Heartbeat
+
+    recorder = None
+    heartbeat = None
+    workdir = workdir or "."
+    if not getattr(args, "no_flight_recorder", False):
+        recorder = FlightRecorder(
+            tel,
+            os.path.join(workdir, "flight.json"),
+            manifest_path=manifest_path,
+        ).install()
+    if getattr(args, "status_json", None):
+        stall = float(os.environ.get("PEASOUP_STALL_TIMEOUT", 300.0))
+        heartbeat = Heartbeat(
+            tel,
+            args.status_json,
+            interval=getattr(args, "heartbeat_interval", 5.0),
+            stall_timeout=stall,
+        ).start()
+    try:
+        yield
+    except BaseException as exc:
+        if recorder is not None and not isinstance(exc, GeneratorExit):
+            import traceback
+
+            recorder.dump(
+                f"exception:{type(exc).__name__}",
+                exception="".join(
+                    traceback.format_exception_only(type(exc), exc)
+                ).strip(),
+            )
+        raise
+    finally:
+        if heartbeat is not None:
+            heartbeat.stop()
+        if recorder is not None:
+            recorder.close()

@@ -21,7 +21,11 @@ import sys
 
 import numpy as np
 
-from . import add_observability_args, init_observability
+from . import (
+    add_observability_args,
+    init_observability,
+    live_observability,
+)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -84,6 +88,8 @@ def write_birdie_list(
 
 
 def main(argv: list[str] | None = None) -> int:
+    import os
+
     args = build_parser().parse_args(argv)
 
     from .peasoup import apply_platform_env
@@ -93,6 +99,13 @@ def main(argv: list[str] | None = None) -> int:
     tel.set_context(
         command="coincidencer", n_beams=len(args.filterbanks)
     )
+    workdir = (
+        os.path.dirname(args.metrics_json or args.samp_outfilename)
+        or "."
+    )
+    manifest_path = args.metrics_json or os.path.join(
+        workdir, "telemetry.json"
+    )
 
     import jax.numpy as jnp
 
@@ -101,60 +114,74 @@ def main(argv: list[str] | None = None) -> int:
     from ..parallel.coincidence import baseline_beam
     from ..plan.dm_plan import DMPlan
 
-    tims = []
-    tsamp = None
-    with tel.stage("reading"):
-        for path in args.filterbanks:
-            if args.verbose:
-                print(f"Reading and dedispersing {path}")
-            fil = read_filterbank(path)
-            plan = DMPlan.create(
-                nsamps=fil.nsamps, nchans=fil.nchans, tsamp=fil.tsamp,
-                fch1=fil.fch1, foff=fil.foff, dm_start=0.0, dm_end=0.0,
-                pulse_width=0.4, tol=1.1,
-            )
-            from ..ops.dedisperse import dedisperse, output_scale
-
-            trial = dedisperse(
-                fil.data, plan.delay_samples(), plan.killmask,
-                plan.out_nsamps,
-                scale=output_scale(fil.nbits, fil.nchans),
-            )[0]
-            tims.append(trial)
-            tsamp = fil.tsamp
-    sizes = {len(t) for t in tims}
-    if len(sizes) != 1:
-        raise SystemExit("Not all filterbanks the same length")
-    # the reference uses the FULL dedispersed length, not a power of two
-    # (coincidencer.cpp:136); jnp.fft handles arbitrary sizes
-    size = sizes.pop()
-    tobs = size * tsamp
-    bin_width = 1.0 / tobs
-    pos5 = int(args.boundary_5_freq / bin_width)
-    pos25 = int(args.boundary_25_freq / bin_width)
-
-    specs, series = [], []
-    with tel.activate(), tel.device_capture():
-        with tel.stage("baselining"):
-            for t in tims:
+    with tel.activate(), live_observability(
+        tel, args, workdir,
+        manifest_path if (args.metrics_json or args.status_json) else None,
+    ):
+        tims = []
+        tsamp = None
+        n_beams = len(args.filterbanks)
+        with tel.stage("reading"):
+            for i, path in enumerate(args.filterbanks):
                 if args.verbose:
-                    print("Baselining beam")
-                spec, tim = baseline_beam(jnp.asarray(t[:size]), size=size,
-                                          pos5=pos5, pos25=pos25)
-                specs.append(np.asarray(spec))
-                series.append(np.asarray(tim))
+                    print(f"Reading and dedispersing {path}")
+                tel.set_progress(i, n_beams, unit="beams")
+                fil = read_filterbank(path)
+                plan = DMPlan.create(
+                    nsamps=fil.nsamps, nchans=fil.nchans, tsamp=fil.tsamp,
+                    fch1=fil.fch1, foff=fil.foff, dm_start=0.0, dm_end=0.0,
+                    pulse_width=0.4, tol=1.1,
+                )
+                from ..ops.dedisperse import dedisperse, output_scale
 
-        if args.verbose:
-            print("Performing cross beam coincidence matching")
-        with tel.stage("coincidence"):
-            samp_mask = np.asarray(
-                coincidence_mask(jnp.asarray(np.stack(series)), args.thresh,
-                                 args.beam_thresh)
-            )
-            spec_mask = np.asarray(
-                coincidence_mask(jnp.asarray(np.stack(specs)), args.thresh,
-                                 args.beam_thresh)
-            )
+                trial = dedisperse(
+                    fil.data, plan.delay_samples(), plan.killmask,
+                    plan.out_nsamps,
+                    scale=output_scale(fil.nbits, fil.nchans),
+                )[0]
+                tims.append(trial)
+                tsamp = fil.tsamp
+        sizes = {len(t) for t in tims}
+        if len(sizes) != 1:
+            raise SystemExit("Not all filterbanks the same length")
+        # the reference uses the FULL dedispersed length, not a power of
+        # two (coincidencer.cpp:136); jnp.fft handles arbitrary sizes
+        size = sizes.pop()
+        tobs = size * tsamp
+        bin_width = 1.0 / tobs
+        pos5 = int(args.boundary_5_freq / bin_width)
+        pos25 = int(args.boundary_25_freq / bin_width)
+
+        specs, series = [], []
+        with tel.device_capture():
+            with tel.stage("baselining"):
+                for i, t in enumerate(tims):
+                    if args.verbose:
+                        print("Baselining beam")
+                    tel.set_progress(n_beams + i, 2 * n_beams, unit="beams")
+                    spec, tim = baseline_beam(
+                        jnp.asarray(t[:size]), size=size,
+                        pos5=pos5, pos25=pos25,
+                    )
+                    specs.append(np.asarray(spec))
+                    series.append(np.asarray(tim))
+
+            if args.verbose:
+                print("Performing cross beam coincidence matching")
+            with tel.stage("coincidence"):
+                samp_mask = np.asarray(
+                    coincidence_mask(
+                        jnp.asarray(np.stack(series)), args.thresh,
+                        args.beam_thresh,
+                    )
+                )
+                spec_mask = np.asarray(
+                    coincidence_mask(
+                        jnp.asarray(np.stack(specs)), args.thresh,
+                        args.beam_thresh,
+                    )
+                )
+        tel.set_progress(2 * n_beams, 2 * n_beams, unit="beams")
     write_samp_mask(samp_mask, args.samp_outfilename)
     write_birdie_list(spec_mask, bin_width, args.spec_outfilename)
     tel.gauge("mask.samples_flagged", int((samp_mask == 0).sum()))

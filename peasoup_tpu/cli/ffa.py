@@ -18,7 +18,11 @@ import time
 
 import numpy as np
 
-from . import add_observability_args, init_observability
+from . import (
+    add_observability_args,
+    init_observability,
+    live_observability,
+)
 
 
 def get_default_ffa_output_filename() -> str:
@@ -67,6 +71,8 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
+    import os
+
     args = build_parser().parse_args(argv)
     out = args.outfilename or get_default_ffa_output_filename()
     from .peasoup import apply_platform_env
@@ -75,6 +81,10 @@ def main(argv=None) -> int:
     tel = init_observability(args)
     tel.set_context(
         command="peasoup-ffa", inputfile=args.inputfile, outfile=out
+    )
+    workdir = os.path.dirname(args.metrics_json or out) or "."
+    manifest_path = args.metrics_json or os.path.join(
+        workdir, "telemetry.json"
     )
 
     from ..io import read_filterbank
@@ -86,51 +96,68 @@ def main(argv=None) -> int:
     from ..utils import ProgressBar
 
     t0 = time.perf_counter()
-    with tel.stage("reading"):
-        fil = read_filterbank(args.inputfile)
-    killmask = (
-        read_killfile(args.killfile, fil.nchans) if args.killfile else None
-    )
-    dm_plan = DMPlan.create(
-        nsamps=fil.nsamps, nchans=fil.nchans, tsamp=fil.tsamp,
-        fch1=fil.fch1, foff=fil.foff, dm_start=args.dm_start,
-        dm_end=args.dm_end, pulse_width=args.dm_pulse_width,
-        tol=args.dm_tol, killmask=killmask,
-    )
-    tel.gauge("search.n_dm_trials", int(dm_plan.ndm))
-    if args.verbose:
-        print(f"FFA search: {dm_plan.ndm} DM trials, periods "
-              f"{args.p_start}-{args.p_end} s, min_dc {args.min_dc}")
-    # trials are consumed on the host (one FFA per DM trial), so use
-    # the host-resident dedisperse variant: HBM holds one block at a
-    # time (packed upload + on-device unpack still apply)
-    with tel.activate(), tel.device_capture():
-        with tel.stage("dedispersion"):
-            trials = dedisperse(
-                fil_to_device(fil), dm_plan.delay_samples(),
-                dm_plan.killmask, dm_plan.out_nsamps,
-                scale=output_scale(fil.nbits, int(dm_plan.killmask.sum())),
-            )
-        tel.capture_device_memory("dedispersion")
+    with tel.activate(), live_observability(
+        tel, args, workdir,
+        manifest_path if (args.metrics_json or args.status_json) else None,
+    ):
+        with tel.stage("reading"):
+            fil = read_filterbank(args.inputfile)
+        killmask = (
+            read_killfile(args.killfile, fil.nchans)
+            if args.killfile else None
+        )
+        dm_plan = DMPlan.create(
+            nsamps=fil.nsamps, nchans=fil.nchans, tsamp=fil.tsamp,
+            fch1=fil.fch1, foff=fil.foff, dm_start=args.dm_start,
+            dm_end=args.dm_end, pulse_width=args.dm_pulse_width,
+            tol=args.dm_tol, killmask=killmask,
+        )
+        tel.gauge("search.n_dm_trials", int(dm_plan.ndm))
+        if args.verbose:
+            print(f"FFA search: {dm_plan.ndm} DM trials, periods "
+                  f"{args.p_start}-{args.p_end} s, min_dc {args.min_dc}")
+        # trials are consumed on the host (one FFA per DM trial), so use
+        # the host-resident dedisperse variant: HBM holds one block at a
+        # time (packed upload + on-device unpack still apply)
+        with tel.device_capture():
+            with tel.stage("dedispersion"):
+                trials = dedisperse(
+                    fil_to_device(fil), dm_plan.delay_samples(),
+                    dm_plan.killmask, dm_plan.out_nsamps,
+                    scale=output_scale(
+                        fil.nbits, int(dm_plan.killmask.sum())
+                    ),
+                )
+            tel.capture_device_memory("dedispersion")
 
-        progress = ProgressBar() if args.progress_bar else None
-        if progress:
-            progress.start()
-        if progress:
-            on_progress = progress.update
-        elif args.verbose:
-            on_progress = lambda f: print(f"FFA octaves: {f * 100:5.1f}% done")
-        else:
-            on_progress = None
-        # every octave folds the whole DM-trial block in a handful of
-        # batched dispatches (ops/ffa.py: ffa_search_block)
-        with tel.stage("ffa_search"):
-            cands = ffa_search_block(
-                trials, fil.tsamp, args.p_start, args.p_end,
-                args.min_dc, dm_plan.dm_list, snr_min=args.min_snr,
-                progress=on_progress,
-            )
-        tel.capture_device_memory("ffa_search")
+            progress = ProgressBar() if args.progress_bar else None
+            if progress:
+                progress.start()
+            if progress:
+                inner_progress = progress.update
+            elif args.verbose:
+                inner_progress = lambda f: print(
+                    f"FFA octaves: {f * 100:5.1f}% done"
+                )
+            else:
+                inner_progress = None
+
+            def on_progress(f, _inner=inner_progress):
+                # feeds the heartbeat's rate/ETA as well as the bar
+                tel.set_progress(round(f * 100.0, 3), 100.0, unit="%")
+                if _inner is not None:
+                    _inner(f)
+
+            # every octave folds the whole DM-trial block in a handful
+            # of batched dispatches (ops/ffa.py: ffa_search_block)
+            with tel.stage("ffa_search"):
+                cands = ffa_search_block(
+                    trials, fil.tsamp, args.p_start, args.p_end,
+                    args.min_dc, dm_plan.dm_list, snr_min=args.min_snr,
+                    progress=on_progress,
+                )
+            tel.capture_device_memory("ffa_search")
+        tel.set_stage("writing")
     if progress:
         progress.stop()
     if args.verbose:

@@ -12,7 +12,11 @@ import os
 import sys
 import time
 
-from . import add_observability_args, init_observability
+from . import (
+    add_observability_args,
+    init_observability,
+    live_observability,
+)
 
 
 def default_outdir() -> str:
@@ -116,13 +120,18 @@ def main(argv: list[str] | None = None) -> int:
     tel.set_context(
         command="peasoup", inputfile=args.inputfile, outdir=outdir
     )
+    manifest_path = args.metrics_json or os.path.join(
+        outdir.rstrip("/"), "telemetry.json"
+    )
 
     # Resolve the peaks-kernel stripe height BEFORE anything creates
     # this process's jax client: the subprocess-isolated _SUB=24 probe
     # (ops/pallas/peaks.py) needs the TPU free to validate the fast
     # default on single-client runtimes; once resolved the verdict is
     # disk-cached and this import is free
-    from ..ops.pallas import peaks as _peaks  # noqa: F401
+    from ..ops.pallas import peaks as _peaks
+
+    tel.event("pallas_peaks_sub", **_peaks.SUB_RESOLUTION)
 
     # Heavy imports after arg parsing so --help stays fast
     from ..io.output import CandidateFileWriter, OutputFileWriter
@@ -161,49 +170,59 @@ def main(argv: list[str] | None = None) -> int:
         subbands=args.subbands,
         subband_smear=args.subband_smear,
     )
-    t0 = time.perf_counter()
-    if args.progress_bar:
-        print(f"Reading data from {args.inputfile}")
-    fil = read_filterbank(args.inputfile)
-    reading = time.perf_counter() - t0
-
     # multi-host aware (JAX_COORDINATOR_ADDRESS & co.): each process
     # searches its DM slice; single-process this is PeasoupSearch.run
     from ..parallel.multihost import run_search
 
-    with tel.activate(), tel.device_capture():
-        result = run_search(fil, cfg)
-    result.timers["reading"] = reading
+    with tel.activate(), live_observability(
+        tel, args, outdir, manifest_path
+    ):
+        t0 = time.perf_counter()
+        tel.set_stage("reading")
+        if args.progress_bar:
+            print(f"Reading data from {args.inputfile}")
+        fil = read_filterbank(args.inputfile)
+        reading = time.perf_counter() - t0
 
-    import jax
+        with tel.device_capture():
+            result = run_search(fil, cfg)
+        result.timers["reading"] = reading
+        tel.merge_timers(result.timers)
 
-    if jax.process_index() != 0:
-        return 0  # every process holds the identical result; rank 0 writes
+        import jax
 
-    t0 = time.perf_counter()
-    writer = CandidateFileWriter(outdir)
-    writer.write_binary(result.candidates, "candidates.peasoup")
-    result.timers["writing"] = time.perf_counter() - t0
+        if jax.process_count() > 1:
+            # per-host manifest shard (stage timers here are this
+            # host's own): telemetry.procN.json next to the main
+            # manifest, merged with `tools.report --merge`
+            base, ext = os.path.splitext(manifest_path)
+            tel.write(f"{base}.proc{jax.process_index()}{ext or '.json'}")
+        if jax.process_index() != 0:
+            return 0  # every process holds the identical result; rank 0 writes
 
-    stats = OutputFileWriter()
-    stats.add_misc_info()
-    stats.add_header(fil.header)
-    stats.add_search_parameters(cfg, args.inputfile)
-    stats.add_dm_list(result.dm_list)
-    stats.add_acc_list(result.acc_list_dm0)
-    stats.add_device_info()
-    stats.add_candidates(result.candidates, writer.byte_mapping)
-    stats.add_timing_info(result.timers)
-    stats.to_file(f"{outdir.rstrip('/')}/overview.xml")
+        tel.set_stage("writing")
+        t0 = time.perf_counter()
+        writer = CandidateFileWriter(outdir)
+        writer.write_binary(result.candidates, "candidates.peasoup")
+        result.timers["writing"] = time.perf_counter() - t0
+        tel.add_timer("writing", result.timers["writing"])
 
-    # the machine-readable twin of overview.xml, written beside it
-    # unless --metrics-json redirects it
-    tel.merge_timers(result.timers)
-    tel.gauge("candidates.written", len(result.candidates))
-    tel.write(
-        args.metrics_json
-        or os.path.join(outdir.rstrip("/"), "telemetry.json")
-    )
+        stats = OutputFileWriter()
+        stats.add_misc_info()
+        stats.add_header(fil.header)
+        stats.add_search_parameters(cfg, args.inputfile)
+        stats.add_dm_list(result.dm_list)
+        stats.add_acc_list(result.acc_list_dm0)
+        stats.add_device_info()
+        stats.add_candidates(result.candidates, writer.byte_mapping)
+        stats.add_timing_info(result.timers)
+        stats.to_file(f"{outdir.rstrip('/')}/overview.xml")
+
+        # the machine-readable twin of overview.xml, written beside it
+        # unless --metrics-json redirects it
+        tel.gauge("candidates.written", len(result.candidates))
+        tel.set_stage("done")
+        tel.write(manifest_path)
     if args.verbose or args.progress_bar:
         print(
             f"Done: {len(result.candidates)} candidates -> {outdir} "

@@ -1,9 +1,16 @@
-"""Run-scoped observability: structured logging (obs/log.py) and the
+"""Run-scoped observability: structured logging (obs/log.py), the
 telemetry subsystem (obs/telemetry.py) behind the versioned
-``telemetry.json`` run manifest. See README "Observability"."""
+``telemetry.json`` run manifest, the live ``status.json`` heartbeat +
+stall watchdog (obs/heartbeat.py), the crash flight recorder
+(obs/flight.py), and the manifest schema contract (obs/schema.py +
+manifest.schema.json). See README "Observability" and "Live
+observability"."""
 
+from .flight import FLIGHT_SCHEMA, FlightRecorder, load_flight
+from .heartbeat import STATUS_SCHEMA, Heartbeat, load_status
 from .log import configure as configure_logging
 from .log import get_logger, resolve_level
+from .schema import SchemaError, validate_manifest
 from .telemetry import (
     MANIFEST_SCHEMA,
     MANIFEST_VERSION,
@@ -17,6 +24,14 @@ __all__ = [
     "configure_logging",
     "get_logger",
     "resolve_level",
+    "FLIGHT_SCHEMA",
+    "FlightRecorder",
+    "load_flight",
+    "STATUS_SCHEMA",
+    "Heartbeat",
+    "load_status",
+    "SchemaError",
+    "validate_manifest",
     "MANIFEST_SCHEMA",
     "MANIFEST_VERSION",
     "NOOP",

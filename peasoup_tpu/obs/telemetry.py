@@ -43,15 +43,23 @@ import sys
 import time
 
 MANIFEST_SCHEMA = "peasoup_tpu.telemetry"
-MANIFEST_VERSION = 1
+# v2: top-level process_index/process_count (per-host shard tagging for
+# tools/report.py --merge) and the optional aborted/abort_reason pair
+# written by the crash flight recorder (obs/flight.py). Readers must
+# .get() keys newer than a manifest's version — see tools/report.py.
+MANIFEST_VERSION = 2
 
 _ACTIVE: contextvars.ContextVar["RunTelemetry | None"] = (
     contextvars.ContextVar("peasoup_tpu_telemetry", default=None)
 )
 
 # jax.monitoring event-name substrings worth keeping (compile +
-# lowering); everything else (tracing cache misses etc.) is noise here
+# lowering); everything else (tracing cache misses etc.) is noise here.
+# "saved" events (e.g. compilation-cache compile_time_saved) are
+# SAVINGS estimates, not durations — they can legitimately be negative
+# on a slow cache hit and don't belong in a compile-time table.
 _JIT_EVENT_KEYS = ("compile", "lower")
+_JIT_EVENT_SKIP = ("saved",)
 _jit_listener_installed = False
 
 
@@ -73,8 +81,11 @@ def _install_jit_listener() -> None:
 
         def _on_duration(event: str, duration: float, **kw) -> None:
             tel = _ACTIVE.get()
-            if tel is not None and any(
-                k in event for k in _JIT_EVENT_KEYS
+            if (
+                tel is not None
+                and duration >= 0  # durations only, not savings deltas
+                and any(k in event for k in _JIT_EVENT_KEYS)
+                and not any(k in event for k in _JIT_EVENT_SKIP)
             ):
                 tel.record_jit(event, float(duration))
 
@@ -107,6 +118,11 @@ class RunTelemetry:
         self.events: list[dict] = []
         self.jit: dict[str, list] = {}  # event -> [count, total_s]
         self.device_trace: dict | None = None
+        # live state read by the heartbeat/flight-recorder layer
+        self.current_stage: str | None = None
+        self._stage_stack: list[str] = []
+        self.progress_state: dict = {}
+        self._listeners: list = []
         if enabled:
             _install_jit_listener()
 
@@ -141,13 +157,57 @@ class RunTelemetry:
             **fields,
         }
         self.events.append(rec)
+        for fn in self._listeners:
+            try:
+                fn(rec)
+            except Exception:
+                pass  # a broken listener must never fail the run
         return rec
+
+    def add_listener(self, fn) -> None:
+        """Subscribe ``fn(record)`` to every event as it is recorded
+        (the flight recorder's ring-buffer feed)."""
+        if fn not in self._listeners:
+            self._listeners.append(fn)
+
+    def remove_listener(self, fn) -> None:
+        if fn in self._listeners:
+            self._listeners.remove(fn)
+
+    def set_stage(self, name: str) -> None:
+        """Mark the run's current pipeline stage (drivers that time
+        stages manually call this at each phase boundary; drivers using
+        :meth:`stage` get it for free). Recorded as a ``stage`` event so
+        the flight recorder and manifest keep the transition history."""
+        if not self.enabled or name == self.current_stage:
+            return
+        self.current_stage = name
+        self.event("stage", name=name)
+
+    def set_progress(
+        self, done: float, total: float | None = None, unit: str = ""
+    ) -> None:
+        """Update the run's live progress counter (read by the
+        status.json heartbeat for rate/ETA and by the stall watchdog)."""
+        if not self.enabled:
+            return
+        self.progress_state = {
+            "done": float(done),
+            "total": float(total) if total is not None else None,
+            "unit": unit,
+            "t": round(time.perf_counter() - self._t0, 6),
+            "updated_unix": time.time(),
+        }
 
     @contextlib.contextmanager
     def stage(self, name: str):
         """Accumulating monotonic stage timer (same key space as the
-        overview.xml ``<execution_times>`` table)."""
+        overview.xml ``<execution_times>`` table). Also tracks the
+        run's *current* stage for the live status.json heartbeat."""
         t0 = time.perf_counter()
+        if self.enabled:
+            self._stage_stack.append(name)
+            self.set_stage(name)
         try:
             yield
         finally:
@@ -155,6 +215,10 @@ class RunTelemetry:
                 self.timers[name] = self.timers.get(name, 0.0) + (
                     time.perf_counter() - t0
                 )
+                if self._stage_stack and self._stage_stack[-1] == name:
+                    self._stage_stack.pop()
+                if self._stage_stack:
+                    self.set_stage(self._stage_stack[-1])
 
     def add_timer(self, name: str, seconds: float) -> None:
         """Merge an externally measured duration into a stage timer."""
@@ -256,10 +320,15 @@ class RunTelemetry:
             pass  # platform info must never fail a run
         return info
 
-    def to_manifest(self) -> dict:
+    def to_manifest(
+        self, aborted: bool = False, abort_reason: str | None = None
+    ) -> dict:
         """The versioned run manifest. Key order is fixed (schema and
-        version lead) so manifests diff cleanly in text tools too."""
-        return {
+        version lead) so manifests diff cleanly in text tools too.
+        ``aborted=True`` marks a partial manifest dumped by the flight
+        recorder for a run that did not complete."""
+        plat = self._platform()
+        man = {
             "schema": MANIFEST_SCHEMA,
             "version": MANIFEST_VERSION,
             "run_id": self.run_id,
@@ -267,7 +336,11 @@ class RunTelemetry:
             "duration_s": round(time.perf_counter() - self._t0, 6),
             "hostname": socket.gethostname(),
             "pid": os.getpid(),
-            "platform": self._platform(),
+            # per-host shard tags, duplicated from platform so the
+            # --merge reader need not reach into nested dicts
+            "process_index": int(plat.get("process_index", 0)),
+            "process_count": int(plat.get("process_count", 1)),
+            "platform": plat,
             "context": self.context,
             "timers": {k: self.timers[k] for k in sorted(self.timers)},
             "counters": {
@@ -281,11 +354,24 @@ class RunTelemetry:
             "events": self.events,
             "device_trace": self.device_trace,
         }
+        if aborted:
+            man["aborted"] = True
+            man["abort_reason"] = abort_reason
+            man["stage_at_abort"] = self.current_stage
+            man["progress_at_abort"] = (
+                dict(self.progress_state) if self.progress_state else None
+            )
+        return man
 
-    def write(self, path: str) -> dict:
+    def write(
+        self,
+        path: str,
+        aborted: bool = False,
+        abort_reason: str | None = None,
+    ) -> dict:
         """Serialise the manifest to ``path`` (atomic replace) and
         return it."""
-        man = self.to_manifest()
+        man = self.to_manifest(aborted=aborted, abort_reason=abort_reason)
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)

@@ -52,6 +52,19 @@ from jax.experimental.pallas import tpu as pltpu
 
 import os as _os
 
+from ...obs.log import get_logger as _get_logger
+from ...obs.telemetry import current as _current_telemetry
+
+_log = _get_logger("ops.pallas.peaks")
+
+# How the stripe height was resolved, for telemetry/debugging: the
+# probe subprocess used to run silently, leaving "why is this machine
+# on _SUB=8?" undiagnosable. Keys: sub (the resolved height), source
+# (env|probe), and for probed resolutions cache (hit|miss|skip) and
+# verdict (ok|bad|notpu|cpu-platform|inconclusive*). The peasoup CLI
+# forwards this dict as a ``pallas_peaks_sub`` telemetry event.
+SUB_RESOLUTION: dict = {}
+
 PEAKS_BLOCK = int(_os.environ.get("PEASOUP_PEAKS_BLOCK", "4096"))
 # bins per grid step (128-lane multiple); 4096 measured best on v5e
 # (fewer grid steps beats the larger per-step vector work; r3 scan:
@@ -124,6 +137,7 @@ def _sub24_default_safe() -> bool:
     # explicit cpu-only env (the test suite's conftest) — same verdict
     # the child would return, without paying its jax import
     if _os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        SUB_RESOLUTION.update(cache="skip", verdict="cpu-platform")
         return True
     def _ver(pkg):
         try:
@@ -169,16 +183,20 @@ def _sub24_default_safe() -> bool:
         with open(path) as fh:
             verdict = fh.read().strip()
         if verdict == "ok":
+            SUB_RESOLUTION.update(cache="hit", verdict="ok")
             return True
         if verdict == "bad":
+            SUB_RESOLUTION.update(cache="hit", verdict="bad")
             return False
         # 'notpu' was recorded on a machine with no TPU hardware: honor
         # it only while that is still true (a shared/NFS cache reaching
         # a real TPU machine must re-probe, not ship 24 unvalidated)
         if verdict == "notpu" and not _tpu_hw_markers():
+            SUB_RESOLUTION.update(cache="hit", verdict="notpu")
             return True
     except OSError:
         pass
+    SUB_RESOLUTION["cache"] = "miss"
     pkg_root = _os.path.dirname(  # .../peasoup_tpu/ops/pallas -> repo
         _os.path.dirname(_os.path.dirname(_os.path.dirname(__file__)))
     )
@@ -231,6 +249,7 @@ def _sub24_default_safe() -> bool:
         # inconclusive (locked TPU / import error / timeout):
         # conservative for this process, nothing persisted; the child's
         # stderr tail makes the cause diagnosable from logs
+        SUB_RESOLUTION.update(verdict="inconclusive", exit_code=rc)
         warnings.warn(
             "PEASOUP_PEAKS_SUB probe subprocess could not validate the "
             f"fast stripe height (exit {rc}); using the conservative 8 "
@@ -254,6 +273,9 @@ def _sub24_default_safe() -> bool:
         signal.SIGABRT, signal.SIGSEGV, signal.SIGILL, signal.SIGFPE,
         signal.SIGBUS,
     ):
+        SUB_RESOLUTION.update(
+            verdict="inconclusive-signal", signal=-rc
+        )
         warnings.warn(
             f"PEASOUP_PEAKS_SUB probe subprocess was killed (signal "
             f"{-rc}); treating as inconclusive — using 8 for this "
@@ -261,6 +283,9 @@ def _sub24_default_safe() -> bool:
         )
         return False
     ok = rc in (0, 3)
+    SUB_RESOLUTION.update(
+        verdict="ok" if rc == 0 else "notpu" if rc == 3 else "bad"
+    )
     try:
         _os.makedirs(cache_dir, exist_ok=True)
         with open(path, "w") as fh:
@@ -273,10 +298,18 @@ def _sub24_default_safe() -> bool:
 _sub_env = _os.environ.get("PEASOUP_PEAKS_SUB")
 if _sub_env is not None:
     _SUB = int(_sub_env)
+    SUB_RESOLUTION.update(sub=_SUB, source="env")
 else:
     _SUB = 24 if _sub24_default_safe() else 8
+    SUB_RESOLUTION.update(sub=_SUB, source="probe")
 if _SUB <= 0 or _SUB % 8:
     raise ValueError(f"PEASOUP_PEAKS_SUB must be a positive multiple of 8: {_SUB}")
+# surface the (formerly silent) resolution: a debug log line always,
+# plus a telemetry event when a run's telemetry is already active (the
+# peasoup CLI re-emits SUB_RESOLUTION into its own manifest, since this
+# module usually resolves before the run's telemetry is activated)
+_log.debug("peaks stripe height resolved: %s", SUB_RESOLUTION)
+_current_telemetry().event("pallas_peaks_sub", **SUB_RESOLUTION)
 # crossing-walk subblock width (lanes). r3 chose 512 to shrink
 # per-TRIP vector work; with the r4 window-merged walk trips are few
 # and the per-SUBBLOCK guards (a sum reduction + scalar branch each,

@@ -28,6 +28,7 @@ local-device behaviour used throughout this repo.
 from __future__ import annotations
 
 import os
+import socket
 
 import jax
 from jax.sharding import Mesh
@@ -153,7 +154,16 @@ def run_search(fil, config):
         "multi-host search: process %d/%d owns DM trials [%d, %d) of %d",
         jax.process_index(), nproc, lo, hi, plan.ndm,
     )
-    current_telemetry().event(
+    # tag this host's telemetry so its manifest shard self-identifies
+    # (tools/report.py --merge keys hosts on process_index/hostname)
+    tel = current_telemetry()
+    tel.set_context(
+        process_index=int(jax.process_index()),
+        process_count=int(nproc),
+        hostname=socket.gethostname(),
+        dm_slice=[int(lo), int(hi)],
+    )
+    tel.event(
         "multihost_slice", processes=nproc,
         process=jax.process_index(), dm_lo=lo, dm_hi=hi,
         ndm=int(plan.ndm),

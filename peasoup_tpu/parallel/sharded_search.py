@@ -56,6 +56,7 @@ def make_sharded_search_fn(
         "sharded_search_built", n_chips=int(mesh.shape[axis]), axis=axis,
         pallas_block=int(pallas_block), pallas_peaks=bool(pallas_peaks),
         mega_harm=bool(mega_harm), fused_dft=bool(fused_dft),
+        process_index=int(jax.process_index()),
     )
 
     @partial(

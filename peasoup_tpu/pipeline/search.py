@@ -498,6 +498,7 @@ class PeasoupSearch:
 
         # --- dedispersion plan + execution ---------------------------------
         t0 = time.perf_counter()
+        tel.set_stage("plan")
         dm_plan = self.build_dm_plan(fil)
         timers["plan"] = time.perf_counter() - t0
         global_ndm = dm_plan.ndm
@@ -532,6 +533,7 @@ class PeasoupSearch:
             )
             return part if not finalize else self.finalize(fil, part)
         t0 = time.perf_counter()
+        tel.set_stage("dedispersion")
         # --- device selection: shard DM trials over local chips --------
         # (the reference's analogue: one worker per GPU up to -t,
         # pipeline_multi.cu:276-277). Selected BEFORE dedispersion so the
@@ -704,9 +706,15 @@ class PeasoupSearch:
         # wave's counts come back in ONE packed D2H, and the peak arrays
         # in ONE more, trimmed to the observed per-chunk maximum count.
         t0 = time.perf_counter()
+        tel.set_stage("searching")
         accel_lists = [
             acc_plan.generate_accel_list(float(dm)) for dm in dm_plan.dm_list
         ]
+        # trial totals published BEFORE the wave loop so the live
+        # status.json heartbeat can report progress against them
+        tel.gauge("search.n_dm_trials", int(dm_plan.ndm))
+        tel.gauge("search.n_accel_trials", sum(len(a) for a in accel_lists))
+        tel.gauge("search.fft_size", int(size))
         # identity-trial dedupe: device programs run only the DISTINCT
         # resamplings; results replicate host-side, bitwise-identical
         # to brute force (see _dedupe_identity_accels)
@@ -1028,6 +1036,7 @@ class PeasoupSearch:
         # objects exist only for its survivors (the reference builds one
         # struct per raw detection, pipeline_multi.cu:233-238).
         t_host = time.perf_counter()
+        tel.set_stage("search_host")
         from .. import native
 
         dm_trial_cands = CandidateCollection()
@@ -1073,9 +1082,6 @@ class PeasoupSearch:
                 )
         timers["search_host"] = time.perf_counter() - t_host
         timers["searching"] = time.perf_counter() - t0
-        tel.gauge("search.n_dm_trials", int(dm_plan.ndm))
-        tel.gauge("search.n_accel_trials", sum(len(a) for a in accel_lists))
-        tel.gauge("search.fft_size", int(size))
         tel.gauge("candidates.per_dm_distill", len(dm_trial_cands))
 
         if dm_lo:
@@ -1115,6 +1121,7 @@ class PeasoupSearch:
         tel = current_telemetry()
         timers = part.timers
         t0 = time.perf_counter()
+        tel.set_stage("distilling")
         dm_still = DMDistiller(cfg.freq_tol, keep_related=True)
         harm_still = HarmonicDistiller(
             cfg.freq_tol, cfg.max_harm, keep_related=True, fractional_harms=False
@@ -1127,6 +1134,7 @@ class PeasoupSearch:
         timers["distilling"] = time.perf_counter() - t0
 
         t0 = time.perf_counter()
+        tel.set_stage("scoring")
         scorer = CandidateScorer(
             fil.tsamp, fil.cfreq, fil.foff, abs(fil.foff) * fil.nchans
         )
@@ -1135,6 +1143,7 @@ class PeasoupSearch:
 
         t0 = time.perf_counter()
         if cfg.npdmp > 0:
+            tel.set_stage("folding")
             folder = MultiFolder(
                 part.trials, part.trials_nsamps, fil.tsamp,
                 pos5_freq=cfg.boundary_5_freq, pos25_freq=cfg.boundary_25_freq,
@@ -1169,6 +1178,8 @@ class PeasoupSearch:
             size=size, nsamps_valid=nsamps_valid, pos5=pos5, pos25=pos25,
             tsamp=tsamp,
         )
+        tel = current_telemetry()
+        tel.set_progress(0, n_chunks, unit="chunks")
         n_done = 0
         for wave in waves:
             todo = [
@@ -1217,6 +1228,14 @@ class PeasoupSearch:
                 if ckpt is not None:
                     ckpt.save(per_dm_results)
             n_done += len(wave)
+            # live progress: the heartbeat derives rate/ETA from this
+            # counter, and the stall watchdog treats its advance (or a
+            # new event) as liveness
+            tel.set_progress(n_done, n_chunks, unit="chunks")
+            tel.incr(
+                "search.dm_trials_done",
+                sum(len(c[0]) for c in wave),
+            )
             if progress:
                 progress.update(n_done / n_chunks)
 

@@ -1,4 +1,4 @@
-"""Render (or diff) ``telemetry.json`` run manifests.
+"""Render, diff, or merge ``telemetry.json`` run manifests.
 
 The manifest is the machine-readable record a run writes next to
 overview.xml (peasoup_tpu/obs/telemetry.py). This tool is the human
@@ -6,6 +6,8 @@ end of that pipe:
 
     python -m peasoup_tpu.tools.report run/telemetry.json
     python -m peasoup_tpu.tools.report before.json after.json   # diff
+    python -m peasoup_tpu.tools.report --merge telemetry.proc*.json \\
+        -o merged.json                                          # merge
 
 One manifest renders the stage-timer table (the superset of
 overview.xml's <execution_times>), counters/gauges (candidate counts
@@ -15,15 +17,32 @@ adaptive-event log, and — when the run was captured with
 from tools/scope_trace.py. Two manifests render aligned timers and
 counters with absolute and relative deltas: the explainability layer
 under bench.py's BENCH_*.json wall-clock numbers.
+
+``--merge`` combines the per-host manifest shards a multi-host run
+writes (``telemetry.procN.json``, tagged with ``process_index`` /
+``hostname``) into ONE merged manifest carrying per-host summaries and
+straggler/imbalance statistics: per-stage time spread across hosts
+with slowest-host attribution, and wall-clock imbalance. The merged
+manifest is itself schema-valid, so it renders and diffs like any
+other.
+
+Readers here must tolerate manifests from OLDER schema versions —
+every key newer than v1 is accessed with ``.get()`` so a legacy
+manifest renders instead of KeyError'ing.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 import time
 
-from ..obs.telemetry import load_manifest
+from ..obs.telemetry import (
+    MANIFEST_SCHEMA,
+    MANIFEST_VERSION,
+    load_manifest,
+)
 
 
 def _fmt_val(v) -> str:
@@ -39,12 +58,23 @@ def _section(title: str) -> list[str]:
 
 
 def render(man: dict, max_events: int = 30) -> str:
-    """Pretty-print one manifest."""
+    """Pretty-print one manifest (plain, aborted, or merged)."""
     lines = [
-        f"telemetry manifest v{man['version']}  run_id={man['run_id']}",
-        f"  created: {time.strftime('%Y-%m-%d %H:%M:%SZ', time.gmtime(man['created_unix']))}"
+        f"telemetry manifest v{man.get('version', '?')}"
+        f"  run_id={man.get('run_id', '?')}",
+        f"  created: {time.strftime('%Y-%m-%d %H:%M:%SZ', time.gmtime(man.get('created_unix', 0)))}"
         f"  host={man.get('hostname', '?')}  pid={man.get('pid', '?')}",
     ]
+    if man.get("process_count", 1) > 1:
+        lines.append(
+            f"  shard: process {man.get('process_index', 0)}/"
+            f"{man.get('process_count', 1)}"
+        )
+    if man.get("aborted"):
+        lines.append(
+            f"  ABORTED ({man.get('abort_reason', '?')}) at stage "
+            f"{man.get('stage_at_abort', '?')} — partial manifest"
+        )
     plat = man.get("platform") or {}
     if plat:
         devs = plat.get("devices") or []
@@ -58,6 +88,9 @@ def render(man: dict, max_events: int = 30) -> str:
     ctx = man.get("context") or {}
     for k in sorted(ctx):
         lines.append(f"  {k}: {_fmt_val(ctx[k])}")
+
+    if man.get("merged"):
+        lines += _render_merged_sections(man)
 
     timers = man.get("timers") or {}
     if timers:
@@ -81,8 +114,8 @@ def render(man: dict, max_events: int = 30) -> str:
         for k in sorted(jit):
             st = jit[k]
             lines.append(
-                f"  {k:<{width}}  {st['count']:5d} x  "
-                f"{st['seconds']:8.3f} s"
+                f"  {k:<{width}}  {st.get('count', 0):5d} x  "
+                f"{st.get('seconds', 0.0):8.3f} s"
             )
 
     events = man.get("events") or []
@@ -94,7 +127,10 @@ def render(man: dict, max_events: int = 30) -> str:
                 for k, v in rec.items()
                 if k not in ("t", "kind")
             )
-            lines.append(f"  [{rec['t']:10.3f}s] {rec['kind']}  {extra}")
+            lines.append(
+                f"  [{rec.get('t', 0.0):10.3f}s] {rec.get('kind', '?')}"
+                f"  {extra}"
+            )
         if len(events) > max_events:
             lines.append(f"  ... {len(events) - max_events} more")
 
@@ -113,11 +149,51 @@ def render(man: dict, max_events: int = 30) -> str:
     return "\n".join(lines) + "\n"
 
 
+def _render_merged_sections(man: dict) -> list[str]:
+    hosts = man.get("hosts") or []
+    lines = _section(f"hosts ({len(hosts)})")
+    for h in hosts:
+        flags = "  ABORTED" if h.get("aborted") else ""
+        lines.append(
+            f"  p{h.get('process_index', 0):<3d} "
+            f"{h.get('hostname', '?'):<20} "
+            f"{h.get('duration_s', 0.0):10.3f} s  "
+            f"run_id={h.get('run_id', '?')}{flags}"
+        )
+    strag = (man.get("straggler") or {}).get("timers") or {}
+    if strag:
+        lines += _section("per-host stage-time spread (straggler view)")
+        width = max(len(k) for k in strag)
+        lines.append(
+            f"  {'stage':<{width}}  {'min':>9}  {'max':>9}  "
+            f"{'spread':>9}  slowest"
+        )
+        for k, st in sorted(
+            strag.items(), key=lambda kv: -kv[1].get("spread", 0.0)
+        ):
+            lines.append(
+                f"  {k:<{width}}  {st.get('min', 0.0):8.3f}s  "
+                f"{st.get('max', 0.0):8.3f}s  "
+                f"{st.get('spread', 0.0):8.3f}s  "
+                f"p{st.get('slowest', {}).get('process_index', '?')}"
+                f"@{st.get('slowest', {}).get('hostname', '?')}"
+            )
+    imb = (man.get("straggler") or {}).get("imbalance")
+    if imb:
+        lines.append(
+            f"  wall-clock imbalance: slowest/mean = "
+            f"{imb.get('ratio', 1.0):.3f} "
+            f"(slowest p{imb.get('slowest', {}).get('process_index', '?')}"
+            f"@{imb.get('slowest', {}).get('hostname', '?')})"
+        )
+    return lines
+
+
 def diff(a: dict, b: dict, max_events: int = 0) -> str:
     """Aligned comparison of two manifests (timers + counters/gauges):
     the 'why did this BENCH number move' view."""
     lines = [
-        f"diff: {a['run_id']}  ->  {b['run_id']}",
+        f"diff: {a.get('run_id', '?')}  ->  {b.get('run_id', '?')}",
         f"  duration: {a.get('duration_s', 0.0):.3f} s -> "
         f"{b.get('duration_s', 0.0):.3f} s",
     ]
@@ -147,20 +223,173 @@ def diff(a: dict, b: dict, max_events: int = 0) -> str:
     return "\n".join(lines) + "\n"
 
 
+def merge_manifests(shards: list[dict]) -> dict:
+    """Combine per-host manifest shards into one merged manifest with
+    straggler/imbalance statistics.
+
+    Merge semantics: ``timers``/``gauges`` take the MAX across hosts
+    (a stage is only done when the slowest host is done; gauges are
+    high-water marks), ``counters`` SUM (work done), events concatenate
+    tagged with their host. The ``straggler`` section carries per-stage
+    min/max/mean/spread with slowest-host attribution — the question a
+    merged view exists to answer is "which host is dragging the run".
+    """
+    if not shards:
+        raise ValueError("no shards to merge")
+    shards = sorted(
+        shards,
+        key=lambda m: (
+            m.get(
+                "process_index",
+                (m.get("platform") or {}).get("process_index", 0),
+            ),
+            m.get("hostname", ""),
+        ),
+    )
+    hosts = []
+    for man in shards:
+        hosts.append(
+            {
+                "process_index": man.get(
+                    "process_index",
+                    (man.get("platform") or {}).get("process_index", 0),
+                ),
+                "hostname": man.get("hostname", "?"),
+                "pid": man.get("pid"),
+                "run_id": man.get("run_id", "?"),
+                "duration_s": float(man.get("duration_s", 0.0)),
+                "aborted": bool(man.get("aborted", False)),
+                "n_events": len(man.get("events") or []),
+                "timers": man.get("timers") or {},
+            }
+        )
+
+    def _host_ref(h: dict) -> dict:
+        return {
+            "process_index": h["process_index"],
+            "hostname": h["hostname"],
+        }
+
+    timer_keys = sorted({k for h in hosts for k in h["timers"]})
+    straggler_timers: dict[str, dict] = {}
+    merged_timers: dict[str, float] = {}
+    for k in timer_keys:
+        vals = [
+            (h["timers"][k], h) for h in hosts if k in h["timers"]
+        ]
+        vmin, vmax = (
+            min(v for v, _ in vals),
+            max(v for v, _ in vals),
+        )
+        mean = sum(v for v, _ in vals) / len(vals)
+        slowest = max(vals, key=lambda vh: vh[0])[1]
+        merged_timers[k] = vmax
+        if len(vals) > 1:
+            straggler_timers[k] = {
+                "min": vmin,
+                "max": vmax,
+                "mean": mean,
+                "spread": vmax - vmin,
+                "spread_frac": (vmax - vmin) / mean if mean else 0.0,
+                "n_hosts": len(vals),
+                "slowest": _host_ref(slowest),
+            }
+
+    counters: dict[str, float] = {}
+    gauges: dict[str, float] = {}
+    for man in shards:
+        for k, v in (man.get("counters") or {}).items():
+            counters[k] = counters.get(k, 0) + v
+        for k, v in (man.get("gauges") or {}).items():
+            gauges[k] = max(gauges.get(k, v), v)
+
+    events = []
+    for man, h in zip(shards, hosts):
+        for rec in man.get("events") or []:
+            events.append({**rec, "process_index": h["process_index"]})
+    events.sort(key=lambda r: r.get("t", 0.0))
+
+    durations = [(h["duration_s"], h) for h in hosts]
+    dmax = max(v for v, _ in durations)
+    dmean = sum(v for v, _ in durations) / len(durations)
+    slowest_host = max(durations, key=lambda vh: vh[0])[1]
+
+    merged = {
+        "schema": MANIFEST_SCHEMA,
+        "version": MANIFEST_VERSION,
+        "run_id": shards[0].get("run_id", "?"),
+        "created_unix": min(
+            m.get("created_unix", 0.0) for m in shards
+        ),
+        "duration_s": dmax,
+        "merged": True,
+        "n_hosts": len(hosts),
+        "process_count": max(
+            m.get("process_count", len(hosts)) for m in shards
+        ),
+        "context": shards[0].get("context") or {},
+        "hosts": hosts,
+        "timers": merged_timers,
+        "counters": {k: counters[k] for k in sorted(counters)},
+        "gauges": {k: gauges[k] for k in sorted(gauges)},
+        "jit": {},
+        "events": events,
+        "device_trace": None,
+        "straggler": {
+            "timers": straggler_timers,
+            "imbalance": {
+                "max_s": dmax,
+                "mean_s": dmean,
+                "ratio": dmax / dmean if dmean else 1.0,
+                "slowest": _host_ref(slowest_host),
+            },
+        },
+    }
+    if any(h["aborted"] for h in hosts):
+        merged["aborted"] = True
+        merged["abort_reason"] = "; ".join(
+            f"p{h['process_index']}" for h in hosts if h["aborted"]
+        )
+    return merged
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
         prog="peasoup-report",
-        description="Render or diff telemetry.json run manifests",
+        description="Render, diff, or merge telemetry.json run manifests",
     )
     p.add_argument(
         "manifests", nargs="+",
-        help="one manifest to render, or two to diff (old new)",
+        help="one manifest to render, two to diff (old new), or N "
+        "per-host shards with --merge",
     )
     p.add_argument(
         "--events", type=int, default=30,
         help="max adaptive events to render (default 30)",
     )
+    p.add_argument(
+        "--merge", action="store_true",
+        help="combine per-host manifest shards (telemetry.procN.json) "
+        "into one merged manifest with straggler statistics",
+    )
+    p.add_argument(
+        "-o", "--output", default=None,
+        help="with --merge: write the merged manifest JSON here "
+        "(still renders the summary to stdout)",
+    )
     args = p.parse_args(argv)
+    if args.merge:
+        if len(args.manifests) < 2:
+            p.error("--merge expects at least two per-host shards")
+        merged = merge_manifests(
+            [load_manifest(m) for m in args.manifests]
+        )
+        if args.output:
+            with open(args.output, "w") as f:
+                json.dump(merged, f, indent=2)
+                f.write("\n")
+        sys.stdout.write(render(merged, max_events=args.events))
+        return 0
     if len(args.manifests) > 2:
         p.error("expected one manifest (render) or two (diff)")
     mans = [load_manifest(m) for m in args.manifests]

@@ -1,0 +1,142 @@
+"""Tail/render a live ``status.json`` heartbeat.
+
+    python -m peasoup_tpu.tools.watch run/status.json
+    python -m peasoup_tpu.tools.watch run/status.json --once
+
+The heartbeat (peasoup_tpu/obs/heartbeat.py, enabled per run with
+``--status-json``) atomically rewrites the snapshot every few seconds;
+this tool polls it and prints one compact line-block per NEW snapshot
+(keyed on ``seq``), so it composes with ``tee``/log collectors instead
+of fighting the terminal. It exits when the run reports ``done`` (or
+immediately with ``--once``), and flags a heartbeat whose
+``updated_unix`` has gone stale — the difference between a run that is
+slow and a process that is gone.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _bar(frac: float, width: int = 24) -> str:
+    filled = int(round(max(0.0, min(1.0, frac)) * width))
+    return "#" * filled + "." * (width - filled)
+
+
+def render_status(st: dict, stale_after: float = 0.0) -> str:
+    """One compact text block for a status snapshot."""
+    prog = st.get("progress") or {}
+    head = (
+        f"run {st.get('run_id', '?')}  "
+        f"p{st.get('pid', '?')}@{st.get('hostname', '?')}  "
+        f"stage={st.get('stage') or '-'}  "
+        f"up {st.get('uptime_s', 0.0):.1f}s  seq={st.get('seq', '?')}"
+    )
+    lines = [head]
+    total = prog.get("total")
+    if prog:
+        frac = prog.get("frac")
+        rate = prog.get("rate_per_s")
+        eta = prog.get("eta_s")
+        unit = prog.get("unit") or ""
+        bits = []
+        if frac is not None:
+            bits.append(f"[{_bar(frac)}] {frac * 100.0:5.1f}%")
+        bits.append(
+            f"{prog.get('done', 0):g}"
+            + (f"/{total:g}" if total else "")
+            + (f" {unit}" if unit else "")
+        )
+        if rate:
+            bits.append(f"{rate:.3g} {unit or 'units'}/s")
+        if eta is not None:
+            bits.append(f"ETA {eta:.1f}s")
+        lines.append("  " + "  ".join(bits))
+    mem = (st.get("gauges") or {}).get("memory.peak_bytes")
+    if mem:
+        lines.append(f"  device memory high-water: {mem / 1e9:.2f} GB")
+    if st.get("stalled"):
+        lines.append(
+            f"  *** STALLED: no progress for "
+            f"{st.get('last_progress_age_s', 0.0):.0f}s ***"
+        )
+    age = time.time() - st.get("updated_unix", time.time())
+    if stale_after and age > stale_after:
+        lines.append(
+            f"  *** heartbeat STALE: last update {age:.0f}s ago — "
+            f"process dead or wedged? ***"
+        )
+    for rec in (st.get("events_tail") or [])[-3:]:
+        extra = " ".join(
+            f"{k}={v}"
+            for k, v in rec.items()
+            if k not in ("t", "kind")
+        )
+        lines.append(
+            f"  [{rec.get('t', 0.0):9.3f}s] {rec.get('kind', '?')}  "
+            f"{extra}"
+        )
+    if st.get("done"):
+        lines.append("  run complete.")
+    return "\n".join(lines) + "\n"
+
+
+def _read(path: str) -> dict | None:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None  # not yet written, or mid-replace on exotic fs
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="peasoup-watch",
+        description="Tail/render a live status.json heartbeat",
+    )
+    p.add_argument("status", help="path to the run's status.json")
+    p.add_argument(
+        "--interval", type=float, default=1.0,
+        help="poll interval in seconds (default 1)",
+    )
+    p.add_argument(
+        "--once", action="store_true",
+        help="render the current snapshot once and exit",
+    )
+    p.add_argument(
+        "--timeout", type=float, default=0.0,
+        help="give up after this many seconds without a snapshot "
+        "appearing (default: wait forever)",
+    )
+    args = p.parse_args(argv)
+
+    t0 = time.monotonic()
+    last_seq = None
+    stale_after = max(10.0, 5 * args.interval)
+    while True:
+        st = _read(args.status)
+        if st is None:
+            if args.once or (
+                args.timeout and time.monotonic() - t0 > args.timeout
+            ):
+                sys.stderr.write(f"no status at {args.status}\n")
+                return 1
+            time.sleep(args.interval)
+            continue
+        if st.get("seq") != last_seq or args.once:
+            last_seq = st.get("seq")
+            sys.stdout.write(render_status(st, stale_after=stale_after))
+            sys.stdout.flush()
+        if args.once or st.get("done"):
+            return 0
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

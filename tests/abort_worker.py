@@ -1,0 +1,53 @@
+"""Worker process for the SIGTERM abort-forensics test.
+
+Launched by tests/test_live_obs.py: runs a real (tiny) `peasoup` CLI
+search with the status.json heartbeat enabled, so the parent can wait
+for the heartbeat to appear (proof the flight recorder is armed — the
+recorder installs before the first snapshot), SIGTERM the run
+mid-flight, and assert the forensics: flight.json plus a partial
+telemetry manifest marked ``"aborted": true``.
+
+Usage: python abort_worker.py <fil_path> <outdir>
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    cache = os.path.join(
+        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+        "peasoup_tpu", "jax-tests",
+    )
+    os.makedirs(cache, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+except Exception:
+    pass
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> int:
+    fil_path, outdir = sys.argv[1], sys.argv[2]
+    from peasoup_tpu.cli.peasoup import main as peasoup_main
+
+    return peasoup_main(
+        [
+            "-i", fil_path,
+            "-o", outdir,
+            "--dm_end", "40",
+            "-n", "2",
+            "--limit", "20",
+            "--status-json", os.path.join(outdir, "status.json"),
+            "--heartbeat-interval", "0.05",
+        ]
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())
