@@ -12,8 +12,43 @@ names and semantics can't drift between tools.
 
 from __future__ import annotations
 
+import argparse
 import contextlib
 import os
+
+
+class _VersionAction(argparse.Action):
+    """--version for every CLI: package version, JAX version, and the
+    active backend — the first three facts every bug report needs.
+    Imports stay lazy so ``--help`` never pays for a backend init."""
+
+    def __call__(self, parser, namespace, values, option_string=None):
+        from .. import __version__
+
+        try:
+            import jax
+
+            jax_version = jax.__version__
+            try:
+                backend = jax.default_backend()
+            except Exception as exc:  # no usable backend is still a fact
+                backend = f"unavailable ({type(exc).__name__})"
+        except Exception:
+            jax_version = backend = "unavailable"
+        print(
+            f"peasoup_tpu {__version__} (jax {jax_version}, "
+            f"backend {backend})"
+        )
+        parser.exit(0)
+
+
+def add_version_arg(p) -> None:
+    """Wire the shared --version flag (see _VersionAction)."""
+    p.add_argument(
+        "--version", action=_VersionAction, nargs=0,
+        help="print package version, JAX version, and active backend, "
+        "then exit",
+    )
 
 
 def add_observability_args(p) -> None:

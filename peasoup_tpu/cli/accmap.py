@@ -24,6 +24,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("files", nargs="+", help="Beam files (.fil or .tim)")
     p.add_argument("-d", "--max_delay", type=int, default=600,
                    help="Maximum lag to search (samples)")
+    from . import add_version_arg
+
+    add_version_arg(p)
     return p
 
 

@@ -23,6 +23,7 @@ import numpy as np
 
 from . import (
     add_observability_args,
+    add_version_arg,
     init_observability,
     live_observability,
 )
@@ -50,6 +51,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-b", "--max_harm", type=int, default=16)
     p.add_argument("-f", "--freq_tol", type=float, default=0.0001)
     p.add_argument("-v", "--verbose", action="store_true")
+    add_version_arg(p)
     add_observability_args(p)
     return p
 

@@ -20,6 +20,7 @@ import numpy as np
 
 from . import (
     add_observability_args,
+    add_version_arg,
     init_observability,
     live_observability,
 )
@@ -66,6 +67,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Maximum candidates to write")
     p.add_argument("-v", "--verbose", action="store_true")
     p.add_argument("-p", "--progress_bar", action="store_true")
+    add_version_arg(p)
     add_observability_args(p)
     return p
 

@@ -14,6 +14,7 @@ import time
 
 from . import (
     add_observability_args,
+    add_version_arg,
     init_observability,
     live_observability,
 )
@@ -89,6 +90,7 @@ def build_parser() -> argparse.ArgumentParser:
         "share their entire rounded resample-shift map (the dedupe is "
         "bitwise-output-equal; this flag exists for timing comparisons)",
     )
+    add_version_arg(p)
     add_observability_args(p)
     return p
 

@@ -1,1 +1,7 @@
-from .candidates import Candidate, CandidateCollection, CANDIDATE_POD_DTYPE
+from .candidates import (
+    Candidate,
+    CandidateCollection,
+    CANDIDATE_POD_DTYPE,
+    SinglePulseCandidate,
+    SinglePulseCandidateCollection,
+)

@@ -5,6 +5,14 @@ from .zap import birdie_mask, zap_birdies
 from .resample import resample_accel, resample_accel_quadratic, accel_factor
 from .harmonics import harmonic_sums
 from .peaks import find_peaks_device, cluster_peaks
+from .singlepulse import (
+    boxcar_best,
+    default_widths,
+    make_single_pulse_search_fn,
+    matched_filter_snr,
+    normalise_trials,
+    width_scales,
+)
 from .fold import fold_time_series, fold_time_series_np
 from .fold_optimise import FoldOptimiser
 from .coincidence import coincidence_mask

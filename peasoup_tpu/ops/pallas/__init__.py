@@ -396,6 +396,69 @@ def probe_pallas_dftspec(n: int, npad: int) -> bool:
         return False
 
 
+@lru_cache(maxsize=None)
+def probe_pallas_boxcar(n_widths: int, span: int) -> bool:
+    """REAL compile+run probe of the single-pulse boxcar sweep kernel
+    (ops/pallas/boxcar.py) at the production width count and tile span,
+    gated on BITWISE equality with the jnp twin
+    (ops.singlepulse.boxcar_best_twin): both consume the same padded
+    prefix-sum rows and replay the same f32 subtract/scale/mask/max
+    chain, so any difference means a broken lowering (roll off by a
+    lane, bad SMEM scalar read, mis-clamped window). The features that
+    vary by toolchain (dynamic-offset 1-D DMA, dynamic pltpu.roll,
+    scalar-prefetch SMEM reads) are exercised at a reduced trial count
+    with the production (n_widths, span) geometry."""
+    if not backend_supports_pallas() or span <= 0:
+        return False
+    try:
+        import numpy as np
+        import jax.numpy as jnp
+
+        from .boxcar import boxcar_best_pallas
+        from ..singlepulse import (
+            boxcar_best_twin,
+            default_widths,
+            prefix_sum_padded,
+            width_extent,
+            width_scales,
+        )
+
+        widths = default_widths(n_widths)
+        scales = width_scales(widths)
+        tpad = 2 * span
+        wext = width_extent(widths)
+        rng = np.random.default_rng(0)
+        nvalid = tpad - span // 2  # exercise the validity tail mask
+        norm = rng.normal(size=(3, nvalid)).astype(np.float32)
+        # a planted bright pulse makes the argmax width data-sensitive
+        norm[1, nvalid // 3 : nvalid // 3 + 16] += 25.0
+        csum = prefix_sum_padded(jnp.asarray(norm), tpad, wext)
+        got_b, got_w = boxcar_best_pallas(
+            csum, widths, scales, nvalid, tpad, span=span
+        )
+        ref_b, ref_w = boxcar_best_twin(csum, widths, scales, nvalid, tpad)
+        ok = bool(
+            np.array_equal(np.asarray(got_b), np.asarray(ref_b))
+            and np.array_equal(np.asarray(got_w), np.asarray(ref_w))
+        )
+        if not ok:
+            import warnings
+
+            warnings.warn(
+                f"Pallas boxcar kernel FAILED the bitwise oracle check "
+                f"at n_widths={n_widths}, span={span}; using jnp twin"
+            )
+        return ok
+    except Exception as exc:  # any Mosaic/compile failure -> jnp twin
+        import warnings
+
+        warnings.warn(
+            f"Pallas boxcar kernel unavailable at n_widths={n_widths}, "
+            f"span={span}; using jnp twin: {type(exc).__name__}: {exc}"
+        )
+        return False
+
+
 from .resample import resample_block_pallas, resample_block  # noqa: E402
 
 

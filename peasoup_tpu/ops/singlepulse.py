@@ -1,0 +1,255 @@
+"""Single-pulse search device ops: per-trial normalisation + boxcar
+matched filtering over the dedispersed DM-time plane.
+
+The reference pipeline has NO single-pulse stage — it searches
+periodicity only. This module is the framework's new workload
+(ROADMAP: "opens a new workload"), following the canonical shape of
+GPU single-pulse pipelines (Heimdall; GSP, arXiv:2110.12749; the
+auto-tuned dedispersion survey work, arXiv:1601.01165): each DM
+trial's time series is baseline/variance normalised, convolved with a
+bank of ~12 log-spaced boxcar filters via cumulative-sum differencing,
+and thresholded in S/N.
+
+TPU design: everything is one jitted program over a (dm_block, nsamps)
+trial block — static shapes, no scalar loops.
+
+* The boxcar bank collapses to a per-sample BEST-width plane:
+  ``best[d, t] = max_w snr_w[d, t]`` and ``argw[d, t]``. This is the
+  W-fold memory reduction that makes the sweep device-friendly (the
+  full (D, W, T) S/N cube never exists in HBM), and per-sample best
+  width is exactly what single-pulse candidates report.
+* S/N extraction reuses the periodicity search's static-shape peak
+  machinery (ops/peaks.find_peaks_device) on a ``dec``-fold
+  max-decimated view of the best plane, with the true sample index
+  recovered from the in-block argmax — crossings are bounded by
+  run-length/dec, so a bright broad pulse cannot overflow the
+  compaction the way raw per-sample crossings would.
+* An optional Pallas kernel (ops/pallas/boxcar.py) keeps the width
+  sweep VMEM-resident with a scalar-prefetch width list; it is gated
+  by a compile+run bitwise oracle probe and falls back to the jnp
+  twin here, exactly like the other Pallas ops.
+
+The boxcar at sample ``t`` with width ``w`` covers ``[t, t + w)``:
+``snr_w[t] = (csum[t + w] - csum[t]) * scale[w]`` with
+``scale[w] = 1/sqrt(w)`` on the normalised series — the matched-filter
+S/N for a top-hat pulse in unit-variance noise.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .peaks import find_peaks_device
+
+# 1-D tiling quantum shared with the Pallas kernel (lane granularity of
+# flat refs; see ops/pallas/resample.py for the lowering constraints)
+_QUANT = 1024
+_SPAN_MAX = 8192  # samples per kernel invocation (VMEM window ~45 KB)
+
+# Std retained by a +-3 sigma clipped Gaussian:
+# sqrt(1 - 6*phi(3)/(2*Phi(3)-1)). The robust clipping passes estimate
+# sigma from clipped samples; dividing by the retention unbiases it so
+# reported S/N matches the matched-filter expectation on pure noise.
+CLIP3_STD_RETENTION = 0.9865835
+DEFAULT_N_WIDTHS = 12
+
+
+def default_widths(n_widths: int = DEFAULT_N_WIDTHS, max_width: int = 0):
+    """Octave-spaced boxcar widths 1, 2, 4, ... (samples). ``max_width``
+    > 0 additionally caps the largest width (the driver caps at a
+    fraction of the trial length so the filter never outgrows the
+    data)."""
+    widths = []
+    for k in range(max(1, n_widths)):
+        w = 1 << k
+        if max_width and w > max_width:
+            break
+        widths.append(w)
+    return tuple(widths)
+
+
+def width_scales(widths) -> np.ndarray:
+    """Matched-filter normalisation 1/sqrt(w) per width, rounded once
+    to f32 (the single source both the jnp twin and the Pallas kernel
+    multiply by, keeping them bitwise comparable)."""
+    return (1.0 / np.sqrt(np.asarray(widths, dtype=np.float64))).astype(
+        np.float32
+    )
+
+
+def plan_pad(nsamps: int) -> tuple[int, int]:
+    """(tpad, span): trial rows pad to ``tpad`` samples processed in
+    ``span``-sample kernel tiles; both are _QUANT multiples and span
+    divides tpad (Mosaic 1-D refs tile in 1024-lane quanta)."""
+    span = _SPAN_MAX if nsamps >= _SPAN_MAX else -(-nsamps // _QUANT) * _QUANT
+    tpad = -(-nsamps // span) * span
+    return tpad, span
+
+
+def width_extent(widths) -> int:
+    """Window slack past a tile for the largest boxcar, rounded to the
+    tiling quantum (the kernel's DMA length is span + this)."""
+    return -(-(int(max(widths)) + 2) // _QUANT) * _QUANT
+
+
+@partial(jax.jit, static_argnames=("clip_sigma", "n_rounds"))
+def normalise_trials(
+    x: jnp.ndarray, *, clip_sigma: float = 3.0, n_rounds: int = 2
+) -> jnp.ndarray:
+    """Per-trial baseline/variance normalisation with iterative
+    sigma-clipped moment re-estimation: moments over the full trial,
+    then ``n_rounds`` passes over samples within ``clip_sigma`` of the
+    running estimate, so a bright pulse does not inflate its own noise
+    estimate (a single pass is not enough — the pulse inflates the
+    FIRST std, so its clip bound sits far above clip_sigma true sigmas
+    and the truncation correction below would over-correct). The
+    clipped std is unbiased by the Gaussian truncation retention
+    (CLIP3_STD_RETENTION) each round, so the clip bound converges to
+    clip_sigma TRUE sigmas and pure noise normalises to unit variance
+    without bias."""
+    x = x.astype(jnp.float32)
+    n = x.shape[-1]
+    corr = np.float32(CLIP3_STD_RETENTION if clip_sigma == 3.0 else 1.0)
+    mean = jnp.sum(x, axis=-1, keepdims=True) / n
+    var = jnp.sum((x - mean) ** 2, axis=-1, keepdims=True) / n
+    std = jnp.sqrt(jnp.maximum(var, 1e-12))
+    for _ in range(max(1, n_rounds)):
+        keep = jnp.abs(x - mean) <= clip_sigma * std
+        nkeep = jnp.maximum(jnp.sum(keep, axis=-1, keepdims=True), 1)
+        mean = jnp.sum(jnp.where(keep, x, 0.0), axis=-1, keepdims=True) / nkeep
+        var = (
+            jnp.sum(
+                jnp.where(keep, (x - mean) ** 2, 0.0), axis=-1, keepdims=True
+            )
+            / nkeep
+        )
+        std = jnp.sqrt(jnp.maximum(var, 1e-12)) / corr
+    return (x - mean) / std
+
+
+def prefix_sum_padded(norm: jnp.ndarray, tpad: int, wext: int) -> jnp.ndarray:
+    """(D, tpad + wext) exclusive prefix sum rows: csum[d, t] =
+    sum(norm[d, :t]) for t <= nsamps, zero-padded past it. Built ONCE
+    and consumed identically by the jnp twin and the Pallas kernel
+    (identical bits in -> bitwise-comparable sweeps out)."""
+    d, n = norm.shape
+    csum = jnp.cumsum(norm, axis=-1, dtype=jnp.float32)
+    lead = jnp.zeros((d, 1), jnp.float32)
+    return jnp.pad(
+        jnp.concatenate([lead, csum], axis=-1), ((0, 0), (0, tpad + wext - n - 1))
+    )
+
+
+def boxcar_best_twin(
+    csum_pad: jnp.ndarray,  # (D, tpad + wext) from prefix_sum_padded
+    widths: tuple[int, ...],
+    scales: np.ndarray,  # f32 from width_scales
+    nvalid: int,
+    tpad: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Pure-jnp width sweep: (best S/N (D, tpad) f32, best width index
+    (D, tpad) i32). Boxcars starting past ``nvalid - w`` are -inf (and
+    therefore never the argmax). Width ties keep the NARROWEST width
+    (strict > in the running max), matching the kernel's loop."""
+    j = jnp.arange(tpad, dtype=jnp.int32)
+    lo = csum_pad[:, :tpad]
+    neg_inf = jnp.float32(-jnp.inf)
+    best = jnp.full(lo.shape, neg_inf, jnp.float32)
+    bw = jnp.zeros(lo.shape, jnp.int32)
+    for k, w in enumerate(widths):
+        hi = csum_pad[:, w : w + tpad]
+        snr = jnp.where(
+            j + w <= nvalid, (hi - lo) * jnp.float32(scales[k]), neg_inf
+        )
+        better = snr > best
+        best = jnp.where(better, snr, best)
+        bw = jnp.where(better, jnp.int32(k), bw)
+    return best, bw
+
+
+def boxcar_best(
+    norm: jnp.ndarray,  # (D, nsamps) normalised trials
+    widths: tuple[int, ...],
+    *,
+    pallas_span: int = 0,  # 0 = jnp twin; >0 = Pallas tile span
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Dispatch the width sweep: the Pallas kernel when the caller
+    resolved a span (probe passed), else the jnp twin. Returns
+    (best (D, tpad), argw (D, tpad)) with tpad from plan_pad."""
+    n = norm.shape[-1]
+    tpad, span = plan_pad(n)
+    wext = width_extent(widths)
+    scales = width_scales(widths)
+    csum_pad = prefix_sum_padded(norm, tpad, wext)
+    if pallas_span:
+        from .pallas.boxcar import boxcar_best_pallas
+
+        return boxcar_best_pallas(
+            csum_pad, widths, scales, n, tpad, span=pallas_span,
+            interpret=interpret,
+        )
+    return boxcar_best_twin(csum_pad, widths, scales, n, tpad)
+
+
+@lru_cache(maxsize=16)
+def make_single_pulse_search_fn(
+    widths: tuple[int, ...],
+    threshold: float,
+    max_events: int,
+    dec: int,
+    pallas_span: int,
+):
+    """One jitted program: u8/f32 trial block -> per-trial single-pulse
+    events. Returns fn(trials (D, nsamps)) ->
+    (samples (D, K) i32, width_idx (D, K) i32, snrs (D, K) f32,
+    counts (D,) i32) with K = max_events; ``counts`` may exceed K
+    (overflow — the driver logs and keeps the first K, which arrive in
+    ascending time order). Events are ``dec``-fold max-decimated block
+    peaks of the best-width plane; the sample index is exact (argmax
+    within the block)."""
+
+    def run(trials: jnp.ndarray):
+        d = trials.shape[0]
+        n = trials.shape[-1]
+        tpad, _ = plan_pad(n)
+        if tpad % dec:
+            raise ValueError(
+                f"decimate={dec} must divide the padded trial length "
+                f"{tpad} (use a power of two <= {_QUANT})"
+            )
+        norm = normalise_trials(trials)
+        best, bw = boxcar_best(
+            norm, widths, pallas_span=pallas_span
+        )
+        nbd = tpad // dec
+        blocks = best.reshape(d, nbd, dec)
+        bmax = jnp.max(blocks, axis=-1)
+        barg = jnp.argmax(blocks, axis=-1).astype(jnp.int32)
+        pidx, psnr, pcount = find_peaks_device(
+            bmax, jnp.float32(threshold), jnp.int32(0), jnp.int32(nbd),
+            max_peaks=max_events,
+        )
+        valid = pidx < nbd
+        safe = jnp.minimum(pidx, nbd - 1)
+        samples = safe * dec + jnp.take_along_axis(barg, safe, axis=-1)
+        widx = jnp.take_along_axis(
+            bw, jnp.clip(samples, 0, tpad - 1), axis=-1
+        )
+        samples = jnp.where(valid, samples, -1)
+        widx = jnp.where(valid, widx, 0)
+        return samples, widx, psnr, pcount
+
+    return jax.jit(run)
+
+
+def matched_filter_snr(amplitude: float, width: int, sigma: float) -> float:
+    """Analytic boxcar matched-filter S/N for a top-hat pulse of
+    per-sample ``amplitude`` and ``width`` samples in noise of std
+    ``sigma`` — the oracle the injection-recovery test checks against:
+    S/N = amplitude * sqrt(width) / sigma."""
+    return float(amplitude) * float(np.sqrt(width)) / float(sigma)

@@ -1,4 +1,5 @@
 from .distill import HarmonicDistiller, AccelerationDistiller, DMDistiller
 from .score import CandidateScorer
 from .search import SearchConfig, PeasoupSearch
+from .single_pulse import SinglePulseConfig, SinglePulseSearch
 from .folder import MultiFolder

@@ -1,0 +1,570 @@
+"""Host-side single-pulse search driver: the framework's new transient
+workload over the dedispersed DM-time plane.
+
+Mirrors pipeline/search.py's shape — a single host process walks the
+GLOBAL DM plan in device waves, reusing the dedispersion engines
+(ops/dedisperse.py), the mesh/sharding helpers (parallel/), and the
+per-trial SearchCheckpoint (keyed by a single-pulse config key, so a
+periodicity checkpoint can never resume a single-pulse run or vice
+versa). Per-trial device work is ops/singlepulse.py's jitted
+normalise -> boxcar-bank -> peak program; the host then clusters the
+raw (dm, time, width) events with a friends-of-friends pass so one
+broad pulse detected at many DM trials / widths / samples reports as
+ONE candidate with its footprint (the clustering stage of Heimdall and
+GSP, arXiv:2110.12749).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.candidates import (
+    SinglePulseCandidate,
+    SinglePulseCandidateCollection,
+)
+from ..io.masks import read_killfile
+from ..io.sigproc import Filterbank
+from ..obs import get_logger
+from ..obs.telemetry import current as current_telemetry
+from ..ops.dedisperse import (
+    dedisperse,
+    dedisperse_device,
+    fil_to_device,
+    output_scale,
+)
+from ..ops.singlepulse import (
+    default_widths,
+    make_single_pulse_search_fn,
+    plan_pad,
+)
+from ..plan.dm_plan import DMPlan
+from ..utils import ProgressBar, trace_span
+from .checkpoint import SearchCheckpoint
+from .search import _is_oom
+
+log = get_logger("pipeline.single_pulse")
+
+
+@dataclass
+class SinglePulseConfig:
+    """Single-pulse search knobs (no reference equivalent — peasoup
+    searches periodicity only; defaults follow Heimdall/GSP practice)."""
+
+    outdir: str = "."
+    killfilename: str = ""
+    limit: int = 1000
+    dm_start: float = 0.0
+    dm_end: float = 100.0
+    dm_tol: float = 1.10
+    dm_pulse_width: float = 64.0
+    min_snr: float = 6.0  # single-pulse searches threshold lower than
+    # periodicity (each trial is one matched filter, not 2^20 bins)
+    n_widths: int = 12  # octave-spaced boxcar widths 1..2^(n-1) samples
+    max_width: int = 0  # optional cap on the widest boxcar (samples);
+    # 0 = only the n_widths / trial-length caps apply
+    max_events: int = 256  # static per-trial event-compaction size
+    decimate: int = 32  # best-plane max-decimation factor before the
+    # peak compaction (bounds crossings to run-length/decimate)
+    time_link: float = 1.0  # friends-of-friends: events link when
+    # |dt| <= time_link * max(width_i, width_j) + decimate
+    dm_link: int = 2  # ... and |d dm_idx| <= dm_link
+    verbose: bool = False
+    progress_bar: bool = False
+    max_num_threads: int = 14
+    # TPU-specific knobs, mirroring SearchConfig
+    dedisp_block: int = 16
+    dm_block: int = 0  # DM trials per device call; 0 = auto from HBM
+    hbm_bytes: int = 0
+    checkpoint_file: str = ""
+    use_pallas: bool = True  # Pallas boxcar kernel on TPU backends
+    shard_devices: int = 0  # 0 = auto; N forces an N-chip 'dm' mesh
+
+
+@dataclass
+class SinglePulseResult:
+    candidates: list
+    dm_list: np.ndarray
+    widths: tuple[int, ...]
+    timers: dict
+    nsamps: int
+    n_events: int = 0  # raw above-threshold events before clustering
+    n_overflowed: int = 0  # trials whose event count exceeded max_events
+
+
+_EVENT_DTYPE = np.dtype(
+    [
+        ("dm_idx", np.int64),
+        ("sample", np.int64),
+        ("width_idx", np.int64),
+        ("snr", np.float64),
+    ]
+)
+
+
+def cluster_events_fof(
+    events: np.ndarray,  # _EVENT_DTYPE records
+    widths: tuple[int, ...],
+    *,
+    time_link: float = 1.0,
+    dm_link: int = 2,
+    dec: int = 32,
+) -> list[np.ndarray]:
+    """Friends-of-friends in (time, DM, width): two events are friends
+    when their start samples lie within ``time_link * max(w_i, w_j) +
+    dec`` AND their DM trials within ``dm_link``. Width enters through
+    the time tolerance (a broad detection reaches further), which links
+    the width ladder a bright pulse climbs without any explicit width
+    adjacency rule. Returns index arrays, one per cluster.
+
+    The pair scan slides over time-sorted events (the time tolerance is
+    bounded by the widest filter), so cost is O(n * window) — fine for
+    the tens of thousands of events a threshold sweep emits.
+    """
+    n = len(events)
+    if n == 0:
+        return []
+    order = np.argsort(events["sample"], kind="stable")
+    ev = events[order]
+    wmax_link = time_link * float(max(widths)) + dec
+    parent = np.arange(n, dtype=np.int64)
+
+    def find(a: int) -> int:
+        while parent[a] != a:
+            parent[a] = parent[parent[a]]
+            a = parent[a]
+        return a
+
+    w_of = np.asarray(widths, dtype=np.float64)[ev["width_idx"]]
+    lo = 0
+    for j in range(n):
+        while ev["sample"][j] - ev["sample"][lo] > wmax_link:
+            lo += 1
+        for i in range(lo, j):
+            dt = ev["sample"][j] - ev["sample"][i]
+            if dt > time_link * max(w_of[i], w_of[j]) + dec:
+                continue
+            if abs(ev["dm_idx"][j] - ev["dm_idx"][i]) > dm_link:
+                continue
+            ra, rb = find(i), find(j)
+            if ra != rb:
+                parent[rb] = ra
+        # liveness note: the [lo, j) window is bounded by wmax_link
+    roots: dict[int, list[int]] = {}
+    for i in range(n):
+        roots.setdefault(find(i), []).append(i)
+    return [order[np.asarray(members)] for members in roots.values()]
+
+
+def make_checkpoint_key(
+    cfg: SinglePulseConfig, fil, global_ndm: int, widths: tuple[int, ...]
+) -> str:
+    """Config key over everything that changes per-trial events —
+    including the observation's identity and the workload TYPE prefix,
+    so a periodicity checkpoint can never resume a single-pulse run."""
+    h = fil.header
+    fields = (
+        "sp-v1",  # single-pulse per-trial payload format version
+        fil.nsamps, fil.nchans, global_ndm,
+        fil.tsamp, fil.fch1, fil.foff,
+        getattr(h, "tstart", None), getattr(h, "source_name", None),
+        getattr(h, "nbits", None),
+        cfg.dm_start, cfg.dm_end, cfg.dm_tol, cfg.dm_pulse_width,
+        cfg.min_snr, tuple(int(w) for w in widths), cfg.max_events,
+        cfg.decimate, cfg.killfilename,
+    )
+    return repr(fields)
+
+
+class SinglePulseSearch:
+    """Walk the DM plan in device waves and cluster the events.
+
+    HBM accounting mirrors PeasoupSearch: the per-trial working set is
+    ~4 f32 planes of the padded trial length (normalised series, prefix
+    sum, best-S/N, best-width), so the auto dm_block is
+    budget / (16 * tpad)."""
+
+    TOTAL_HBM = 12_000_000_000
+    TRIALS_DEVICE_LIMIT = 4_000_000_000
+
+    def __init__(self, config: SinglePulseConfig):
+        self.config = config
+        import os
+
+        devs = jax.local_devices()
+        limit = config.hbm_bytes or int(
+            os.environ.get("PEASOUP_HBM_BYTES", 0) or 0
+        )
+        if not limit:
+            try:
+                limit = (devs[0].memory_stats() or {}).get("bytes_limit", 0)
+            except Exception:
+                limit = 0
+        if limit:
+            self.TOTAL_HBM = int(limit)
+            self.TRIALS_DEVICE_LIMIT = int(limit) // 3
+
+    def build_dm_plan(self, fil: Filterbank) -> DMPlan:
+        """The GLOBAL dedispersion plan (same construction as the
+        periodicity search's — the two workloads share the DM-time
+        plane by design)."""
+        cfg = self.config
+        killmask = None
+        if cfg.killfilename:
+            killmask = read_killfile(cfg.killfilename, fil.nchans)
+        return DMPlan.create(
+            nsamps=fil.nsamps,
+            nchans=fil.nchans,
+            tsamp=fil.tsamp,
+            fch1=fil.fch1,
+            foff=fil.foff,
+            dm_start=cfg.dm_start,
+            dm_end=cfg.dm_end,
+            pulse_width=cfg.dm_pulse_width,
+            tol=cfg.dm_tol,
+            killmask=killmask,
+        )
+
+    def widths_for(self, out_nsamps: int) -> tuple[int, ...]:
+        """The run's boxcar bank: octave-spaced, capped so the widest
+        filter is at most a quarter of the trial (beyond that the
+        'pulse' is baseline, not transient) and by cfg.max_width."""
+        cap = max(1, out_nsamps // 4)
+        if self.config.max_width:
+            cap = min(cap, self.config.max_width)
+        return default_widths(self.config.n_widths, max_width=cap)
+
+    def _pick_devices(self) -> list:
+        cfg = self.config
+        devs = jax.local_devices()
+        if cfg.shard_devices > 0:
+            return devs[: min(cfg.shard_devices, len(devs))]
+        if devs and devs[0].platform == "tpu":
+            return devs[: min(len(devs), cfg.max_num_threads)]
+        return devs[:1]
+
+    def run(self, fil: Filterbank) -> SinglePulseResult:
+        cfg = self.config
+        tel = current_telemetry()
+        timers: dict[str, float] = {}
+        t_total = time.perf_counter()
+
+        # --- plan ------------------------------------------------------
+        t0 = time.perf_counter()
+        tel.set_stage("plan")
+        dm_plan = self.build_dm_plan(fil)
+        widths = self.widths_for(dm_plan.out_nsamps)
+        timers["plan"] = time.perf_counter() - t0
+        tel.gauge("sp.n_dm_trials", int(dm_plan.ndm))
+        tel.gauge("sp.n_widths", len(widths))
+        tel.event(
+            "sp_plan", ndm=int(dm_plan.ndm), out_nsamps=int(dm_plan.out_nsamps),
+            widths=[int(w) for w in widths],
+        )
+
+        # --- checkpoint store (load before dedispersion: a fully
+        # restored run skips the expensive part, like the periodicity
+        # driver's resume fast path) -----------------------------------
+        ckpt = None
+        restored: dict[int, tuple] = {}
+        if cfg.checkpoint_file:
+            ckpt = SearchCheckpoint(
+                cfg.checkpoint_file,
+                make_checkpoint_key(cfg, fil, dm_plan.ndm, widths),
+            )
+            restored = ckpt.load()
+        skip_dedisp = dm_plan.ndm > 0 and all(
+            d in restored for d in range(dm_plan.ndm)
+        )
+
+        # --- dedispersion (reusing the periodicity engines) ------------
+        t0 = time.perf_counter()
+        tel.set_stage("dedispersion")
+        devices = self._pick_devices()
+        mesh = None
+        if len(devices) > 1:
+            from ..parallel.mesh import make_mesh
+
+            mesh = make_mesh({"dm": len(devices)}, devices=devices)
+        trials_bytes = dm_plan.ndm * dm_plan.out_nsamps
+        spill = trials_bytes > self.TRIALS_DEVICE_LIMIT * (
+            len(devices) if mesh is not None else 1
+        )
+        tel.event(
+            "sp_device_plan", n_devices=len(devices),
+            sharded=mesh is not None, trials_spill=bool(spill),
+            trials_bytes=int(trials_bytes),
+        )
+        scale = output_scale(fil.nbits, int(dm_plan.killmask.sum()))
+        if skip_dedisp:
+            log.info(
+                "Resume fast path: all %d trials checkpointed — "
+                "skipping dedispersion", dm_plan.ndm,
+            )
+            tel.event("sp_resume_fast_path", ndm=int(dm_plan.ndm))
+            trials = np.zeros((0, dm_plan.out_nsamps), dtype=np.uint8)
+            spill = True
+        else:
+            with trace_span("Dedisperse"):
+                shard_dd = (
+                    mesh is not None
+                    and not spill
+                    and 4 * fil.nsamps * fil.nchans < 3_000_000_000
+                )
+                if shard_dd:
+                    try:
+                        from ..parallel.sharded_dedisperse import (
+                            dedisperse_sharded,
+                        )
+
+                        trials = dedisperse_sharded(
+                            fil_to_device(fil),
+                            dm_plan.delay_samples(),
+                            dm_plan.killmask,
+                            dm_plan.out_nsamps,
+                            mesh,
+                            scale=scale,
+                            block=cfg.dedisp_block,
+                        )
+                        jax.block_until_ready(trials)
+                    except Exception as exc:
+                        # shard_map availability varies by jax release;
+                        # a single-device dedispersion is always correct
+                        # (the search blocks re-shard onto the mesh)
+                        log.warning(
+                            "sharded dedispersion unavailable (%.200s); "
+                            "falling back to the single-device engine",
+                            exc,
+                        )
+                        tel.event(
+                            "sp_sharded_dedisp_fallback",
+                            error=f"{exc!s:.200}",
+                        )
+                        shard_dd = False
+                if not shard_dd:
+                    dd = dedisperse if spill else dedisperse_device
+                    trials = dd(
+                        fil.data if spill else fil_to_device(fil),
+                        dm_plan.delay_samples(),
+                        dm_plan.killmask,
+                        dm_plan.out_nsamps,
+                        scale=scale,
+                        block=cfg.dedisp_block,
+                    )
+                if not spill:
+                    jax.block_until_ready(trials)
+        timers["dedispersion"] = time.perf_counter() - t0
+        tel.capture_device_memory("dedispersion")
+
+        # --- device waves over the DM axis -----------------------------
+        t0 = time.perf_counter()
+        tel.set_stage("searching")
+        nsamps = dm_plan.out_nsamps
+        tpad, span = plan_pad(nsamps)
+        pallas_span = 0
+        if cfg.use_pallas:
+            from ..ops.pallas import probe_pallas_boxcar
+
+            if probe_pallas_boxcar(len(widths), span):
+                pallas_span = span
+        self._pallas_span = pallas_span
+        sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            sharding = NamedSharding(mesh, PartitionSpec("dm"))
+
+        per_dm: dict[int, tuple] = restored
+        if per_dm and not skip_dedisp:
+            log.info(
+                "Resuming: %d/%d DM trials restored from %s",
+                len(per_dm), dm_plan.ndm, cfg.checkpoint_file,
+            )
+            tel.event(
+                "sp_checkpoint_resume", restored=len(per_dm),
+                ndm=int(dm_plan.ndm),
+            )
+
+        # auto block: ~4 f32 planes of tpad per trial (norm, csum,
+        # best, argw) with 4x headroom; mesh runs round up to a
+        # devices multiple so every chip gets equal rows
+        if cfg.dm_block > 0:
+            dm_block = cfg.dm_block
+        else:
+            per_trial = 16 * tpad
+            dm_block = int(
+                max(1, min(256, (self.TOTAL_HBM // 4) // max(1, per_trial)))
+            )
+        n_dev = len(devices)
+        if n_dev > 1:
+            dm_block = max(n_dev, -(-dm_block // n_dev) * n_dev)
+
+        shrink = 1
+        while True:
+            blk = max(
+                n_dev if n_dev > 1 else 1, dm_block // shrink
+            )
+            if n_dev > 1:
+                blk = max(n_dev, -(-blk // n_dev) * n_dev)
+            chunks = [
+                list(range(s, min(s + blk, dm_plan.ndm)))
+                for s in range(0, dm_plan.ndm, blk)
+            ]
+            tel.event(
+                "sp_wave_plan", n_chunks=len(chunks), dm_block=blk,
+                shrink=shrink, pallas_span=pallas_span,
+            )
+            try:
+                self._run_waves(
+                    chunks, blk, trials, per_dm, ckpt, widths,
+                    sharding=sharding, spill=spill,
+                )
+                break
+            except Exception as exc:
+                if not _is_oom(exc) or blk <= max(1, n_dev):
+                    raise
+                shrink *= 2
+                log.warning(
+                    "device OOM at dm_block=%d; retrying with "
+                    "dm_block=%d: %.200s", blk, max(1, dm_block // shrink),
+                    exc,
+                )
+                tel.event(
+                    "sp_oom_shrink_retry", dm_block_old=blk,
+                    shrink=shrink, error=f"{exc!s:.200}",
+                )
+        timers["searching"] = time.perf_counter() - t0
+        tel.capture_device_memory("search")
+
+        # --- host clustering -------------------------------------------
+        t0 = time.perf_counter()
+        tel.set_stage("clustering")
+        recs = []
+        n_overflowed = 0
+        for dm_idx in range(dm_plan.ndm):
+            pos_w, snrs, count = per_dm[dm_idx]
+            c = int(np.asarray(count))
+            k = min(c, len(snrs))
+            if c > len(snrs):
+                n_overflowed += 1
+            for i in range(k):
+                recs.append(
+                    (dm_idx, int(pos_w[0, i]), int(pos_w[1, i]),
+                     float(snrs[i]))
+                )
+        events = np.asarray(recs, dtype=_EVENT_DTYPE)
+        if n_overflowed:
+            log.warning(
+                "%d DM trials overflowed the %d-event compaction; "
+                "keeping the first %d (ascending time) per trial",
+                n_overflowed, cfg.max_events, cfg.max_events,
+            )
+            tel.event(
+                "sp_event_overflow", trials=n_overflowed,
+                max_events=cfg.max_events,
+            )
+        clusters = cluster_events_fof(
+            events, widths, time_link=cfg.time_link, dm_link=cfg.dm_link,
+            dec=cfg.decimate,
+        )
+        cands = SinglePulseCandidateCollection()
+        w_arr = np.asarray(widths, dtype=np.int64)
+        for members in clusters:
+            ev = events[members]
+            peak = int(np.argmax(ev["snr"]))
+            widx = int(ev["width_idx"][peak])
+            cands.append(
+                [
+                    SinglePulseCandidate(
+                        dm=float(dm_plan.dm_list[int(ev["dm_idx"][peak])]),
+                        dm_idx=int(ev["dm_idx"][peak]),
+                        snr=float(ev["snr"][peak]),
+                        time_s=float(ev["sample"][peak]) * fil.tsamp,
+                        sample=int(ev["sample"][peak]),
+                        width=int(w_arr[widx]),
+                        width_idx=widx,
+                        members=len(members),
+                        dm_idx_lo=int(ev["dm_idx"].min()),
+                        dm_idx_hi=int(ev["dm_idx"].max()),
+                        sample_lo=int(ev["sample"].min()),
+                        sample_hi=int(ev["sample"].max()),
+                        width_lo=int(w_arr[ev["width_idx"]].min()),
+                        width_hi=int(w_arr[ev["width_idx"]].max()),
+                    )
+                ]
+            )
+        out = sorted(cands, key=lambda c: -c.snr)[: cfg.limit]
+        timers["clustering"] = time.perf_counter() - t0
+        timers["total"] = time.perf_counter() - t_total
+        tel.gauge("sp.n_events", len(events))
+        tel.gauge("sp.n_clusters", len(clusters))
+        tel.gauge("candidates.final", len(out))
+        log.info(
+            "single-pulse search: %d events -> %d clusters -> %d "
+            "candidates", len(events), len(clusters), len(out),
+        )
+        return SinglePulseResult(
+            candidates=out,
+            dm_list=dm_plan.dm_list,
+            widths=widths,
+            timers=timers,
+            nsamps=fil.nsamps,
+            n_events=len(events),
+            n_overflowed=n_overflowed,
+        )
+
+    def _run_waves(
+        self, chunks, blk, trials, per_dm, ckpt, widths, *, sharding, spill
+    ) -> None:
+        cfg = self.config
+        tel = current_telemetry()
+        progress = ProgressBar() if cfg.progress_bar else None
+        if progress:
+            progress.start()
+        search_fn = make_single_pulse_search_fn(
+            widths, float(cfg.min_snr), cfg.max_events, cfg.decimate,
+            self._pallas_span,
+        )
+        tel.set_progress(0, len(chunks), unit="chunks")
+        try:
+            for ci, chunk in enumerate(chunks):
+                if all(d in per_dm for d in chunk):
+                    tel.set_progress(ci + 1, len(chunks), unit="chunks")
+                    continue
+                lo, hi = chunk[0], chunk[-1] + 1
+                with trace_span("SP-Chunk"):
+                    block = trials[lo:hi]
+                    if spill:
+                        block = jnp.asarray(block)
+                    pad = blk - (hi - lo)
+                    if pad:
+                        block = jnp.concatenate(
+                            [block, jnp.zeros((pad, block.shape[1]),
+                                              block.dtype)]
+                        )
+                    if sharding is not None:
+                        block = jax.device_put(block, sharding)
+                    samples, widx, snrs, counts = search_fn(block)
+                    # one packed fetch per wave (tiny arrays)
+                    samples = np.asarray(samples)
+                    widx = np.asarray(widx)
+                    snrs = np.asarray(snrs)
+                    counts = np.asarray(counts)
+                for j, dm_idx in enumerate(chunk):
+                    per_dm[dm_idx] = (
+                        np.stack([samples[j], widx[j]]).astype(np.int32),
+                        snrs[j].astype(np.float32),
+                        np.int32(counts[j]),
+                    )
+                if ckpt is not None:
+                    ckpt.save(per_dm)
+                tel.set_progress(ci + 1, len(chunks), unit="chunks")
+                if progress:
+                    progress.update((ci + 1) / len(chunks))
+        finally:
+            if progress:
+                progress.stop()

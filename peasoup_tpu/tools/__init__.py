@@ -1,1 +1,1 @@
-from .parsers import OverviewFile, CandidateFileParser
+from .parsers import OverviewFile, CandidateFileParser, read_singlepulse

@@ -34,8 +34,58 @@ CAND_FIELDS = [
 ]
 
 
+# single-pulse candidate fields (io/output.py SINGLEPULSE_COLUMNS
+# minus the time/snr formatting): one row per cluster
+SP_CAND_FIELDS = [
+    ("dm", "f4"),
+    ("snr", "f4"),
+    ("time_s", "f8"),
+    ("sample", "i8"),
+    ("width", "i4"),
+    ("width_idx", "i4"),
+    ("dm_idx", "i4"),
+    ("members", "i4"),
+    ("sample_lo", "i8"),
+    ("sample_hi", "i8"),
+    ("dm_idx_lo", "i4"),
+    ("dm_idx_hi", "i4"),
+    ("width_lo", "i4"),
+    ("width_hi", "i4"),
+]
+
+
+def read_singlepulse(path: str) -> np.ndarray:
+    """Parse a ``.singlepulse`` text table (io.output.write_singlepulse)
+    into a recarray with SP_CAND_FIELDS. The '#' header row names the
+    columns, so extra/reordered columns from newer writers parse by
+    NAME (missing fields default to 0)."""
+    with open(path, "r", encoding="ascii") as f:
+        lines = [ln.strip() for ln in f if ln.strip()]
+    names = None
+    rows = []
+    for ln in lines:
+        if ln.startswith("#"):
+            if names is None:
+                names = ln.lstrip("# ").split()
+            continue
+        rows.append(ln.split())
+    if names is None:
+        names = [fname for fname, _ in SP_CAND_FIELDS]
+    out = np.zeros(len(rows), dtype=SP_CAND_FIELDS)
+    col_of = {n: i for i, n in enumerate(names)}
+    for fname, ftype in SP_CAND_FIELDS:
+        ci = col_of.get(fname)
+        if ci is None:
+            continue
+        vals = [r[ci] if ci < len(r) else 0 for r in rows]
+        out[fname] = np.asarray(vals, dtype=np.dtype(ftype))
+    return out
+
+
 class OverviewFile:
-    """Parse overview.xml into header/search dicts + candidate recarray."""
+    """Parse overview.xml into header/search dicts + candidate recarray
+    (plus, when a <single_pulse_search> section is present, the
+    single-pulse width list and candidate recarray)."""
 
     def __init__(self, path: str):
         with open(path, "rb") as f:
@@ -57,6 +107,37 @@ class OverviewFile:
             [float(t.text) for t in self.root.findall("acceleration_trials/trial")]
         )
         self.candidates = self._parse_candidates()
+        self.sp_parameters = self._sp_section_dict("search_parameters")
+        self.sp_widths = np.array(
+            [
+                int(t.text)
+                for t in self.root.findall(
+                    "single_pulse_search/width_trials/trial"
+                )
+            ],
+            dtype=np.int64,
+        )
+        self.sp_candidates = self._parse_sp_candidates()
+
+    def _sp_section_dict(self, name: str) -> dict:
+        node = self.root.find(f"single_pulse_search/{name}")
+        if node is None:
+            return {}
+        return {child.tag: (child.text or "") for child in node}
+
+    def _parse_sp_candidates(self) -> np.ndarray:
+        rows = []
+        for cand in self.root.findall(
+            "single_pulse_search/candidates/candidate"
+        ):
+            vals = {c.tag: c.text for c in cand}
+            rows.append(
+                tuple(
+                    np.dtype(ftype).type(vals.get(fname, 0) or 0)
+                    for fname, ftype in SP_CAND_FIELDS
+                )
+            )
+        return np.array(rows, dtype=SP_CAND_FIELDS)
 
     def _section_dict(self, name: str) -> dict:
         node = self.root.find(name)

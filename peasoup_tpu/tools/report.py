@@ -248,21 +248,31 @@ def merge_manifests(shards: list[dict]) -> dict:
     )
     hosts = []
     for man in shards:
-        hosts.append(
-            {
-                "process_index": man.get(
-                    "process_index",
-                    (man.get("platform") or {}).get("process_index", 0),
-                ),
-                "hostname": man.get("hostname", "?"),
-                "pid": man.get("pid"),
-                "run_id": man.get("run_id", "?"),
-                "duration_s": float(man.get("duration_s", 0.0)),
-                "aborted": bool(man.get("aborted", False)),
-                "n_events": len(man.get("events") or []),
-                "timers": man.get("timers") or {},
-            }
-        )
+        # keep only numeric timers: a malformed shard value must not
+        # poison the straggler math or the merged manifest's schema
+        timers = {
+            k: v
+            for k, v in (man.get("timers") or {}).items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+        }
+        host = {
+            "process_index": man.get(
+                "process_index",
+                (man.get("platform") or {}).get("process_index", 0),
+            ),
+            "hostname": man.get("hostname", "?"),
+            "pid": man.get("pid"),
+            "run_id": man.get("run_id", "?"),
+            "aborted": bool(man.get("aborted", False)),
+            "n_events": len(man.get("events") or []),
+            "timers": timers,
+        }
+        # duration is OPTIONAL: an aborted/partial shard without one
+        # must not enter the imbalance ranking as a phantom 0.0 s
+        # "fastest host"
+        if isinstance(man.get("duration_s"), (int, float)):
+            host["duration_s"] = float(man["duration_s"])
+        hosts.append(host)
 
     def _host_ref(h: dict) -> dict:
         return {
@@ -274,9 +284,19 @@ def merge_manifests(shards: list[dict]) -> dict:
     straggler_timers: dict[str, dict] = {}
     merged_timers: dict[str, float] = {}
     for k in timer_keys:
+        # a shard can be missing a stage entirely (aborted before
+        # reaching it, older writer, partial manifest) or carry a
+        # non-numeric value: SKIP those hosts rather than KeyError or
+        # rank a phantom 0.0 as the fastest host; the entry records who
+        # was missing so the straggler view stays honest
         vals = [
-            (h["timers"][k], h) for h in hosts if k in h["timers"]
+            (h["timers"][k], h)
+            for h in hosts
+            if isinstance(h["timers"].get(k), (int, float))
+            and not isinstance(h["timers"].get(k), bool)
         ]
+        if not vals:
+            continue
         vmin, vmax = (
             min(v for v, _ in vals),
             max(v for v, _ in vals),
@@ -294,6 +314,11 @@ def merge_manifests(shards: list[dict]) -> dict:
                 "n_hosts": len(vals),
                 "slowest": _host_ref(slowest),
             }
+            if len(vals) < len(hosts):
+                present = {id(h) for _, h in vals}
+                straggler_timers[k]["missing"] = [
+                    _host_ref(h) for h in hosts if id(h) not in present
+                ]
 
     counters: dict[str, float] = {}
     gauges: dict[str, float] = {}
@@ -309,10 +334,16 @@ def merge_manifests(shards: list[dict]) -> dict:
             events.append({**rec, "process_index": h["process_index"]})
     events.sort(key=lambda r: r.get("t", 0.0))
 
-    durations = [(h["duration_s"], h) for h in hosts]
-    dmax = max(v for v, _ in durations)
-    dmean = sum(v for v, _ in durations) / len(durations)
-    slowest_host = max(durations, key=lambda vh: vh[0])[1]
+    durations = [
+        (h["duration_s"], h) for h in hosts if "duration_s" in h
+    ]
+    if durations:
+        dmax = max(v for v, _ in durations)
+        dmean = sum(v for v, _ in durations) / len(durations)
+        slowest_host = max(durations, key=lambda vh: vh[0])[1]
+    else:  # every shard partial: no imbalance ranking to compute
+        dmax = dmean = 0.0
+        slowest_host = hosts[0]
 
     merged = {
         "schema": MANIFEST_SCHEMA,

@@ -399,6 +399,74 @@ def test_report_merge_straggler_stats(tmp_path, capsys):
     assert "hosts (2)" in capsys.readouterr().out
 
 
+def test_report_merge_skips_shards_missing_a_stage(tmp_path):
+    """Satellite regression: a shard that never reached a stage
+    (aborted early, older writer, partial manifest) is SKIPPED in that
+    stage's straggler entry — no KeyError, no phantom 0.0 ranked as
+    the fastest host — and recorded as missing; a shard without
+    duration_s stays out of the imbalance ranking; non-numeric timer
+    values are dropped rather than poisoning the math."""
+    from peasoup_tpu.tools.report import merge_manifests
+
+    a = json.loads(open(_shard(
+        tmp_path, 0, "host-a",
+        {"searching": 10.0, "dedispersion": 2.0})).read())
+    b = json.loads(open(_shard(
+        tmp_path, 1, "host-b",
+        {"searching": 14.0, "dedispersion": 2.5})).read())
+    c = json.loads(open(_shard(
+        tmp_path, 2, "host-c",
+        {"dedispersion": 1.0})).read())
+    # host-c aborted before the searching stage: no timer, no duration,
+    # and one corrupted timer value
+    del c["duration_s"]
+    c["timers"]["plan"] = "corrupt"
+    c["aborted"] = True
+
+    merged = merge_manifests([a, b, c])
+    obs.validate_manifest(merged)
+
+    strag = merged["straggler"]["timers"]["searching"]
+    assert strag["n_hosts"] == 2
+    assert strag["min"] == 10.0  # NOT 0.0 from the missing shard
+    assert strag["slowest"] == {"process_index": 1, "hostname": "host-b"}
+    assert strag["missing"] == [
+        {"process_index": 2, "hostname": "host-c"}
+    ]
+    # all three hosts carry dedispersion: no missing list there
+    ded = merged["straggler"]["timers"]["dedispersion"]
+    assert ded["n_hosts"] == 3 and "missing" not in ded
+    # the corrupt value neither crashes nor appears anywhere
+    assert "plan" not in merged["timers"]
+    assert "plan" not in merged["hosts"][2]["timers"]
+    # imbalance ranks only hosts that reported a duration
+    imb = merged["straggler"]["imbalance"]
+    assert imb["slowest"]["hostname"] == "host-b"
+    assert imb["mean_s"] == pytest.approx((11.0 + 15.0) / 2)
+    # the merged manifest still renders
+    from peasoup_tpu.tools.report import render
+
+    assert "host-c" in render(merged)
+
+
+def test_report_merge_all_shards_partial(tmp_path):
+    """Degenerate hardening case: EVERY shard lacks duration_s — the
+    merge must still succeed with a zeroed imbalance block."""
+    from peasoup_tpu.tools.report import merge_manifests
+
+    shards = []
+    for i in range(2):
+        man = json.loads(
+            open(_shard(tmp_path, i, f"h{i}", {"plan": 0.1 * (i + 1)})).read()
+        )
+        del man["duration_s"]
+        shards.append(man)
+    merged = merge_manifests(shards)
+    obs.validate_manifest(merged)
+    assert merged["straggler"]["imbalance"]["ratio"] == 1.0
+    assert merged["straggler"]["timers"]["plan"]["n_hosts"] == 2
+
+
 def test_report_merge_needs_two_shards(tmp_path):
     from peasoup_tpu.tools.report import main as report_main
 

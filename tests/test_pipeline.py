@@ -503,3 +503,54 @@ class TestAccelDedupe:
                 if np.rint(af * quad).any():
                     fired = True
         assert fired, "expected a non-identity equivalence class"
+
+
+class TestCheckpointProcessCount:
+    def test_checkpoint_process_count_independent(self, tmp_path):
+        """Satellite (documented contract in pipeline/checkpoint.py):
+        trials completed under one process count resume under ANY
+        other. Complete all trials under 2-way slicing, reload under
+        1-way, and assert the union reuses every completed trial —
+        then re-slice 3 ways and check each slice sees exactly its
+        own trials with local keys."""
+        from peasoup_tpu.parallel.multihost import dm_slice_for_process
+        from peasoup_tpu.pipeline.checkpoint import SearchCheckpoint
+
+        base = str(tmp_path / "search.ckpt")
+        key = "config-key-A"
+        ndm = 7
+
+        def payload(g):
+            return (
+                np.full((2, 4), g, dtype=np.int32),
+                np.full((4,), 0.5 * g, dtype=np.float32),
+                np.asarray(g, dtype=np.int32),
+            )
+
+        # complete every trial under 2-way slicing: each process
+        # writes its own .dmLO-HI sibling with LOCAL keys
+        for pid in range(2):
+            lo, hi = dm_slice_for_process(ndm, 2, pid)
+            ck = SearchCheckpoint(base, key, slice_bounds=(lo, hi))
+            ck.save({g - lo: payload(g) for g in range(lo, hi)})
+
+        # reload under 1-way: the union must reuse every trial
+        restored = SearchCheckpoint(base, key).load()
+        assert sorted(restored) == list(range(ndm))
+        for g in range(ndm):
+            idxs, snrs, counts = restored[g]
+            assert idxs[0, 0] == g
+            assert snrs[0] == pytest.approx(0.5 * g)
+            assert int(counts) == g
+
+        # reload under 3-way: each slice sees exactly its trials,
+        # re-keyed locally
+        for pid in range(3):
+            lo, hi = dm_slice_for_process(ndm, 3, pid)
+            part = SearchCheckpoint(base, key, slice_bounds=(lo, hi)).load()
+            assert sorted(k + lo for k in part) == list(range(lo, hi))
+            for k, (idxs, _, _) in part.items():
+                assert idxs[0, 0] == k + lo
+
+        # a different config key restores nothing from any sibling
+        assert SearchCheckpoint(base, "config-key-B").load() == {}

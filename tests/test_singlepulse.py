@@ -1,0 +1,413 @@
+"""Single-pulse search subsystem tests (ops -> pipeline -> CLI -> IO).
+
+Acceptance gates (ISSUE 3): injection recovery with analytic
+matched-filter S/N, one-cluster clustering of a broad pulse, and
+``.singlepulse`` + overview.xml round-trips through the parsers.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from peasoup_tpu.io.sigproc import (
+    Filterbank,
+    SigprocHeader,
+    read_filterbank,
+    write_filterbank,
+)
+from peasoup_tpu.ops.singlepulse import (
+    boxcar_best,
+    boxcar_best_twin,
+    default_widths,
+    make_single_pulse_search_fn,
+    matched_filter_snr,
+    normalise_trials,
+    plan_pad,
+    prefix_sum_padded,
+    width_extent,
+    width_scales,
+)
+from peasoup_tpu.pipeline.single_pulse import (
+    SinglePulseConfig,
+    SinglePulseSearch,
+    cluster_events_fof,
+    _EVENT_DTYPE,
+)
+from peasoup_tpu.plan.dm_plan import DMPlan
+
+
+# --------------------------------------------------------------------------
+# device ops
+# --------------------------------------------------------------------------
+
+class TestBoxcarOps:
+    def test_best_plane_matches_bruteforce(self, rng):
+        x = rng.normal(size=(3, 3000)).astype(np.float32)
+        x[1, 700:716] += 6.0
+        widths = default_widths(6)
+        norm = np.asarray(normalise_trials(jnp.asarray(x)))
+        best, bw = boxcar_best(jnp.asarray(norm), widths)
+        best, bw = np.asarray(best), np.asarray(bw)
+        t = x.shape[1]
+        for d in range(x.shape[0]):
+            planes = np.full((len(widths), t), -np.inf)
+            for k, w in enumerate(widths):
+                conv = np.convolve(norm[d], np.ones(w), "valid")
+                planes[k, : t - w + 1] = conv * (1.0 / np.sqrt(w)).astype(
+                    np.float32
+                )
+            ref_best = planes.max(axis=0)
+            ref_w = planes.argmax(axis=0)
+            assert np.allclose(best[d, :t], ref_best, rtol=2e-5, atol=2e-5)
+            # argmax ties broken identically off-noise is not guaranteed
+            # by float assoc; check where the margin is clear
+            margin = np.partition(planes, -2, axis=0)
+            clear = ref_best - margin[-2] > 1e-3
+            assert np.array_equal(bw[d, :t][clear], ref_w[clear])
+
+    def test_validity_tail_is_masked(self, rng):
+        x = rng.normal(size=(1, 1500)).astype(np.float32)
+        widths = (1, 4, 16)
+        best, bw = map(np.asarray, boxcar_best(jnp.asarray(x), widths))
+        t = 1500
+        # a boxcar starting past t - w must never win: the last 15
+        # samples can only carry widths whose window still fits
+        for j in range(t - 16, t):
+            wsel = widths[bw[0, j]]
+            assert j + wsel <= t
+        # padded region (t..tpad) is all -inf
+        assert np.all(np.isneginf(best[0, t:]))
+
+    def test_normalise_is_zero_mean_unit_std(self, rng):
+        x = (rng.normal(40.0, 5.0, size=(4, 8192))).astype(np.float32)
+        n = np.asarray(normalise_trials(jnp.asarray(x)))
+        assert np.abs(n.mean(axis=1)).max() < 0.05
+        assert np.abs(n.std(axis=1) - 1.0).max() < 0.05
+
+    def test_normalise_resists_bright_pulse(self, rng):
+        x = rng.normal(0.0, 1.0, size=(1, 8192)).astype(np.float32)
+        y = x.copy()
+        y[0, 100:160] += 50.0  # would inflate a naive std by ~4x
+        nx = np.asarray(normalise_trials(jnp.asarray(x)))
+        ny = np.asarray(normalise_trials(jnp.asarray(y)))
+        # the clipped re-estimate must keep the noise scale unchanged
+        assert np.allclose(nx[0, 200:], ny[0, 200:], atol=0.05)
+
+    def test_search_fn_finds_pulse_at_exact_sample(self, rng):
+        x = rng.normal(size=(2, 6000)).astype(np.float32)
+        t0, w, amp = 2500, 8, 6.0
+        x[1, t0 : t0 + w] += amp
+        widths = default_widths(6)
+        fn = make_single_pulse_search_fn(widths, 6.0, 64, 32, 0)
+        samples, widx, snrs, counts = map(np.asarray, fn(jnp.asarray(x)))
+        assert counts[0] == 0
+        assert counts[1] >= 1
+        k = np.argmax(snrs[1])
+        assert abs(int(samples[1, k]) - t0) <= 1
+        assert widths[int(widx[1, k])] == w
+        # the matched filter integrates the window's noise too: one
+        # realization scatters by ~N(0, 1) around the expectation
+        exp = matched_filter_snr(amp, w, 1.0)
+        assert abs(float(snrs[1, k]) - exp) < 3.5
+
+
+class TestPallasBoxcar:
+    """Interpret-mode kernel vs the jnp twin: BITWISE (the same gate
+    probe_pallas_boxcar applies on real TPU toolchains)."""
+
+    @pytest.mark.parametrize("t,nw", [(5000, 8), (20000, 11)])
+    def test_bitwise_vs_twin(self, rng, t, nw):
+        from peasoup_tpu.ops.pallas.boxcar import boxcar_best_pallas
+
+        x = rng.normal(size=(3, t)).astype(np.float32)
+        x[0, t // 2 : t // 2 + 12] += 20.0
+        widths = default_widths(nw)
+        tpad, span = plan_pad(t)
+        wext = width_extent(widths)
+        norm = normalise_trials(jnp.asarray(x))
+        csum = prefix_sum_padded(norm, tpad, wext)
+        scales = width_scales(widths)
+        gb, gw = boxcar_best_pallas(
+            csum, widths, scales, t, tpad, span=span, interpret=True
+        )
+        rb, rw = boxcar_best_twin(csum, widths, scales, t, tpad)
+        assert np.array_equal(np.asarray(gb), np.asarray(rb))
+        assert np.array_equal(np.asarray(gw), np.asarray(rw))
+
+    def test_geometry_guard(self, rng):
+        from peasoup_tpu.ops.pallas.boxcar import boxcar_best_pallas
+
+        widths = default_widths(4)
+        csum = jnp.zeros((1, 2048 + 1024), jnp.float32)
+        with pytest.raises(ValueError):
+            boxcar_best_pallas(
+                csum, widths, width_scales(widths), 2000, 2048, span=999,
+                interpret=True,
+            )
+
+
+# --------------------------------------------------------------------------
+# friends-of-friends clustering
+# --------------------------------------------------------------------------
+
+class TestClustering:
+    def test_links_width_ladder_and_dm_chain(self):
+        widths = (1, 2, 4, 8, 16, 32, 64)
+        # one pulse seen at 3 DM trials, several widths, nearby samples
+        rows = [
+            (10, 5000, 3, 12.0), (10, 4996, 4, 10.0), (11, 5001, 3, 11.0),
+            (12, 5002, 3, 9.0), (11, 4970, 6, 8.0),
+            # and a second, unrelated pulse far away in time
+            (10, 9000, 0, 7.5),
+        ]
+        ev = np.asarray(rows, dtype=_EVENT_DTYPE)
+        clusters = cluster_events_fof(ev, widths, dm_link=2, dec=32)
+        sizes = sorted(len(c) for c in clusters)
+        assert sizes == [1, 5]
+
+    def test_dm_gap_splits(self):
+        widths = (1, 2, 4)
+        rows = [(0, 100, 0, 8.0), (10, 100, 0, 8.0)]
+        ev = np.asarray(rows, dtype=_EVENT_DTYPE)
+        clusters = cluster_events_fof(ev, widths, dm_link=2, dec=0)
+        assert len(clusters) == 2
+
+    def test_empty(self):
+        ev = np.asarray([], dtype=_EVENT_DTYPE)
+        assert cluster_events_fof(ev, (1, 2)) == []
+
+
+# --------------------------------------------------------------------------
+# pipeline-level: synthetic injections
+# --------------------------------------------------------------------------
+
+def make_sp_fil(
+    tmp_path,
+    nsamps=1 << 15,
+    nchans=16,
+    tsamp=0.000256,
+    fch1=1400.0,
+    foff=-8.0,
+    dm_end=60.0,
+    t0=9000,
+    width=8,
+    amp=9.0,
+    seed=3,
+    name="sp.fil",
+):
+    """8-bit filterbank with one dispersed top-hat pulse injected with
+    the search's OWN delay table at the middle DM trial, so the
+    analytic matched-filter S/N applies exactly at that trial."""
+    plan = DMPlan.create(
+        nsamps=nsamps, nchans=nchans, tsamp=tsamp, fch1=fch1, foff=foff,
+        dm_start=0.0, dm_end=dm_end, pulse_width=64.0, tol=1.10,
+    )
+    idx = plan.ndm // 2
+    delays = plan.delay_samples()[idx]
+    rng = np.random.default_rng(seed)
+    data = rng.normal(32.0, 4.0, size=(nsamps, nchans))
+    for c in range(nchans):
+        lo = t0 + delays[c]
+        data[lo : lo + width, c] += amp
+    data = np.clip(np.rint(data), 0, 255).astype(np.uint8)
+    hdr = SigprocHeader(
+        source_name="SPFAKE", tsamp=tsamp, tstart=55000.0, fch1=fch1,
+        foff=foff, nchans=nchans, nbits=8, nifs=1, data_type=1,
+    )
+    path = tmp_path / name
+    write_filterbank(path, Filterbank(header=hdr, data=data))
+    return path, plan, idx
+
+
+class TestInjectionRecovery:
+    def test_recovers_injected_pulse(self, tmp_path):
+        """ISSUE acceptance: right DM trial, right time sample, width
+        within one log-spaced step, S/N within 10% of the analytic
+        matched-filter expectation."""
+        nchans, width, amp = 16, 8, 9.0
+        t0 = 9000
+        path, plan, idx = make_sp_fil(
+            tmp_path, nchans=nchans, width=width, amp=amp, t0=t0
+        )
+        fil = read_filterbank(path)
+        cfg = SinglePulseConfig(dm_end=60.0, min_snr=7.0, n_widths=8)
+        res = SinglePulseSearch(cfg).run(fil)
+        assert len(res.candidates) >= 1
+        top = res.candidates[0]
+        assert top.dm_idx == idx
+        assert abs(top.sample - t0) <= 2
+        # detected width within one octave step of the injected width
+        k_true = int(np.log2(width))
+        assert abs(top.width_idx - k_true) <= 1
+        # analytic matched filter: the dedispersed trial sums nchans
+        # channels (noise std 4 each -> 16) and scales by
+        # output_scale(8, 16) = 1/16, so sigma = 1.0 and the summed
+        # pulse amplitude is nchans * amp / 16
+        exp = matched_filter_snr(nchans * amp * (1.0 / 16.0), width, 1.0)
+        assert abs(top.snr / exp - 1.0) < 0.10
+
+    def test_broad_pulse_is_one_cluster_and_roundtrips(self, tmp_path):
+        """ISSUE acceptance: ONE candidate cluster for a broad pulse
+        (not one per width/DM trial), and the .singlepulse table + XML
+        section round-trip through the parsers."""
+        from peasoup_tpu.io.output import (
+            OutputFileWriter,
+            write_singlepulse,
+        )
+        from peasoup_tpu.tools.parsers import OverviewFile, read_singlepulse
+
+        width = 64
+        path, plan, idx = make_sp_fil(
+            tmp_path, width=width, amp=4.0, t0=8000, name="broad.fil"
+        )
+        fil = read_filterbank(path)
+        cfg = SinglePulseConfig(dm_end=60.0, min_snr=7.0, n_widths=10)
+        res = SinglePulseSearch(cfg).run(fil)
+        assert res.n_events > 1  # the pulse fired many (trial, width) cells
+        assert len(res.candidates) == 1
+        top = res.candidates[0]
+        assert abs(top.width_idx - int(np.log2(width))) <= 1
+        assert top.members > 1
+        assert top.sample_lo <= top.sample <= top.sample_hi
+        assert top.dm_idx_lo <= top.dm_idx <= top.dm_idx_hi
+
+        # round-trip: text table
+        table_path = str(tmp_path / "cands.singlepulse")
+        write_singlepulse(table_path, res.candidates)
+        tab = read_singlepulse(table_path)
+        assert len(tab) == 1
+        assert int(tab["sample"][0]) == top.sample
+        assert int(tab["width"][0]) == top.width
+        assert tab["snr"][0] == pytest.approx(top.snr, rel=1e-4)
+        assert tab["dm"][0] == pytest.approx(top.dm, rel=1e-5)
+        assert int(tab["members"][0]) == top.members
+
+        # round-trip: overview.xml single-pulse section
+        w = OutputFileWriter()
+        w.add_misc_info()
+        w.add_header(fil.header)
+        w.add_dm_list(res.dm_list)
+        w.add_single_pulse_section(cfg, str(path), res.widths, res.candidates)
+        w.add_timing_info(res.timers)
+        xml_path = str(tmp_path / "overview.xml")
+        w.to_file(xml_path)
+        ov = OverviewFile(xml_path)
+        assert list(ov.sp_widths) == [int(x) for x in res.widths]
+        assert len(ov.sp_candidates) == 1
+        row = ov.sp_candidates[0]
+        assert int(row["sample"]) == top.sample
+        assert int(row["width"]) == top.width
+        assert row["snr"] == pytest.approx(top.snr, rel=1e-4)
+        assert float(ov.sp_parameters["min_snr"]) == cfg.min_snr
+        # the periodicity candidate table stays empty/absent — the two
+        # sections are disjoint
+        assert len(ov.candidates) == 0
+
+    def test_checkpoint_resume_reuses_trials(self, tmp_path):
+        path, plan, idx = make_sp_fil(tmp_path, name="ck.fil")
+        fil = read_filterbank(path)
+        ck = str(tmp_path / "sp.ckpt")
+        cfg = SinglePulseConfig(
+            dm_end=60.0, min_snr=7.0, n_widths=8, checkpoint_file=ck
+        )
+        res1 = SinglePulseSearch(cfg).run(fil)
+        assert os.path.exists(ck)
+
+        # resume: every trial restores; the dedispersion stage is
+        # skipped entirely (the resume fast path) and the candidate
+        # list is identical
+        res2 = SinglePulseSearch(cfg).run(fil)
+        assert res2.timers["dedispersion"] < res1.timers["dedispersion"]
+        assert len(res2.candidates) == len(res1.candidates)
+        for a, b in zip(res1.candidates, res2.candidates):
+            assert (a.dm_idx, a.sample, a.width, a.members) == (
+                b.dm_idx, b.sample, b.width, b.members
+            )
+            assert a.snr == pytest.approx(b.snr)
+
+        # a config that changes per-trial results invalidates the key
+        cfg3 = SinglePulseConfig(
+            dm_end=60.0, min_snr=8.5, n_widths=8, checkpoint_file=ck
+        )
+        from peasoup_tpu.pipeline.single_pulse import make_checkpoint_key
+
+        k1 = make_checkpoint_key(
+            cfg, fil, plan.ndm, SinglePulseSearch(cfg).widths_for(1024)
+        )
+        k3 = make_checkpoint_key(
+            cfg3, fil, plan.ndm, SinglePulseSearch(cfg3).widths_for(1024)
+        )
+        assert k1 != k3
+
+    def test_sharded_matches_single_device(self, tmp_path):
+        """The 'dm' mesh path (virtual CPU devices) must reproduce the
+        single-device candidate list."""
+        path, plan, idx = make_sp_fil(tmp_path, name="mesh.fil")
+        fil = read_filterbank(path)
+        base = dict(dm_end=60.0, min_snr=7.0, n_widths=8)
+        r1 = SinglePulseSearch(SinglePulseConfig(**base)).run(fil)
+        r2 = SinglePulseSearch(
+            SinglePulseConfig(**base, shard_devices=2)
+        ).run(fil)
+        key = lambda r: [
+            (c.dm_idx, c.sample, c.width, round(c.snr, 4))
+            for c in r.candidates
+        ]
+        assert key(r1) == key(r2)
+
+
+# --------------------------------------------------------------------------
+# CLI
+# --------------------------------------------------------------------------
+
+class TestSpsearchCLI:
+    def test_end_to_end(self, tmp_path):
+        from peasoup_tpu.cli.spsearch import main as sp_main
+        from peasoup_tpu.obs.schema import validate_manifest
+        from peasoup_tpu.obs.telemetry import load_manifest
+        from peasoup_tpu.tools.parsers import OverviewFile, read_singlepulse
+
+        path, plan, idx = make_sp_fil(tmp_path, name="cli.fil")
+        outdir = tmp_path / "out"
+        rc = sp_main(
+            [
+                "-i", str(path), "-o", str(outdir), "--dm_end", "60",
+                "-m", "7", "--n_widths", "8",
+                "--status-json", str(outdir / "status.json"),
+            ]
+        )
+        assert rc == 0
+        tab = read_singlepulse(str(outdir / "candidates.singlepulse"))
+        assert len(tab) >= 1
+        assert int(tab["dm_idx"][0]) == idx
+        ov = OverviewFile(str(outdir / "overview.xml"))
+        assert len(ov.sp_candidates) == len(tab)
+        assert "searching" in ov.execution_times
+        assert "clustering" in ov.execution_times
+        man = load_manifest(str(outdir / "telemetry.json"))
+        validate_manifest(man)
+        assert man["context"]["command"] == "spsearch"
+        assert man["gauges"]["sp.n_dm_trials"] == plan.ndm
+        assert man["gauges"]["candidates.written"] == len(tab)
+
+    def test_version_flag(self, capsys):
+        """Satellite: every CLI prints package + JAX version and the
+        active backend."""
+        import peasoup_tpu
+        from peasoup_tpu.cli.coincidencer import build_parser as coin_bp
+        from peasoup_tpu.cli.ffa import build_parser as ffa_bp
+        from peasoup_tpu.cli.peasoup import build_parser as peasoup_bp
+        from peasoup_tpu.cli.spsearch import build_parser as sp_bp
+
+        for bp in (peasoup_bp, ffa_bp, coin_bp, sp_bp):
+            with pytest.raises(SystemExit) as exc:
+                bp().parse_args(["--version"])
+            assert exc.value.code == 0
+            out = capsys.readouterr().out
+            assert peasoup_tpu.__version__ in out
+            assert jax.__version__ in out
+            assert "backend" in out
