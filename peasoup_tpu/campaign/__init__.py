@@ -1,0 +1,44 @@
+"""Campaign orchestration: run the pipelines over MANY observations.
+
+The reference processes one filterbank per invocation; a survey runs
+thousands. This package is the orchestration + aggregation layer that
+survey pipelines (the FAST drift-scan PRESTO pipeline, arXiv:1912.12807;
+the GSP single-pulse pipeline with its candidate database,
+arXiv:2110.12749) show is where throughput and operability are won:
+
+- :mod:`.queue` — a file-backed job queue, safe for many workers on a
+  shared filesystem: atomic claim files, lease expiry + stale-claim
+  reaping (a SIGKILLed worker's job is re-queued), per-job retry with
+  exponential backoff, quarantine after the retry budget.
+- :mod:`.runner` — the long-lived worker loop: orders jobs into shape
+  buckets so consecutive observations hit the in-process jit caches and
+  the persistent XLA compilation cache, runs each job with its own
+  live-observability stack (heartbeat, flight recorder, telemetry
+  manifest under the job dir), and records per-job compile counts so
+  cache reuse is asserted, not assumed.
+- :mod:`.db` — the survey-level candidate database (stdlib sqlite):
+  every completed job's overview.xml / .singlepulse outputs ingested
+  into queryable tables with per-observation provenance.
+- :mod:`.rollup` — the atomically rewritten ``campaign_status.json``
+  aggregating queue depth, running-job heartbeats, throughput/ETA and
+  failure tallies; ``python -m peasoup_tpu.tools.watch`` renders it.
+
+Entry point: ``python -m peasoup_tpu.cli.campaign``.
+"""
+
+from .db import CandidateDB
+from .queue import Claim, Job, JobQueue
+from .rollup import CAMPAIGN_SCHEMA, build_status, write_status
+from .runner import CampaignRunner, load_campaign_config
+
+__all__ = [
+    "CAMPAIGN_SCHEMA",
+    "CandidateDB",
+    "CampaignRunner",
+    "Claim",
+    "Job",
+    "JobQueue",
+    "build_status",
+    "load_campaign_config",
+    "write_status",
+]

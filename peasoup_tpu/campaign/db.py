@@ -1,0 +1,190 @@
+"""Survey-level candidate database (stdlib sqlite).
+
+Per-observation outputs (overview.xml, candidates.singlepulse) are
+files a human reads one at a time; a survey needs the union queryable
+— "every candidate above S/N 9 across all beams at DM 56±1", "which
+observations produced nothing" (the GSP pipeline's candidate database,
+arXiv:2110.12749, is the model). One sqlite file per campaign holds:
+
+- ``observations`` — one row per ingested job: input path, header
+  provenance (source, tstart, tsamp, nchans, nsamps), ingest time.
+- ``candidates`` — one row per candidate with ``kind`` in
+  ``('periodicity', 'single_pulse')``; periodicity rows carry
+  period/acc/harmonic columns, single-pulse rows carry
+  time/width/members columns, both share dm/snr — so survey-wide
+  queries (top-N by S/N, DM histograms) need no UNION.
+
+Ingest is idempotent per job (delete + reinsert under one
+transaction), so re-running ``campaign ingest`` after adding jobs or
+re-processing is safe. Writes from concurrent workers serialise on
+sqlite's own locking (WAL where the filesystem supports it, plus a
+generous busy timeout).
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import time
+
+from ..obs import get_logger
+
+log = get_logger("campaign.db")
+
+DB_FILENAME = "candidates.sqlite"
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS observations (
+    job_id       TEXT PRIMARY KEY,
+    input        TEXT,
+    source_name  TEXT,
+    tstart       REAL,
+    tsamp        REAL,
+    nchans       INTEGER,
+    nsamps       INTEGER,
+    ingested_unix REAL
+);
+CREATE TABLE IF NOT EXISTS candidates (
+    id        INTEGER PRIMARY KEY,
+    job_id    TEXT NOT NULL REFERENCES observations(job_id),
+    kind      TEXT NOT NULL CHECK (kind IN ('periodicity', 'single_pulse')),
+    dm        REAL,
+    snr       REAL,
+    -- periodicity columns
+    period    REAL,
+    opt_period REAL,
+    acc       REAL,
+    nh        INTEGER,
+    folded_snr REAL,
+    -- single-pulse columns
+    time_s    REAL,
+    sample    INTEGER,
+    width     INTEGER,
+    members   INTEGER
+);
+CREATE INDEX IF NOT EXISTS idx_cand_snr ON candidates (kind, snr DESC);
+CREATE INDEX IF NOT EXISTS idx_cand_job ON candidates (job_id);
+CREATE INDEX IF NOT EXISTS idx_cand_dm ON candidates (dm);
+"""
+
+
+class CandidateDB:
+    """The campaign's sqlite candidate store."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        self._conn = sqlite3.connect(path, timeout=30.0)
+        self._conn.row_factory = sqlite3.Row
+        try:
+            self._conn.execute("PRAGMA journal_mode=WAL")
+        except sqlite3.OperationalError:
+            pass  # WAL unsupported on some shared filesystems
+        self._conn.execute("PRAGMA busy_timeout=30000")
+        self._conn.executescript(_SCHEMA)
+        self._conn.commit()
+
+    def close(self) -> None:
+        self._conn.close()
+
+    def __enter__(self) -> "CandidateDB":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # --- ingest -------------------------------------------------------
+    def ingest_job(self, job_id: str, job_dir: str, input_path: str = "") -> dict:
+        """Ingest one completed job's outputs (idempotent: any prior
+        rows for ``job_id`` are replaced in the same transaction).
+        Returns counts of ingested rows per kind."""
+        from ..tools.parsers import OverviewFile
+
+        xml_path = os.path.join(job_dir, "overview.xml")
+        ov = OverviewFile(xml_path)
+        hdr = ov.header
+        counts = {"periodicity": 0, "single_pulse": 0}
+        rows: list[tuple] = []
+        for c in ov.candidates:
+            rows.append(
+                (
+                    job_id, "periodicity", float(c["dm"]), float(c["snr"]),
+                    float(c["period"]), float(c["opt_period"]),
+                    float(c["acc"]), int(c["nh"]), float(c["folded_snr"]),
+                    None, None, None, None,
+                )
+            )
+            counts["periodicity"] += 1
+        for c in ov.sp_candidates:
+            rows.append(
+                (
+                    job_id, "single_pulse", float(c["dm"]), float(c["snr"]),
+                    None, None, None, None, None,
+                    float(c["time_s"]), int(c["sample"]), int(c["width"]),
+                    int(c["members"]),
+                )
+            )
+            counts["single_pulse"] += 1
+        with self._conn:  # one transaction: delete + reinsert
+            self._conn.execute(
+                "DELETE FROM candidates WHERE job_id = ?", (job_id,)
+            )
+            self._conn.execute(
+                "INSERT OR REPLACE INTO observations VALUES (?,?,?,?,?,?,?,?)",
+                (
+                    job_id,
+                    input_path or hdr.get("rawdatafile", ""),
+                    hdr.get("source_name", ""),
+                    float(hdr.get("tstart", 0) or 0),
+                    float(hdr.get("tsamp", 0) or 0),
+                    int(float(hdr.get("nchans", 0) or 0)),
+                    int(float(hdr.get("nsamples", 0) or 0)),
+                    time.time(),
+                ),
+            )
+            self._conn.executemany(
+                "INSERT INTO candidates (job_id, kind, dm, snr, period, "
+                "opt_period, acc, nh, folded_snr, time_s, sample, width, "
+                "members) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                rows,
+            )
+        log.info(
+            "ingested %s: %d periodicity + %d single-pulse candidates",
+            job_id, counts["periodicity"], counts["single_pulse"],
+        )
+        return counts
+
+    # --- queries ------------------------------------------------------
+    def top_candidates(
+        self, kind: str | None = None, limit: int = 20
+    ) -> list[dict]:
+        q = "SELECT c.*, o.source_name FROM candidates c JOIN observations o ON o.job_id = c.job_id"
+        args: list = []
+        if kind:
+            q += " WHERE c.kind = ?"
+            args.append(kind)
+        q += " ORDER BY c.snr DESC LIMIT ?"
+        args.append(int(limit))
+        return [dict(r) for r in self._conn.execute(q, args)]
+
+    def counts(self) -> dict:
+        obs = self._conn.execute(
+            "SELECT COUNT(*) AS n FROM observations"
+        ).fetchone()["n"]
+        by_kind = {
+            r["kind"]: r["n"]
+            for r in self._conn.execute(
+                "SELECT kind, COUNT(*) AS n FROM candidates GROUP BY kind"
+            )
+        }
+        return {"observations": obs, "candidates": by_kind}
+
+    def candidates_for(self, job_id: str) -> list[dict]:
+        return [
+            dict(r)
+            for r in self._conn.execute(
+                "SELECT * FROM candidates WHERE job_id = ? ORDER BY snr DESC",
+                (job_id,),
+            )
+        ]

@@ -1,0 +1,469 @@
+"""File-backed job queue for multi-worker survey campaigns.
+
+No daemon, no database: the queue IS the filesystem, so any number of
+workers on any number of hosts coordinate through a shared campaign
+directory (the standard deployment for survey pipelines on cluster
+filesystems). Every state transition is an atomic filesystem operation:
+
+- **enqueue** — ``O_CREAT|O_EXCL`` of ``queue/jobs/<id>.json``; two
+  workers enqueueing the same manifest collide harmlessly (first wins).
+- **claim** — ``O_CREAT|O_EXCL`` of ``queue/claims/<id>.json`` carrying
+  the worker identity and a lease expiry. Exactly one claimant can win.
+- **renew** — the owner atomically rewrites its claim with a fresh
+  expiry (tmp + ``os.replace``); a live worker never loses its lease.
+- **reap** — anyone may reap an EXPIRED claim (a SIGKILLed worker never
+  releases). The reaper wins an ``os.rename`` race to a private
+  tombstone; the loser gets ``FileNotFoundError`` and walks away. A
+  reaped job counts as one failed attempt and re-queues with backoff.
+- **complete / fail** — the claim holder writes ``queue/done/<id>.json``
+  or updates the job record (attempts, exponential-backoff
+  ``next_eligible_unix``), then releases the claim. After
+  ``max_attempts`` failures the job lands in
+  ``queue/quarantine/<id>.json`` and is never claimed again until an
+  operator re-queues it (``campaign retry``).
+
+Job records are only ever mutated by the current claim holder (or the
+reap winner), so a tmp + ``os.replace`` rewrite needs no further
+locking. States are derived, not stored: a job is *pending* when it has
+no claim/done/quarantine marker and its backoff has elapsed.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import socket
+import tempfile
+import time
+import uuid
+from dataclasses import dataclass, field
+
+from ..obs import get_logger
+
+log = get_logger("campaign.queue")
+
+# terminal + live marker subdirectories under <root>/queue/
+_JOBS = "jobs"
+_CLAIMS = "claims"
+_DONE = "done"
+_QUARANTINE = "quarantine"
+
+
+def _atomic_write_json(path: str, doc: dict) -> None:
+    d = os.path.dirname(path) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def _read_json(path: str) -> dict | None:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None  # gone, mid-replace, or torn: treat as absent
+
+
+def job_id_for(input_path: str) -> str:
+    """Stable job id for an observation: file stem + a short hash of
+    the absolute path, so two workers enqueueing the same manifest
+    derive the same id (enqueue is idempotent) and two files with the
+    same stem in different directories stay distinct."""
+    ap = os.path.abspath(input_path)
+    stem = os.path.splitext(os.path.basename(ap))[0]
+    safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in stem)
+    return f"{safe[:48]}-{hashlib.sha1(ap.encode()).hexdigest()[:8]}"
+
+
+@dataclass
+class Job:
+    """One observation to process. ``config`` holds per-job pipeline
+    overrides (merged over the campaign's); ``bucket`` is the padded
+    shape key the scheduler groups on (None when the header could not
+    be read at enqueue time — the job will fail at run time and walk
+    the normal retry/quarantine path)."""
+
+    job_id: str
+    input: str
+    pipeline: str = "spsearch"
+    config: dict = field(default_factory=dict)
+    bucket: tuple | None = None
+    attempts: int = 0
+    next_eligible_unix: float = 0.0
+    last_error: str | None = None
+    created_unix: float = 0.0
+
+    def to_doc(self) -> dict:
+        return {
+            "job_id": self.job_id,
+            "input": self.input,
+            "pipeline": self.pipeline,
+            "config": self.config,
+            "bucket": list(self.bucket) if self.bucket else None,
+            "attempts": self.attempts,
+            "next_eligible_unix": self.next_eligible_unix,
+            "last_error": self.last_error,
+            "created_unix": self.created_unix,
+        }
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "Job":
+        b = doc.get("bucket")
+        return cls(
+            job_id=doc["job_id"],
+            input=doc.get("input", ""),
+            pipeline=doc.get("pipeline", "spsearch"),
+            config=doc.get("config") or {},
+            bucket=tuple(b) if b else None,
+            attempts=int(doc.get("attempts", 0)),
+            next_eligible_unix=float(doc.get("next_eligible_unix", 0.0)),
+            last_error=doc.get("last_error"),
+            created_unix=float(doc.get("created_unix", 0.0)),
+        )
+
+
+@dataclass
+class Claim:
+    """A held lease on one job. Only its holder may complete/fail the
+    job or rewrite the job record."""
+
+    job: Job
+    worker_id: str
+    expires_unix: float
+    path: str
+
+
+class JobQueue:
+    """The file-backed queue rooted at ``<root>/queue/``."""
+
+    def __init__(
+        self,
+        root: str,
+        lease_s: float = 60.0,
+        max_attempts: int = 3,
+        backoff_base_s: float = 2.0,
+    ) -> None:
+        self.root = os.path.abspath(root)
+        self.qdir = os.path.join(self.root, "queue")
+        self.lease_s = float(lease_s)
+        self.max_attempts = int(max_attempts)
+        self.backoff_base_s = float(backoff_base_s)
+        for sub in (_JOBS, _CLAIMS, _DONE, _QUARANTINE):
+            os.makedirs(os.path.join(self.qdir, sub), exist_ok=True)
+
+    # --- paths --------------------------------------------------------
+    def _p(self, sub: str, job_id: str) -> str:
+        return os.path.join(self.qdir, sub, f"{job_id}.json")
+
+    # --- enqueue ------------------------------------------------------
+    def add_job(self, job: Job) -> bool:
+        """Idempotent enqueue: True when this call created the record,
+        False when the job already exists (any state)."""
+        job.created_unix = job.created_unix or time.time()
+        path = self._p(_JOBS, job.job_id)
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False
+        with os.fdopen(fd, "w") as f:
+            json.dump(job.to_doc(), f, indent=2)
+            f.write("\n")
+        log.debug("enqueued %s (%s)", job.job_id, job.input)
+        return True
+
+    # --- inspection ---------------------------------------------------
+    def job_ids(self) -> list[str]:
+        return sorted(
+            os.path.splitext(n)[0]
+            for n in os.listdir(os.path.join(self.qdir, _JOBS))
+            if n.endswith(".json")
+        )
+
+    def get_job(self, job_id: str) -> Job | None:
+        doc = _read_json(self._p(_JOBS, job_id))
+        return Job.from_doc(doc) if doc else None
+
+    def state(self, job_id: str, now: float | None = None) -> str:
+        """Derived state: done | quarantined | running | stale |
+        backoff | pending | unknown."""
+        now = time.time() if now is None else now
+        if os.path.exists(self._p(_DONE, job_id)):
+            return "done"
+        if os.path.exists(self._p(_QUARANTINE, job_id)):
+            return "quarantined"
+        claim = _read_json(self._p(_CLAIMS, job_id))
+        if claim is not None:
+            return (
+                "running"
+                if float(claim.get("expires_unix", 0)) >= now
+                else "stale"
+            )
+        job = self.get_job(job_id)
+        if job is None:
+            return "unknown"
+        return "backoff" if job.next_eligible_unix > now else "pending"
+
+    def counts(self) -> dict[str, int]:
+        out = {
+            "total": 0, "pending": 0, "backoff": 0, "running": 0,
+            "stale": 0, "done": 0, "quarantined": 0,
+        }
+        now = time.time()
+        for jid in self.job_ids():
+            out["total"] += 1
+            st = self.state(jid, now)
+            if st in out:
+                out[st] += 1
+        return out
+
+    def drained(self) -> bool:
+        """True when every job is terminal (done or quarantined)."""
+        c = self.counts()
+        return c["total"] > 0 and c["done"] + c["quarantined"] == c["total"]
+
+    # --- claim / renew / release -------------------------------------
+    @staticmethod
+    def default_worker_id() -> str:
+        return f"{socket.gethostname()}-{os.getpid()}"
+
+    def try_claim(
+        self, job_id: str, worker_id: str, now: float | None = None
+    ) -> Claim | None:
+        now = time.time() if now is None else now
+        if os.path.exists(self._p(_DONE, job_id)) or os.path.exists(
+            self._p(_QUARANTINE, job_id)
+        ):
+            return None
+        job = self.get_job(job_id)
+        if job is None or job.next_eligible_unix > now:
+            return None
+        path = self._p(_CLAIMS, job_id)
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return None
+        expires = now + self.lease_s
+        with os.fdopen(fd, "w") as f:
+            json.dump(
+                {
+                    "job_id": job_id,
+                    "worker_id": worker_id,
+                    "pid": os.getpid(),
+                    "hostname": socket.gethostname(),
+                    "claimed_unix": now,
+                    "expires_unix": expires,
+                },
+                f, indent=2,
+            )
+            f.write("\n")
+        return Claim(
+            job=job, worker_id=worker_id, expires_unix=expires, path=path
+        )
+
+    def claim_next(
+        self, worker_id: str, prefer_bucket: tuple | None = None
+    ) -> Claim | None:
+        """Claim the next eligible job. Jobs sharing ``prefer_bucket``
+        (the worker's previous shape bucket) come first, then the
+        remainder grouped BY bucket — so a fleet of workers naturally
+        partitions into shape-coherent streaks and consecutive jobs hit
+        the compiled-program caches (see runner.py)."""
+        self.reap_stale()
+        now = time.time()
+        eligible: list[tuple[tuple, str]] = []
+        for jid in self.job_ids():
+            if self.state(jid, now) != "pending":
+                continue
+            job = self.get_job(jid)
+            if job is None:
+                continue
+            bucket = job.bucket or ()
+            rank = (
+                0 if (prefer_bucket and bucket == tuple(prefer_bucket))
+                else 1,
+                tuple(str(x) for x in bucket),
+                jid,
+            )
+            eligible.append((rank, jid))
+        for _, jid in sorted(eligible):
+            claim = self.try_claim(jid, worker_id, now)
+            if claim is not None:
+                return claim
+        return None
+
+    def renew(self, claim: Claim) -> None:
+        """Extend the holder's lease (atomic rewrite of the claim)."""
+        claim.expires_unix = time.time() + self.lease_s
+        doc = _read_json(claim.path) or {}
+        doc.update(
+            {
+                "job_id": claim.job.job_id,
+                "worker_id": claim.worker_id,
+                "pid": os.getpid(),
+                "hostname": socket.gethostname(),
+                "expires_unix": claim.expires_unix,
+            }
+        )
+        _atomic_write_json(claim.path, doc)
+
+    # --- terminal transitions ----------------------------------------
+    def complete(self, claim: Claim, **info) -> None:
+        """Success: write the done record, release the claim."""
+        _atomic_write_json(
+            self._p(_DONE, claim.job.job_id),
+            {
+                "job_id": claim.job.job_id,
+                "input": claim.job.input,
+                "worker_id": claim.worker_id,
+                "finished_unix": time.time(),
+                "attempts": claim.job.attempts + 1,
+                **info,
+            },
+        )
+        self._release(claim)
+
+    def fail(self, claim: Claim, error: str) -> str:
+        """Failure by the claim holder: one attempt consumed. Returns
+        the resulting state: 'backoff' (will retry) or 'quarantined'."""
+        state = self._record_failure(claim.job.job_id, error)
+        self._release(claim)
+        return state
+
+    def _release(self, claim: Claim) -> None:
+        try:
+            os.unlink(claim.path)
+        except FileNotFoundError:
+            pass  # reaped from under us (lease must have expired)
+
+    def _record_failure(self, job_id: str, error: str) -> str:
+        """Consume one attempt: exponential backoff, or quarantine when
+        the budget is spent. Caller must hold the claim (or have won
+        the reap race) — job records have a single writer at a time."""
+        job = self.get_job(job_id)
+        if job is None:
+            return "unknown"
+        job.attempts += 1
+        job.last_error = f"{error}"[:2000]
+        if job.attempts >= self.max_attempts:
+            _atomic_write_json(
+                self._p(_QUARANTINE, job_id),
+                {
+                    "job_id": job_id,
+                    "input": job.input,
+                    "attempts": job.attempts,
+                    "last_error": job.last_error,
+                    "quarantined_unix": time.time(),
+                },
+            )
+            _atomic_write_json(self._p(_JOBS, job_id), job.to_doc())
+            log.warning(
+                "job %s quarantined after %d attempts: %s",
+                job_id, job.attempts, job.last_error,
+            )
+            return "quarantined"
+        backoff = self.backoff_base_s * (2 ** (job.attempts - 1))
+        job.next_eligible_unix = time.time() + backoff
+        _atomic_write_json(self._p(_JOBS, job_id), job.to_doc())
+        log.warning(
+            "job %s failed (attempt %d/%d, retry in %.3gs): %s",
+            job_id, job.attempts, self.max_attempts, backoff,
+            job.last_error,
+        )
+        return "backoff"
+
+    # --- stale-claim reaping -----------------------------------------
+    def reap_stale(self, now: float | None = None) -> list[str]:
+        """Re-queue jobs whose claim lease expired (their worker was
+        SIGKILLed or wedged past its lease). Exactly one reaper wins
+        per claim: the claim is renamed to a private tombstone first,
+        and only the winner of that rename records the failure.
+
+        A renewal racing the reap is detected by re-reading the
+        tombstone: if the lease is no longer expired the rename
+        caught a freshly renewed claim, and it is put back."""
+        now = time.time() if now is None else now
+        reaped = []
+        cdir = os.path.join(self.qdir, _CLAIMS)
+        for name in sorted(os.listdir(cdir)):
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(cdir, name)
+            doc = _read_json(path)
+            if doc is None or float(doc.get("expires_unix", 0)) >= now:
+                continue
+            tomb = f"{path}.reap.{uuid.uuid4().hex[:8]}"
+            try:
+                os.rename(path, tomb)
+            except OSError:
+                continue  # lost the reap race
+            fresh = _read_json(tomb)
+            if fresh and float(fresh.get("expires_unix", 0)) >= now:
+                # the owner renewed between our read and the rename:
+                # restore its claim (if a third party claimed in the
+                # gap the owner has genuinely lost the lease)
+                try:
+                    os.rename(tomb, path)
+                except OSError:
+                    os.unlink(tomb)
+                continue
+            job_id = os.path.splitext(name)[0]
+            worker = (fresh or {}).get("worker_id", "?")
+            self._record_failure(
+                job_id,
+                f"lease expired (worker {worker} presumed dead)",
+            )
+            os.unlink(tomb)
+            reaped.append(job_id)
+            log.warning(
+                "reaped stale claim on %s (worker %s)", job_id, worker
+            )
+        return reaped
+
+    # --- operator controls -------------------------------------------
+    def quarantined(self) -> list[dict]:
+        qdir = os.path.join(self.qdir, _QUARANTINE)
+        out = []
+        for name in sorted(os.listdir(qdir)):
+            if name.endswith(".json"):
+                doc = _read_json(os.path.join(qdir, name))
+                if doc:
+                    out.append(doc)
+        return out
+
+    def retry(self, job_id: str) -> bool:
+        """Re-queue a quarantined job: reset its attempt budget and
+        remove the quarantine marker. Returns False when the job is
+        not quarantined."""
+        qpath = self._p(_QUARANTINE, job_id)
+        if not os.path.exists(qpath):
+            return False
+        job = self.get_job(job_id)
+        if job is None:
+            return False
+        job.attempts = 0
+        job.next_eligible_unix = 0.0
+        _atomic_write_json(self._p(_JOBS, job_id), job.to_doc())
+        # marker removed LAST: a crash mid-retry leaves the job
+        # quarantined (safe), never half-requeued
+        os.unlink(qpath)
+        log.info("job %s re-queued from quarantine", job_id)
+        return True
+
+    def done_records(self) -> list[dict]:
+        ddir = os.path.join(self.qdir, _DONE)
+        out = []
+        for name in sorted(os.listdir(ddir)):
+            if name.endswith(".json"):
+                doc = _read_json(os.path.join(ddir, name))
+                if doc:
+                    out.append(doc)
+        return out

@@ -1,0 +1,134 @@
+"""Campaign rollup: the atomically rewritten ``campaign_status.json``.
+
+One small JSON snapshot aggregates the whole campaign for operators and
+schedulers, the survey-level analogue of a single run's ``status.json``
+heartbeat (obs/heartbeat.py): queue depths by derived state, the
+running jobs with each one's live stage/progress (read from the per-job
+``status.json`` under its job dir), completion throughput and an ETA
+extrapolated from the done timestamps, and the failure tallies
+(retrying jobs with their last error, quarantined jobs). Workers
+rewrite it after every state transition; ``python -m
+peasoup_tpu.tools.watch <campaign_dir>`` tails it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+
+from .queue import JobQueue
+
+CAMPAIGN_SCHEMA = "peasoup_tpu.campaign_status"
+CAMPAIGN_VERSION = 1
+
+
+def _read_json(path: str) -> dict | None:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def build_status(root: str, queue: JobQueue | None = None) -> dict:
+    """Aggregate the campaign directory into one status document."""
+    queue = queue or JobQueue(root)
+    now = time.time()
+    counts = queue.counts()
+
+    running = []
+    failures = []
+    for jid in queue.job_ids():
+        st = queue.state(jid, now)
+        job = queue.get_job(jid)
+        if st == "running":
+            hb = _read_json(os.path.join(root, "jobs", jid, "status.json"))
+            claim = _read_json(
+                os.path.join(queue.qdir, "claims", f"{jid}.json")
+            )
+            running.append(
+                {
+                    "job_id": jid,
+                    "worker_id": (claim or {}).get("worker_id"),
+                    "stage": (hb or {}).get("stage"),
+                    "progress": (hb or {}).get("progress"),
+                    "stalled": bool((hb or {}).get("stalled")),
+                }
+            )
+        elif st in ("backoff", "pending") and job and job.attempts:
+            failures.append(
+                {
+                    "job_id": jid,
+                    "attempts": job.attempts,
+                    "retry_in_s": round(
+                        max(0.0, job.next_eligible_unix - now), 3
+                    ),
+                    "last_error": job.last_error,
+                }
+            )
+
+    done = queue.done_records()
+    throughput = None
+    eta_s = None
+    if len(done) >= 2:
+        ts = sorted(float(d.get("finished_unix", 0)) for d in done)
+        span = ts[-1] - ts[0]
+        if span > 0:
+            throughput = (len(done) - 1) / span  # jobs per second
+            remaining = counts["total"] - counts["done"] - counts["quarantined"]
+            eta_s = round(remaining / throughput, 3) if remaining else 0.0
+
+    n_candidates = sum(int(d.get("n_candidates", 0) or 0) for d in done)
+    quarantined = [
+        {
+            "job_id": q.get("job_id"),
+            "attempts": q.get("attempts"),
+            "last_error": q.get("last_error"),
+        }
+        for q in queue.quarantined()
+    ]
+    return {
+        "schema": CAMPAIGN_SCHEMA,
+        "version": CAMPAIGN_VERSION,
+        "root": os.path.abspath(root),
+        "updated_unix": now,
+        "queue": counts,
+        "done": queue.drained(),
+        "running_jobs": running,
+        "failures": failures,
+        "quarantined": quarantined,
+        "throughput_jobs_per_s": throughput,
+        "eta_s": eta_s,
+        "candidates_total": n_candidates,
+    }
+
+
+def write_status(root: str, queue: JobQueue | None = None) -> dict:
+    """Build + atomically rewrite ``<root>/campaign_status.json``."""
+    doc = build_status(root, queue)
+    path = os.path.join(root, "campaign_status.json")
+    fd, tmp = tempfile.mkstemp(dir=root, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    return doc
+
+
+def load_campaign_status(path: str) -> dict:
+    """Load + validate a campaign_status.json snapshot."""
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("schema") != CAMPAIGN_SCHEMA:
+        raise ValueError(
+            f"{path}: not a {CAMPAIGN_SCHEMA} snapshot "
+            f"(schema={doc.get('schema')!r})"
+        )
+    return doc

@@ -1,0 +1,562 @@
+"""The campaign worker: a long-lived scheduler/executor loop.
+
+One invocation of ``campaign run`` is one worker. Workers share nothing
+but the campaign directory (queue.py); N workers on M hosts need no
+coordinator. What makes the loop worth having over ``for f in *.fil:
+peasoup -i $f`` is **compiled-program reuse**: a fresh process pays the
+full XLA compile per observation (minutes at survey sizes — NOTES.md),
+while a long-lived worker that feeds same-shaped observations through
+one process hits the in-process jit caches (every op-building function
+is ``lru_cache``'d on its shape signature) and compiles *zero* new
+programs after the first observation of a shape.
+
+Observations rarely share exact shapes, so the runner buckets them:
+``nsamps`` is padded up to a coarse geometric ladder (powers of two and
+3·2^(k-1) — two rungs per octave) with per-channel median samples, and
+the queue hands a worker jobs from its previous bucket first
+(queue.claim_next prefer_bucket). The bucket key includes everything
+shape-determining (nchans, nbits, padded nsamps, tsamp, fch1, foff) so
+two jobs in one bucket provably trace identical programs. Reuse is
+asserted, not assumed: each job's telemetry JIT stats yield a
+``jit_programs_compiled`` count recorded in its done record, and a
+same-bucket successor that compiled anything raises a structured
+``jit_cache_miss`` event.
+
+Each job runs with the full live-observability stack under its own job
+dir (``<root>/jobs/<id>/``): status.json heartbeat, crash flight
+recorder, telemetry.json manifest — ``tools.watch`` and
+``tools.report`` work on campaign jobs unchanged. A lease-renewal
+thread keeps the claim fresh while the job computes; if the worker is
+SIGKILLed the lease expires and any other worker reaps + re-queues the
+job (queue.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+
+import numpy as np
+
+from ..obs import get_logger
+from ..obs.flight import FlightRecorder
+from ..obs.heartbeat import Heartbeat
+from ..obs.telemetry import RunTelemetry
+from .db import DB_FILENAME, CandidateDB
+from .queue import Claim, Job, JobQueue, job_id_for
+from .rollup import write_status
+
+log = get_logger("campaign.runner")
+
+CAMPAIGN_CONFIG = "campaign.json"
+CAMPAIGN_CONFIG_SCHEMA = "peasoup_tpu.campaign"
+
+PIPELINES = ("search", "spsearch")
+
+
+# --------------------------------------------------------------------------
+# campaign config
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class CampaignConfig:
+    """Campaign-wide settings, persisted as ``<root>/campaign.json`` so
+    every worker (and every later ``status``/``retry`` invocation) runs
+    with identical semantics. First writer wins; later writers attach."""
+
+    pipeline: str = "spsearch"
+    config: dict = dataclasses.field(default_factory=dict)
+    lease_s: float = 60.0
+    max_attempts: int = 3
+    backoff_base_s: float = 2.0
+    heartbeat_interval: float = 2.0
+    bucket_nsamps: list | None = None  # explicit ladder override
+
+    def to_doc(self) -> dict:
+        return {
+            "schema": CAMPAIGN_CONFIG_SCHEMA,
+            **dataclasses.asdict(self),
+        }
+
+
+def save_campaign_config(root: str, cfg: CampaignConfig) -> CampaignConfig:
+    """Persist the campaign config; if one already exists it WINS (a
+    second worker attaching with different flags must not fork the
+    campaign's semantics mid-flight)."""
+    os.makedirs(root, exist_ok=True)
+    path = os.path.join(root, CAMPAIGN_CONFIG)
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except FileExistsError:
+        existing = load_campaign_config(root)
+        if existing.to_doc() != cfg.to_doc():
+            log.warning(
+                "campaign %s already configured; using its existing "
+                "campaign.json (pipeline=%s) over this invocation's flags",
+                root, existing.pipeline,
+            )
+        return existing
+    with os.fdopen(fd, "w") as f:
+        json.dump(cfg.to_doc(), f, indent=2)
+        f.write("\n")
+    return cfg
+
+
+def load_campaign_config(root: str) -> CampaignConfig:
+    path = os.path.join(root, CAMPAIGN_CONFIG)
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("schema") != CAMPAIGN_CONFIG_SCHEMA:
+        raise ValueError(f"{path}: not a {CAMPAIGN_CONFIG_SCHEMA} file")
+    doc.pop("schema", None)
+    return CampaignConfig(**doc)
+
+
+# --------------------------------------------------------------------------
+# shape buckets
+# --------------------------------------------------------------------------
+
+def bucket_nsamps(n: int, ladder: list[int] | None = None) -> int:
+    """Pad target for ``n`` samples: the smallest rung >= n of the
+    geometric ladder {2^k, 3*2^(k-1)} — two rungs per octave, so
+    padding stays under 50% (and under 10% for the common
+    just-short-of-a-power-of-two observation lengths) while the whole
+    survey shares only ~2 compiled program sets per octave of
+    observation length. An explicit campaign ladder overrides."""
+    if ladder:
+        above = [int(x) for x in ladder if int(x) >= n]
+        if above:
+            return min(above)
+        # beyond the explicit ladder: fall through to the default rungs
+    p = 1 << max(0, (int(n) - 1).bit_length())
+    if 3 * p // 4 >= n:
+        return 3 * p // 4
+    return p
+
+
+def bucket_for_header(hdr, ladder: list[int] | None = None) -> tuple:
+    """The shape-bucket key: everything that determines traced program
+    shapes for a fixed campaign config. nsamps enters padded; the plan
+    scalars (tsamp/fch1/foff) enter because they set the DM trial count
+    and therefore every wave geometry downstream."""
+    return (
+        int(hdr.nchans),
+        int(hdr.nbits),
+        bucket_nsamps(int(hdr.nsamples), ladder),
+        round(float(hdr.tsamp), 12),
+        round(float(hdr.fch1), 6),
+        round(float(hdr.foff), 6),
+    )
+
+
+def bucket_for_input(path: str, ladder: list[int] | None = None) -> tuple | None:
+    """Bucket key from just the file header (cheap at enqueue time);
+    None when the header is unreadable — the job still enqueues and
+    fails into quarantine through the normal retry path at run time."""
+    from ..io.sigproc import read_sigproc_header
+
+    try:
+        with open(path, "rb") as f:
+            hdr = read_sigproc_header(f)
+        if hdr.nsamples <= 0 or hdr.nchans <= 0:
+            return None
+        return bucket_for_header(hdr, ladder)
+    except Exception:
+        return None
+
+
+def pad_to_nsamps(fil, target: int):
+    """Pad a filterbank's time axis up to ``target`` samples with each
+    channel's median level (flat baseline: the normalisers see a few
+    percent more pure-baseline samples, no fake transient edges).
+    Returns (padded_fil, original_nsamps)."""
+    orig = fil.nsamps
+    if target <= orig:
+        return fil, orig
+    data = fil.data
+    fill = np.median(data, axis=0)
+    if np.issubdtype(data.dtype, np.integer):
+        fill = np.rint(fill)
+    pad = np.broadcast_to(
+        fill.astype(data.dtype), (target - orig, data.shape[1])
+    )
+    from ..io.sigproc import Filterbank
+
+    hdr = dataclasses.replace(fil.header, nsamples=target)
+    return Filterbank(
+        header=hdr, data=np.concatenate([data, pad], axis=0)
+    ), orig
+
+
+# --------------------------------------------------------------------------
+# manifest -> jobs
+# --------------------------------------------------------------------------
+
+def parse_manifest(path: str) -> list[dict]:
+    """One observation per line: either a bare filterbank path or a
+    JSON object ``{"input": ..., "config": {...}}`` with per-job
+    pipeline overrides. ``#`` comments and blank lines are skipped;
+    relative paths resolve against the manifest's directory."""
+    base = os.path.dirname(os.path.abspath(path))
+    entries = []
+    with open(path) as f:
+        for ln in f:
+            ln = ln.strip()
+            if not ln or ln.startswith("#"):
+                continue
+            if ln.startswith("{"):
+                doc = json.loads(ln)
+                if "input" not in doc:
+                    raise ValueError(
+                        f"{path}: manifest JSON line lacks 'input': {ln}"
+                    )
+            else:
+                doc = {"input": ln}
+            if not os.path.isabs(doc["input"]):
+                doc["input"] = os.path.join(base, doc["input"])
+            entries.append(doc)
+    return entries
+
+
+def enqueue_entries(
+    queue: JobQueue,
+    entries: list[dict],
+    pipeline: str,
+    ladder: list[int] | None = None,
+) -> int:
+    """Idempotently enqueue manifest entries; returns how many were new."""
+    added = 0
+    for e in entries:
+        inp = e["input"]
+        job = Job(
+            job_id=job_id_for(inp),
+            input=inp,
+            pipeline=e.get("pipeline", pipeline),
+            config=e.get("config") or {},
+            bucket=bucket_for_input(inp, ladder),
+        )
+        if job.pipeline not in PIPELINES:
+            raise ValueError(
+                f"unknown pipeline {job.pipeline!r} for {inp} "
+                f"(expected one of {PIPELINES})"
+            )
+        added += bool(queue.add_job(job))
+    return added
+
+
+# --------------------------------------------------------------------------
+# per-job execution
+# --------------------------------------------------------------------------
+
+def _build_config(cls, overrides: dict, **fixed):
+    """Instantiate a pipeline config dataclass from campaign + job
+    overrides, rejecting unknown keys loudly (a typo'd knob must fail
+    the job visibly, not silently run with defaults)."""
+    names = {f.name for f in dataclasses.fields(cls)}
+    unknown = set(overrides) - names
+    if unknown:
+        raise ValueError(
+            f"unknown {cls.__name__} keys in campaign config: "
+            f"{sorted(unknown)}"
+        )
+    merged = dict(overrides)
+    merged.update(fixed)
+    return cls(**merged)
+
+
+def jit_programs_compiled(tel: RunTelemetry) -> int:
+    """Backend programs compiled during this telemetry's run (the
+    jax.monitoring backend_compile counter). Zero on a job whose every
+    program came out of the in-process jit caches."""
+    return int(
+        sum(v[0] for k, v in tel.jit.items() if "backend_compile" in k)
+    )
+
+
+def run_observation(
+    job: Job, overrides: dict, job_dir: str, tel: RunTelemetry,
+    bucket_ladder: list[int] | None = None,
+) -> dict:
+    """Execute one observation end-to-end inside this process and write
+    its outputs (overview.xml + pipeline-specific candidate files)
+    under ``job_dir``. Returns the done-record info dict."""
+    from ..io.output import (
+        CandidateFileWriter,
+        OutputFileWriter,
+        write_singlepulse,
+    )
+    from ..io.sigproc import read_filterbank
+
+    t0 = time.perf_counter()
+    tel.set_stage("reading")
+    fil = read_filterbank(job.input)
+    if fil.nsamps <= 0 or fil.nchans <= 0:
+        raise ValueError(f"{job.input}: empty filterbank")
+    reading = time.perf_counter() - t0
+
+    target = (
+        job.bucket[2]
+        if job.bucket
+        else bucket_nsamps(fil.nsamps, bucket_ladder)
+    )
+    fil, orig_nsamps = pad_to_nsamps(fil, target)
+    if fil.nsamps != orig_nsamps:
+        tel.event(
+            "campaign_pad", orig_nsamps=orig_nsamps,
+            padded_nsamps=int(fil.nsamps),
+        )
+
+    outdir = job_dir.rstrip("/")
+    if job.pipeline == "spsearch":
+        from ..pipeline.single_pulse import (
+            SinglePulseConfig,
+            SinglePulseSearch,
+        )
+
+        cfg = _build_config(
+            SinglePulseConfig, overrides, outdir=outdir,
+            checkpoint_file=os.path.join(outdir, "search.ckpt.npz"),
+        )
+        result = SinglePulseSearch(cfg).run(fil)
+        # detections whose peak lies in the padding are artefacts of
+        # the bucket, not the sky
+        cands = [c for c in result.candidates if c.sample < orig_nsamps]
+        result.timers["reading"] = reading
+        tel.merge_timers(result.timers)
+        tel.set_stage("writing")
+        write_singlepulse(
+            os.path.join(outdir, "candidates.singlepulse"), cands
+        )
+        stats = OutputFileWriter()
+        stats.add_misc_info()
+        stats.add_header(fil.header)
+        stats.add_dm_list(result.dm_list)
+        stats.add_device_info()
+        stats.add_single_pulse_section(
+            cfg, job.input, result.widths, cands
+        )
+        stats.add_timing_info(result.timers)
+        stats.to_file(os.path.join(outdir, "overview.xml"))
+        n_cands = len(cands)
+    else:  # "search" (validated at enqueue)
+        from ..pipeline.search import PeasoupSearch, SearchConfig
+
+        cfg = _build_config(
+            SearchConfig, overrides, outdir=outdir,
+            checkpoint_file=os.path.join(outdir, "search.ckpt.npz"),
+        )
+        result = PeasoupSearch(cfg).run(fil)
+        result.timers["reading"] = reading
+        tel.merge_timers(result.timers)
+        tel.set_stage("writing")
+        writer = CandidateFileWriter(outdir)
+        writer.write_binary(result.candidates, "candidates.peasoup")
+        stats = OutputFileWriter()
+        stats.add_misc_info()
+        stats.add_header(fil.header)
+        stats.add_search_parameters(cfg, job.input)
+        stats.add_dm_list(result.dm_list)
+        stats.add_acc_list(result.acc_list_dm0)
+        stats.add_device_info()
+        stats.add_candidates(result.candidates, writer.byte_mapping)
+        stats.add_timing_info(result.timers)
+        stats.to_file(os.path.join(outdir, "overview.xml"))
+        n_cands = len(result.candidates)
+
+    tel.gauge("candidates.written", n_cands)
+    return {
+        "n_candidates": n_cands,
+        "pipeline": job.pipeline,
+        "bucket": list(job.bucket) if job.bucket else None,
+        "duration_s": round(time.perf_counter() - t0, 3),
+        "padded_from": orig_nsamps if fil.nsamps != orig_nsamps else None,
+    }
+
+
+class _LeaseRenewer(threading.Thread):
+    """Daemon renewing the worker's claim at a third of the lease, so
+    only a dead (or wedged-past-lease) worker ever loses a job."""
+
+    def __init__(self, queue: JobQueue, claim: Claim) -> None:
+        super().__init__(name="campaign-lease", daemon=True)
+        self._queue = queue
+        self._claim = claim
+        # NB: not "_stop" — Thread uses that name internally
+        self._halt = threading.Event()
+
+    def run(self) -> None:
+        period = max(0.05, self._queue.lease_s / 3.0)
+        while not self._halt.wait(period):
+            try:
+                self._queue.renew(self._claim)
+            except Exception:
+                log.debug("lease renewal failed", exc_info=True)
+
+    def stop(self) -> None:
+        self._halt.set()
+        self.join(timeout=5.0)
+
+
+# --------------------------------------------------------------------------
+# the worker loop
+# --------------------------------------------------------------------------
+
+class CampaignRunner:
+    """One worker process draining a campaign directory."""
+
+    def __init__(self, root: str, worker_id: str | None = None) -> None:
+        self.root = os.path.abspath(root)
+        self.campaign = load_campaign_config(self.root)
+        self.queue = JobQueue(
+            self.root,
+            lease_s=self.campaign.lease_s,
+            max_attempts=self.campaign.max_attempts,
+            backoff_base_s=self.campaign.backoff_base_s,
+        )
+        self.worker_id = worker_id or JobQueue.default_worker_id()
+        self._last_bucket: tuple | None = None
+        # the persistent XLA cache backs the in-process caches across
+        # worker restarts (utils/cache.py)
+        from ..utils.cache import enable_compilation_cache
+
+        enable_compilation_cache()
+
+    # --- one job ------------------------------------------------------
+    def process_claim(self, claim: Claim) -> str:
+        """Run one claimed job under its own observability stack.
+        Returns the job's resulting state (done|backoff|quarantined)."""
+        job = claim.job
+        job_dir = os.path.join(self.root, "jobs", job.job_id)
+        os.makedirs(job_dir, exist_ok=True)
+        manifest_path = os.path.join(job_dir, "telemetry.json")
+        tel = RunTelemetry()
+        tel.set_context(
+            command="campaign-job",
+            job_id=job.job_id,
+            worker_id=self.worker_id,
+            pipeline=job.pipeline,
+            inputfile=job.input,
+            outdir=job_dir,
+            attempt=job.attempts + 1,
+            bucket=list(job.bucket) if job.bucket else None,
+        )
+        renewer = _LeaseRenewer(self.queue, claim)
+        renewer.start()
+        recorder = FlightRecorder(
+            tel,
+            os.path.join(job_dir, "flight.json"),
+            manifest_path=manifest_path,
+        ).install()
+        heartbeat = Heartbeat(
+            tel,
+            os.path.join(job_dir, "status.json"),
+            interval=self.campaign.heartbeat_interval,
+        ).start()
+        overrides = {**self.campaign.config, **job.config}
+        try:
+            with tel.activate():
+                try:
+                    info = run_observation(
+                        job, overrides, job_dir, tel,
+                        bucket_ladder=self.campaign.bucket_nsamps,
+                    )
+                    compiled = jit_programs_compiled(tel)
+                    info["jit_programs_compiled"] = compiled
+                    tel.gauge("jit.programs_compiled", compiled)
+                    if (
+                        compiled
+                        and job.bucket
+                        and job.bucket == self._last_bucket
+                    ):
+                        # same bucket yet new programs: the reuse
+                        # contract broke — surface it, don't fail
+                        tel.event(
+                            "jit_cache_miss", bucket=list(job.bucket),
+                            programs_compiled=compiled,
+                        )
+                        log.warning(
+                            "job %s recompiled %d programs despite "
+                            "matching the previous bucket %s",
+                            job.job_id, compiled, job.bucket,
+                        )
+                    tel.set_stage("ingest")
+                    with CandidateDB(
+                        os.path.join(self.root, DB_FILENAME)
+                    ) as db:
+                        info["ingested"] = db.ingest_job(
+                            job.job_id, job_dir, job.input
+                        )
+                    tel.set_stage("done")
+                    tel.write(manifest_path)
+                except Exception as exc:
+                    tel.event(
+                        "campaign_job_failed",
+                        error=f"{type(exc).__name__}: {exc!s:.500}",
+                    )
+                    tel.write(
+                        manifest_path, aborted=True,
+                        abort_reason=f"{type(exc).__name__}: {exc!s:.200}",
+                    )
+                    state = self.queue.fail(
+                        claim, f"{type(exc).__name__}: {exc}"
+                    )
+                    log.warning(
+                        "job %s failed -> %s: %s", job.job_id, state, exc
+                    )
+                    return state
+        finally:
+            heartbeat.stop()
+            recorder.close()
+            renewer.stop()
+        self.queue.complete(claim, worker_id=self.worker_id, **info)
+        if job.bucket:
+            self._last_bucket = job.bucket
+        log.info(
+            "job %s done: %d candidates, %d programs compiled",
+            job.job_id, info["n_candidates"], info["jit_programs_compiled"],
+        )
+        return "done"
+
+    # --- the loop -----------------------------------------------------
+    def run(
+        self,
+        max_jobs: int | None = None,
+        drain: bool = True,
+        poll_s: float = 1.0,
+    ) -> dict:
+        """Claim and process jobs until the campaign drains (every job
+        terminal), ``max_jobs`` are processed, or — with
+        ``drain=False`` — the queue has nothing immediately claimable.
+        Returns this worker's tally."""
+        tally = {"done": 0, "failed": 0, "quarantined": 0}
+        processed = 0
+        while True:
+            if max_jobs is not None and processed >= max_jobs:
+                break
+            claim = self.queue.claim_next(
+                self.worker_id, prefer_bucket=self._last_bucket
+            )
+            if claim is None:
+                write_status(self.root, self.queue)
+                if self.queue.drained() or not drain:
+                    break
+                counts = self.queue.counts()
+                if counts["total"] == 0:
+                    break
+                # others are running, or retries are backing off: wait
+                time.sleep(poll_s)
+                continue
+            state = self.process_claim(claim)
+            processed += 1
+            if state == "done":
+                tally["done"] += 1
+            elif state == "quarantined":
+                tally["quarantined"] += 1
+            else:
+                tally["failed"] += 1
+            write_status(self.root, self.queue)
+        write_status(self.root, self.queue)
+        return tally

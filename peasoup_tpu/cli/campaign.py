@@ -1,0 +1,308 @@
+"""`peasoup-campaign` — fault-tolerant multi-observation orchestration.
+
+Run the pipelines over a manifest (or directory) of filterbanks as one
+long-lived worker process; start the same command on N hosts/terminals
+for N workers — they coordinate through the campaign directory alone
+(file-backed queue with atomic claims, lease expiry, retry/backoff and
+quarantine; see peasoup_tpu/campaign/).
+
+    # start (or join) a campaign: one worker per invocation
+    python -m peasoup_tpu.cli.campaign run -w camp/ --manifest obs.txt \\
+        --pipeline spsearch --config '{"dm_end": 250, "min_snr": 7}'
+
+    # live view (also: python -m peasoup_tpu.tools.watch camp/)
+    python -m peasoup_tpu.cli.campaign status -w camp/
+
+    # operator controls
+    python -m peasoup_tpu.cli.campaign quarantine-list -w camp/
+    python -m peasoup_tpu.cli.campaign retry -w camp/ --all
+    python -m peasoup_tpu.cli.campaign ingest -w camp/
+
+Campaign layout: ``campaign.json`` (config, first writer wins),
+``queue/`` (job records, claims, done + quarantine markers),
+``jobs/<id>/`` (each job's outputs + its own status.json heartbeat,
+flight recorder and telemetry manifest), ``candidates.sqlite`` (the
+survey candidate database) and ``campaign_status.json`` (the rollup).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+from . import add_version_arg
+
+
+def _load_config_arg(text: str | None) -> dict:
+    """--config accepts inline JSON or @path-to-json-file."""
+    if not text:
+        return {}
+    if text.startswith("@"):
+        with open(text[1:]) as f:
+            return json.load(f)
+    return json.loads(text)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="peasoup-campaign",
+        description="Peasoup-TPU campaign orchestration - run the "
+        "pipelines over many observations with a fault-tolerant "
+        "multi-worker queue and a survey candidate database",
+    )
+    add_version_arg(p)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    run = sub.add_parser(
+        "run", help="enqueue observations (idempotent) and work the "
+        "queue until the campaign drains",
+    )
+    run.add_argument("-w", "--workdir", required=True,
+                     help="campaign directory (shared by all workers)")
+    run.add_argument("--manifest", default=None,
+                     help="observation list: one .fil path per line, or "
+                     "JSON lines {'input': ..., 'config': {...}}")
+    run.add_argument("--data-dir", default=None,
+                     help="enqueue every *.fil under this directory "
+                     "instead of (or in addition to) --manifest")
+    run.add_argument("--pipeline", default="spsearch",
+                     choices=["search", "spsearch"],
+                     help="which pipeline each job runs (default spsearch)")
+    run.add_argument("--config", default=None,
+                     help="pipeline config overrides as inline JSON or "
+                     "@file.json (keys = SearchConfig/SinglePulseConfig "
+                     "fields)")
+    run.add_argument("--lease", type=float, default=60.0,
+                     help="claim lease seconds; a worker dead past this "
+                     "loses its job to the reaper (default 60)")
+    run.add_argument("--max-attempts", type=int, default=3,
+                     help="failures before quarantine (default 3)")
+    run.add_argument("--backoff", type=float, default=2.0,
+                     help="retry backoff base seconds, doubled per "
+                     "attempt (default 2)")
+    run.add_argument("--bucket-nsamps", default=None,
+                     help="comma-separated explicit nsamps bucket ladder "
+                     "(default: powers of two and 3*2^(k-1))")
+    run.add_argument("--max-jobs", type=int, default=None,
+                     help="stop this worker after N jobs (default: run "
+                     "until the campaign drains)")
+    run.add_argument("--no-drain", action="store_true",
+                     help="exit when nothing is immediately claimable "
+                     "instead of waiting for running/backoff jobs")
+    run.add_argument("--worker-id", default=None,
+                     help="override the worker identity (default "
+                     "hostname-pid)")
+    run.add_argument("--poll", type=float, default=1.0,
+                     help="seconds between queue polls while waiting "
+                     "(default 1)")
+    run.add_argument("--log-level", dest="log_level", default=None,
+                     choices=["debug", "info", "warning", "error"])
+    run.add_argument("-v", "--verbose", action="store_true")
+
+    st = sub.add_parser("status", help="print the campaign rollup")
+    st.add_argument("-w", "--workdir", required=True)
+    st.add_argument("--json", action="store_true",
+                    help="print the raw campaign_status.json document")
+
+    rt = sub.add_parser(
+        "retry", help="re-queue quarantined jobs (reset attempts)"
+    )
+    rt.add_argument("-w", "--workdir", required=True)
+    rt.add_argument("job_ids", nargs="*", help="job ids to re-queue")
+    rt.add_argument("--all", action="store_true",
+                    help="re-queue every quarantined job")
+
+    ql = sub.add_parser(
+        "quarantine-list", help="list quarantined jobs with last errors"
+    )
+    ql.add_argument("-w", "--workdir", required=True)
+
+    ing = sub.add_parser(
+        "ingest", help="(re)ingest every completed job's outputs into "
+        "the sqlite candidate database",
+    )
+    ing.add_argument("-w", "--workdir", required=True)
+    return p
+
+
+def _cmd_run(args) -> int:
+    from ..campaign.queue import JobQueue
+    from ..campaign.rollup import write_status
+    from ..campaign.runner import (
+        CampaignConfig,
+        CampaignRunner,
+        enqueue_entries,
+        parse_manifest,
+        save_campaign_config,
+    )
+    from ..obs import configure_logging
+    from .peasoup import apply_platform_env
+
+    configure_logging(args.log_level, args.verbose)
+    apply_platform_env()
+    ladder = (
+        [int(x) for x in args.bucket_nsamps.split(",")]
+        if args.bucket_nsamps else None
+    )
+    campaign = save_campaign_config(
+        args.workdir,
+        CampaignConfig(
+            pipeline=args.pipeline,
+            config=_load_config_arg(args.config),
+            lease_s=args.lease,
+            max_attempts=args.max_attempts,
+            backoff_base_s=args.backoff,
+            bucket_nsamps=ladder,
+        ),
+    )
+    queue = JobQueue(
+        args.workdir,
+        lease_s=campaign.lease_s,
+        max_attempts=campaign.max_attempts,
+        backoff_base_s=campaign.backoff_base_s,
+    )
+    entries = []
+    if args.manifest:
+        entries.extend(parse_manifest(args.manifest))
+    if args.data_dir:
+        entries.extend(
+            {"input": p}
+            for p in sorted(
+                glob.glob(os.path.join(args.data_dir, "**", "*.fil"),
+                          recursive=True)
+            )
+        )
+    added = enqueue_entries(
+        queue, entries, campaign.pipeline, campaign.bucket_nsamps
+    )
+    counts = queue.counts()
+    print(
+        f"campaign {os.path.abspath(args.workdir)}: enqueued {added} new "
+        f"of {len(entries)} listed ({counts['total']} total jobs)"
+    )
+    if counts["total"] == 0:
+        print("nothing to do (empty campaign)")
+        return 1
+    runner = CampaignRunner(args.workdir, worker_id=args.worker_id)
+    tally = runner.run(
+        max_jobs=args.max_jobs,
+        drain=not args.no_drain,
+        poll_s=args.poll,
+    )
+    status = write_status(args.workdir, queue)
+    q = status["queue"]
+    print(
+        f"worker {runner.worker_id}: {tally['done']} done, "
+        f"{tally['failed']} failed, {tally['quarantined']} quarantined "
+        f"(campaign: {q['done']}/{q['total']} done, "
+        f"{q['quarantined']} quarantined)"
+    )
+    return 0 if q["quarantined"] == 0 and q["done"] == q["total"] else 2
+
+
+def _cmd_status(args) -> int:
+    from ..campaign.rollup import write_status
+    from ..tools.watch import render_campaign_status
+
+    doc = write_status(args.workdir)
+    if args.json:
+        print(json.dumps(doc, indent=2))
+    else:
+        sys.stdout.write(render_campaign_status(doc))
+    return 0
+
+
+def _cmd_retry(args) -> int:
+    from ..campaign.queue import JobQueue
+    from ..campaign.rollup import write_status
+    from ..campaign.runner import load_campaign_config
+
+    campaign = load_campaign_config(args.workdir)
+    queue = JobQueue(
+        args.workdir,
+        lease_s=campaign.lease_s,
+        max_attempts=campaign.max_attempts,
+        backoff_base_s=campaign.backoff_base_s,
+    )
+    ids = list(args.job_ids)
+    if args.all:
+        ids.extend(
+            q["job_id"] for q in queue.quarantined()
+            if q.get("job_id") not in ids
+        )
+    if not ids:
+        print("nothing to retry (no job ids given; use --all?)")
+        return 1
+    n = 0
+    for jid in ids:
+        if queue.retry(jid):
+            print(f"re-queued {jid}")
+            n += 1
+        else:
+            print(f"{jid}: not quarantined, skipping")
+    write_status(args.workdir, queue)
+    return 0 if n else 1
+
+
+def _cmd_quarantine_list(args) -> int:
+    from ..campaign.queue import JobQueue
+
+    queue = JobQueue(args.workdir)
+    rows = queue.quarantined()
+    if not rows:
+        print("quarantine is empty")
+        return 0
+    for q in rows:
+        print(
+            f"{q.get('job_id')}  attempts={q.get('attempts')}  "
+            f"input={q.get('input')}\n    {q.get('last_error')}"
+        )
+    return 0
+
+
+def _cmd_ingest(args) -> int:
+    from ..campaign.db import DB_FILENAME, CandidateDB
+    from ..campaign.queue import JobQueue
+
+    queue = JobQueue(args.workdir)
+    done = queue.done_records()
+    if not done:
+        print("no completed jobs to ingest")
+        return 1
+    total = {"periodicity": 0, "single_pulse": 0}
+    with CandidateDB(os.path.join(args.workdir, DB_FILENAME)) as db:
+        for rec in done:
+            jid = rec["job_id"]
+            job_dir = os.path.join(args.workdir, "jobs", jid)
+            try:
+                counts = db.ingest_job(jid, job_dir, rec.get("input", ""))
+            except Exception as exc:
+                print(f"{jid}: ingest failed: {exc}")
+                continue
+            for k, v in counts.items():
+                total[k] += v
+        summary = db.counts()
+    print(
+        f"ingested {len(done)} jobs: {total['periodicity']} periodicity "
+        f"+ {total['single_pulse']} single-pulse candidates "
+        f"({summary['observations']} observations in the database)"
+    )
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    return {
+        "run": _cmd_run,
+        "status": _cmd_status,
+        "retry": _cmd_retry,
+        "quarantine-list": _cmd_quarantine_list,
+        "ingest": _cmd_ingest,
+    }[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -121,7 +121,12 @@ def main(argv: list[str] | None = None) -> int:
     # Heavy imports after arg parsing so --help/--version stay fast
     from ..io.output import OutputFileWriter, write_singlepulse
     from ..io.sigproc import read_filterbank
-    from ..pipeline.single_pulse import SinglePulseConfig, SinglePulseSearch
+    from ..pipeline.single_pulse import SinglePulseConfig
+
+    # multi-host aware (JAX_COORDINATOR_ADDRESS & co.): each process
+    # searches its DM slice, events are allgathered and clustered
+    # globally; single-process this is SinglePulseSearch.run
+    from ..parallel.multihost import run_single_pulse_search
 
     cfg = SinglePulseConfig(
         outdir=outdir,
@@ -156,15 +161,21 @@ def main(argv: list[str] | None = None) -> int:
         reading = time.perf_counter() - t0
 
         with tel.device_capture():
-            result = SinglePulseSearch(cfg).run(fil)
+            result = run_single_pulse_search(fil, cfg)
         result.timers["reading"] = reading
         tel.merge_timers(result.timers)
 
         import jax
 
+        if jax.process_count() > 1:
+            # per-host manifest shard (stage timers here are this
+            # host's own slice): telemetry.procN.json, merged with
+            # `tools.report --merge`
+            base, ext = os.path.splitext(manifest_path)
+            tel.write(f"{base}.proc{jax.process_index()}{ext or '.json'}")
         if jax.process_index() != 0:
-            # multi-process launch: every process ran the identical
-            # search (the driver is single-host for now); rank 0 writes
+            # the merged+clustered result is identical on every
+            # process; rank 0 writes
             return 0
 
         tel.set_stage("writing")

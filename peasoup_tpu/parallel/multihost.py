@@ -201,6 +201,77 @@ def run_search(fil, config):
     return search.finalize(fil, merged, fold_exchange=fold_exchange)
 
 
+def run_single_pulse_search(fil, config):
+    """Multi-host `spsearch`: DM-trial data parallelism across
+    processes, mirroring :func:`run_search`. Each process dedisperses +
+    boxcar-searches its contiguous slice of the global DM list on its
+    LOCAL chips, the raw above-threshold events (GLOBAL dm_idx) are
+    allgathered over DCN, and every process runs the identical global
+    friends-of-friends clustering — so a pulse whose DM footprint
+    spans a slice boundary still clusters as ONE candidate, and the
+    final list is identical (and deterministic) on every process; the
+    CLI's rank 0 writes it.
+
+    Single-process: exactly SinglePulseSearch(config).run(fil).
+    """
+    import pickle
+
+    from ..pipeline.single_pulse import (
+        PartialSinglePulseResult,
+        SinglePulseSearch,
+    )
+
+    initialize()
+    search = SinglePulseSearch(config)
+    nproc = jax.process_count()
+    if nproc == 1:
+        return search.run(fil)
+
+    plan = search.build_dm_plan(fil)
+    lo, hi = dm_slice_for_process(plan.ndm, nproc, jax.process_index())
+    log.info(
+        "multi-host spsearch: process %d/%d owns DM trials [%d, %d) "
+        "of %d", jax.process_index(), nproc, lo, hi, plan.ndm,
+    )
+    tel = current_telemetry()
+    tel.set_context(
+        process_index=int(jax.process_index()),
+        process_count=int(nproc),
+        hostname=socket.gethostname(),
+        dm_slice=[int(lo), int(hi)],
+    )
+    tel.event(
+        "multihost_slice", processes=nproc,
+        process=jax.process_index(), dm_lo=lo, dm_hi=hi,
+        ndm=int(plan.ndm),
+    )
+    part = search.run(fil, dm_slice=(lo, hi), finalize=False)
+
+    # the event allgather: tiny payloads (<= max_events per trial),
+    # process order == ascending DM slices so the merged set is
+    # deterministic
+    import numpy as np
+
+    blobs = _allgather_pickled(
+        pickle.dumps((part.events, part.n_overflowed))
+    )
+    all_events, n_overflowed = [], 0
+    for blob in blobs:
+        ev, novf = pickle.loads(blob)
+        all_events.append(ev)
+        n_overflowed += int(novf)
+    merged = PartialSinglePulseResult(
+        events=np.concatenate(all_events),
+        dm_list=plan.dm_list,  # global
+        widths=part.widths,
+        timers=part.timers,
+        nsamps=part.nsamps,
+        n_overflowed=n_overflowed,
+        t_total_start=part.t_total_start,
+    )
+    return search.finalize(fil, merged)
+
+
 def process_local_slice(mesh: Mesh, axis: str) -> tuple[int, int]:
     """The [start, stop) block of ``axis`` whose shards live on THIS
     process — the host-side work partition for feeding per-process

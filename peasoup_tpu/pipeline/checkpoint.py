@@ -24,6 +24,10 @@ import tempfile
 
 import numpy as np
 
+from ..obs import get_logger
+
+log = get_logger("pipeline.checkpoint")
+
 
 class SearchCheckpoint:
     """Atomic .npz store(s) of {global dm_idx: (idxs, snrs, counts)}.
@@ -97,8 +101,21 @@ class SearchCheckpoint:
                         out[g - self.lo] = (
                             z[f"idxs_{g}"], z[f"snrs_{g}"], z[f"counts_{g}"]
                         )
-            except (OSError, KeyError, ValueError):
-                continue  # corrupt/partial file: skip it, never crash
+            except Exception as exc:
+                # A truncated/corrupt store (worker SIGKILLed mid-write,
+                # torn copy, bad disk) must never fail the run — resume
+                # loses nothing but the restart time, and campaign
+                # retries (campaign/runner.py) depend on a damaged
+                # checkpoint degrading to "start over", not crashing
+                # the job again. np.load raises well outside
+                # OSError/ValueError here (zipfile.BadZipFile,
+                # EOFError, pickle errors), so catch everything.
+                log.warning(
+                    "discarding unreadable checkpoint %s "
+                    "(%s: %.200s); restarting those trials",
+                    path, type(exc).__name__, exc,
+                )
+                continue
         return out
 
     def save(

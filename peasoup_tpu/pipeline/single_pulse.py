@@ -96,6 +96,26 @@ class SinglePulseResult:
     n_overflowed: int = 0  # trials whose event count exceeded max_events
 
 
+@dataclass
+class PartialSinglePulseResult:
+    """A single-pulse search stopped before clustering
+    (``run(finalize=False)``): the raw above-threshold events of one
+    process's DM slice with GLOBAL dm_idx, ready for the multi-host
+    allgather (parallel/multihost.py:run_single_pulse_search). The
+    merged global event set then goes through :meth:`finalize` on
+    every process, so the clustered candidate list is identical (and
+    deterministic) everywhere — the single-pulse analogue of the
+    periodicity PartialSearchResult."""
+
+    events: np.ndarray  # _EVENT_DTYPE records, dm_idx GLOBAL
+    dm_list: np.ndarray  # the GLOBAL trial list
+    widths: tuple[int, ...]
+    timers: dict
+    nsamps: int
+    n_overflowed: int
+    t_total_start: float
+
+
 _EVENT_DTYPE = np.dtype(
     [
         ("dm_idx", np.int64),
@@ -247,7 +267,17 @@ class SinglePulseSearch:
             return devs[: min(len(devs), cfg.max_num_threads)]
         return devs[:1]
 
-    def run(self, fil: Filterbank) -> SinglePulseResult:
+    def run(
+        self,
+        fil: Filterbank,
+        dm_slice: tuple[int, int] | None = None,
+        finalize: bool = True,
+    ) -> "SinglePulseResult | PartialSinglePulseResult":
+        """Full search. With ``dm_slice=(lo, hi)`` only that contiguous
+        block of the global DM-trial list is dedispersed and searched
+        (events come back with GLOBAL dm_idx); with ``finalize=False``
+        the run stops before clustering and returns a
+        PartialSinglePulseResult for the multi-host event merge."""
         cfg = self.config
         tel = current_telemetry()
         timers: dict[str, float] = {}
@@ -256,30 +286,55 @@ class SinglePulseSearch:
         # --- plan ------------------------------------------------------
         t0 = time.perf_counter()
         tel.set_stage("plan")
-        dm_plan = self.build_dm_plan(fil)
-        widths = self.widths_for(dm_plan.out_nsamps)
+        global_plan = self.build_dm_plan(fil)
+        widths = self.widths_for(global_plan.out_nsamps)
+        lo = 0
+        dm_plan = global_plan
+        if dm_slice is not None:
+            lo, hi = dm_slice
+            dm_plan = global_plan.subset(lo, hi)
         timers["plan"] = time.perf_counter() - t0
-        tel.gauge("sp.n_dm_trials", int(dm_plan.ndm))
+        tel.gauge("sp.n_dm_trials", int(global_plan.ndm))
         tel.gauge("sp.n_widths", len(widths))
         tel.event(
-            "sp_plan", ndm=int(dm_plan.ndm), out_nsamps=int(dm_plan.out_nsamps),
+            "sp_plan", ndm=int(global_plan.ndm),
+            out_nsamps=int(global_plan.out_nsamps),
             widths=[int(w) for w in widths],
+            dm_slice=[int(lo), int(lo + dm_plan.ndm)],
         )
 
         # --- checkpoint store (load before dedispersion: a fully
         # restored run skips the expensive part, like the periodicity
-        # driver's resume fast path) -----------------------------------
+        # driver's resume fast path). Keyed on the GLOBAL trial count
+        # with per-slice store files, so resuming under a different
+        # process count reuses every completed trial -------------------
         ckpt = None
         restored: dict[int, tuple] = {}
         if cfg.checkpoint_file:
             ckpt = SearchCheckpoint(
                 cfg.checkpoint_file,
-                make_checkpoint_key(cfg, fil, dm_plan.ndm, widths),
+                make_checkpoint_key(cfg, fil, global_plan.ndm, widths),
+                slice_bounds=dm_slice,
             )
             restored = ckpt.load()
         skip_dedisp = dm_plan.ndm > 0 and all(
             d in restored for d in range(dm_plan.ndm)
         )
+        if dm_plan.ndm == 0:
+            # empty multi-host slice (more processes than DM trials):
+            # contribute zero events without touching the device
+            part = PartialSinglePulseResult(
+                events=np.zeros(0, dtype=_EVENT_DTYPE),
+                dm_list=global_plan.dm_list,
+                widths=widths,
+                timers={
+                    **timers, "dedispersion": 0.0, "searching": 0.0,
+                },
+                nsamps=fil.nsamps,
+                n_overflowed=0,
+                t_total_start=t_total,
+            )
+            return part if not finalize else self.finalize(fil, part)
 
         # --- dedispersion (reusing the periodicity engines) ------------
         t0 = time.perf_counter()
@@ -440,9 +495,7 @@ class SinglePulseSearch:
         timers["searching"] = time.perf_counter() - t0
         tel.capture_device_memory("search")
 
-        # --- host clustering -------------------------------------------
-        t0 = time.perf_counter()
-        tel.set_stage("clustering")
+        # --- event extraction (GLOBAL dm_idx) --------------------------
         recs = []
         n_overflowed = 0
         for dm_idx in range(dm_plan.ndm):
@@ -453,7 +506,7 @@ class SinglePulseSearch:
                 n_overflowed += 1
             for i in range(k):
                 recs.append(
-                    (dm_idx, int(pos_w[0, i]), int(pos_w[1, i]),
+                    (dm_idx + lo, int(pos_w[0, i]), int(pos_w[1, i]),
                      float(snrs[i]))
                 )
         events = np.asarray(recs, dtype=_EVENT_DTYPE)
@@ -467,6 +520,32 @@ class SinglePulseSearch:
                 "sp_event_overflow", trials=n_overflowed,
                 max_events=cfg.max_events,
             )
+        part = PartialSinglePulseResult(
+            events=events,
+            dm_list=global_plan.dm_list,
+            widths=widths,
+            timers=timers,
+            nsamps=fil.nsamps,
+            n_overflowed=n_overflowed,
+            t_total_start=t_total,
+        )
+        if not finalize:
+            return part
+        return self.finalize(fil, part)
+
+    def finalize(
+        self, fil: Filterbank, part: PartialSinglePulseResult
+    ) -> SinglePulseResult:
+        """Cluster a (possibly multi-host-merged) global event set and
+        package candidates. Deterministic in the event set, so every
+        process of a multi-host run reaches the identical result."""
+        cfg = self.config
+        tel = current_telemetry()
+        timers = part.timers
+        events, widths = part.events, part.widths
+
+        t0 = time.perf_counter()
+        tel.set_stage("clustering")
         clusters = cluster_events_fof(
             events, widths, time_link=cfg.time_link, dm_link=cfg.dm_link,
             dec=cfg.decimate,
@@ -480,7 +559,7 @@ class SinglePulseSearch:
             cands.append(
                 [
                     SinglePulseCandidate(
-                        dm=float(dm_plan.dm_list[int(ev["dm_idx"][peak])]),
+                        dm=float(part.dm_list[int(ev["dm_idx"][peak])]),
                         dm_idx=int(ev["dm_idx"][peak]),
                         snr=float(ev["snr"][peak]),
                         time_s=float(ev["sample"][peak]) * fil.tsamp,
@@ -499,7 +578,7 @@ class SinglePulseSearch:
             )
         out = sorted(cands, key=lambda c: -c.snr)[: cfg.limit]
         timers["clustering"] = time.perf_counter() - t0
-        timers["total"] = time.perf_counter() - t_total
+        timers["total"] = time.perf_counter() - part.t_total_start
         tel.gauge("sp.n_events", len(events))
         tel.gauge("sp.n_clusters", len(clusters))
         tel.gauge("candidates.final", len(out))
@@ -509,12 +588,12 @@ class SinglePulseSearch:
         )
         return SinglePulseResult(
             candidates=out,
-            dm_list=dm_plan.dm_list,
+            dm_list=part.dm_list,
             widths=widths,
             timers=timers,
-            nsamps=fil.nsamps,
+            nsamps=part.nsamps,
             n_events=len(events),
-            n_overflowed=n_overflowed,
+            n_overflowed=part.n_overflowed,
         )
 
     def _run_waves(

@@ -1,7 +1,9 @@
-"""Tail/render a live ``status.json`` heartbeat.
+"""Tail/render a live ``status.json`` heartbeat or a campaign rollup.
 
     python -m peasoup_tpu.tools.watch run/status.json
     python -m peasoup_tpu.tools.watch run/status.json --once
+    python -m peasoup_tpu.tools.watch campaign_dir/          # rollup
+    python -m peasoup_tpu.tools.watch campaign_dir/campaign_status.json
 
 The heartbeat (peasoup_tpu/obs/heartbeat.py, enabled per run with
 ``--status-json``) atomically rewrites the snapshot every few seconds;
@@ -11,12 +13,21 @@ of fighting the terminal. It exits when the run reports ``done`` (or
 immediately with ``--once``), and flags a heartbeat whose
 ``updated_unix`` has gone stale — the difference between a run that is
 slow and a process that is gone.
+
+Campaign mode: pointed at a campaign directory (or its
+``campaign_status.json``) it renders the survey-level rollup instead —
+queue depths, the running jobs with each one's live stage/progress,
+throughput/ETA and the failure/quarantine tallies (the file is
+rewritten by every worker after each state transition; see
+peasoup_tpu/campaign/rollup.py). The two snapshot kinds are told apart
+by their ``schema`` key, so one watch invocation works on both.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -84,6 +95,72 @@ def render_status(st: dict, stale_after: float = 0.0) -> str:
     return "\n".join(lines) + "\n"
 
 
+def render_campaign_status(st: dict, stale_after: float = 0.0) -> str:
+    """One compact text block for a campaign_status.json rollup."""
+    q = st.get("queue") or {}
+    total = q.get("total", 0)
+    done = q.get("done", 0)
+    head = (
+        f"campaign {st.get('root', '?')}\n"
+        f"  [{_bar(done / total if total else 0.0)}] "
+        f"{done}/{total} done  "
+        f"running={q.get('running', 0)}  pending={q.get('pending', 0)}"
+        f"+{q.get('backoff', 0)} backing off  "
+        f"stale={q.get('stale', 0)}  quarantined={q.get('quarantined', 0)}"
+    )
+    lines = [head]
+    thr = st.get("throughput_jobs_per_s")
+    if thr:
+        eta = st.get("eta_s")
+        lines.append(
+            f"  throughput {thr * 3600.0:.3g} jobs/h"
+            + (f"  ETA {eta:.0f}s" if eta is not None else "")
+        )
+    if st.get("candidates_total"):
+        lines.append(f"  candidates so far: {st['candidates_total']}")
+    for rj in st.get("running_jobs") or []:
+        prog = rj.get("progress") or {}
+        frac = prog.get("frac")
+        bits = [f"  run {rj.get('job_id')}  "
+                f"worker={rj.get('worker_id', '?')}  "
+                f"stage={rj.get('stage') or '-'}"]
+        if frac is not None:
+            bits.append(f"{frac * 100.0:5.1f}%")
+        if rj.get("stalled"):
+            bits.append("*** STALLED ***")
+        lines.append("  ".join(bits))
+    for fl in st.get("failures") or []:
+        lines.append(
+            f"  retrying {fl.get('job_id')} (attempt {fl.get('attempts')},"
+            f" in {fl.get('retry_in_s', 0):.0f}s): {fl.get('last_error')}"
+        )
+    for ql in st.get("quarantined") or []:
+        lines.append(
+            f"  QUARANTINED {ql.get('job_id')} after "
+            f"{ql.get('attempts')} attempts: {ql.get('last_error')}"
+        )
+    age = time.time() - st.get("updated_unix", time.time())
+    if stale_after and age > stale_after:
+        lines.append(
+            f"  *** rollup STALE: last update {age:.0f}s ago — "
+            f"no worker alive? ***"
+        )
+    if st.get("done"):
+        lines.append("  campaign complete.")
+    return "\n".join(lines) + "\n"
+
+
+def resolve_status_path(path: str) -> str:
+    """A directory argument resolves to the campaign rollup inside it
+    when one exists (else the single-run status.json)."""
+    if os.path.isdir(path):
+        camp = os.path.join(path, "campaign_status.json")
+        if os.path.exists(camp):
+            return camp
+        return os.path.join(path, "status.json")
+    return path
+
+
 def _read(path: str) -> dict | None:
     try:
         with open(path) as f:
@@ -97,7 +174,11 @@ def main(argv: list[str] | None = None) -> int:
         prog="peasoup-watch",
         description="Tail/render a live status.json heartbeat",
     )
-    p.add_argument("status", help="path to the run's status.json")
+    p.add_argument(
+        "status",
+        help="path to a run's status.json, a campaign_status.json, or "
+        "a campaign directory",
+    )
     p.add_argument(
         "--interval", type=float, default=1.0,
         help="poll interval in seconds (default 1)",
@@ -116,19 +197,28 @@ def main(argv: list[str] | None = None) -> int:
     t0 = time.monotonic()
     last_seq = None
     stale_after = max(10.0, 5 * args.interval)
+    path = resolve_status_path(args.status)
     while True:
-        st = _read(args.status)
+        st = _read(path)
         if st is None:
+            # a campaign rollup may appear after the first worker
+            # starts — re-resolve directory arguments while waiting
+            path = resolve_status_path(args.status)
             if args.once or (
                 args.timeout and time.monotonic() - t0 > args.timeout
             ):
-                sys.stderr.write(f"no status at {args.status}\n")
+                sys.stderr.write(f"no status at {path}\n")
                 return 1
             time.sleep(args.interval)
             continue
-        if st.get("seq") != last_seq or args.once:
-            last_seq = st.get("seq")
-            sys.stdout.write(render_status(st, stale_after=stale_after))
+        campaign = st.get("schema") == "peasoup_tpu.campaign_status"
+        # campaign rollups have no seq: key change detection on the
+        # writer's timestamp instead
+        seq = st.get("updated_unix") if campaign else st.get("seq")
+        if seq != last_seq or args.once:
+            last_seq = seq
+            render = render_campaign_status if campaign else render_status
+            sys.stdout.write(render(st, stale_after=stale_after))
             sys.stdout.flush()
         if args.once or st.get("done"):
             return 0

@@ -179,3 +179,49 @@ def test_multibeam_rfi_loop(tmp_path):
         ) < 0.01
     ]
     assert min(abs(d - dm_psr) for d in psr_dms) < 10.0, psr_dms
+
+
+def test_campaign_cli_subcommands(tmp_path, capsys):
+    """Campaign CLI: run a 2-observation manifest (one corrupt) with a
+    single worker invocation, then drive status/quarantine-list/
+    retry/ingest through the CLI surface."""
+    import json
+
+    from peasoup_tpu.cli.campaign import main as camp_main
+    from test_campaign import make_corrupt_obs, make_obs
+
+    data = tmp_path / "data"
+    data.mkdir()
+    good = make_obs(str(data / "good.fil"))
+    make_corrupt_obs(str(data / "bad.fil"), good)
+    manifest = tmp_path / "obs.txt"
+    manifest.write_text("data/good.fil\ndata/bad.fil\n")
+    camp = tmp_path / "camp"
+
+    rc = camp_main(
+        [
+            "run", "-w", str(camp), "--manifest", str(manifest),
+            "--pipeline", "spsearch",
+            "--config", '{"dm_end": 20, "min_snr": 7, "n_widths": 6}',
+            "--max-attempts", "2", "--backoff", "0.05", "--poll", "0.05",
+        ]
+    )
+    out = capsys.readouterr().out
+    assert rc == 2  # quarantine present -> non-zero, distinct from crash
+    assert "enqueued 2 new" in out
+    assert "1 done" in out and "1 quarantined" in out
+
+    assert camp_main(["status", "-w", str(camp), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["schema"] == "peasoup_tpu.campaign_status"
+    assert doc["queue"]["done"] == 1
+    assert doc["queue"]["quarantined"] == 1
+
+    assert camp_main(["quarantine-list", "-w", str(camp)]) == 0
+    assert "unterminated sigproc header" in capsys.readouterr().out
+
+    assert camp_main(["retry", "-w", str(camp), "--all"]) == 0
+    assert "re-queued" in capsys.readouterr().out
+
+    assert camp_main(["ingest", "-w", str(camp)]) == 0
+    assert "1 jobs" in capsys.readouterr().out

@@ -575,6 +575,45 @@ def test_watch_once_renders(tmp_path, capsys):
     assert watch_main(["--once", str(tmp_path / "nope.json")]) == 1
 
 
+def test_watch_campaign_rollup_renders(tmp_path, capsys):
+    """Satellite: watch pointed at a campaign directory (or its
+    campaign_status.json) renders the survey rollup — queue depths,
+    retrying jobs with errors, quarantine — and detects the snapshot
+    kind by schema, so one invocation works on both."""
+    from peasoup_tpu.campaign.queue import Job, JobQueue
+    from peasoup_tpu.campaign.rollup import write_status
+    from peasoup_tpu.tools.watch import main as watch_main
+
+    root = str(tmp_path / "camp")
+    q = JobQueue(root, lease_s=30.0, max_attempts=2, backoff_base_s=60.0)
+    for i in range(3):
+        q.add_job(Job(job_id=f"job{i}", input=f"obs{i}.fil"))
+    q.complete(q.try_claim("job0", "w1"), n_candidates=5)
+    q.fail(q.try_claim("job1", "w1"), "flaky io")
+    q.fail(q.try_claim("job1", "w1", now=time.time() + 120), "flaky io")
+    write_status(root, q)
+
+    # directory argument resolves to the rollup inside it
+    assert watch_main(["--once", root]) == 0
+    out = capsys.readouterr().out
+    assert "campaign" in out
+    assert "1/3 done" in out
+    assert "quarantined=1" in out
+    assert "QUARANTINED job1" in out and "flaky io" in out
+
+    # the explicit file path works too, and a drained campaign says so
+    q.complete(q.try_claim("job2", "w2"), n_candidates=1)
+    q.retry("job1")
+    q.complete(q.try_claim("job1", "w2"), n_candidates=0)
+    write_status(root, q)
+    assert watch_main(
+        ["--once", os.path.join(root, "campaign_status.json")]
+    ) == 0
+    out = capsys.readouterr().out
+    assert "3/3 done" in out
+    assert "campaign complete" in out
+
+
 # --------------------------------------------------------------------------
 # satellites: Stopwatch context manager, peaks probe resolution, flags
 # --------------------------------------------------------------------------

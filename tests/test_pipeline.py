@@ -554,3 +554,47 @@ class TestCheckpointProcessCount:
 
         # a different config key restores nothing from any sibling
         assert SearchCheckpoint(base, "config-key-B").load() == {}
+
+
+class TestCheckpointCorruption:
+    def test_corrupt_store_discarded_with_warning(self, tmp_path, caplog):
+        """Satellite (campaign retries depend on it): a truncated or
+        garbage checkpoint file must degrade to "start over" with a
+        warning — np.load raises zipfile.BadZipFile/EOFError here,
+        well outside the old OSError/ValueError net."""
+        import logging
+
+        from peasoup_tpu.pipeline.checkpoint import SearchCheckpoint
+
+        base = str(tmp_path / "search.ckpt")
+        payload = {
+            0: (
+                np.zeros((2, 4), dtype=np.int32),
+                np.zeros((4,), dtype=np.float32),
+                np.asarray(0, dtype=np.int32),
+            )
+        }
+        ck = SearchCheckpoint(base, "key")
+        ck.save(payload)
+        assert sorted(ck.load()) == [0]
+
+        # truncate mid-zip: a worker SIGKILLed during a torn copy
+        with open(base, "r+b") as f:
+            f.truncate(20)
+        with caplog.at_level(
+            logging.WARNING, logger="peasoup_tpu.pipeline.checkpoint"
+        ):
+            assert ck.load() == {}
+        assert any(
+            "discarding unreadable checkpoint" in r.message
+            for r in caplog.records
+        )
+
+        # pure garbage (not even a zip): same contract
+        with open(base, "wb") as f:
+            f.write(b"\x00garbage" * 5)
+        assert ck.load() == {}
+
+        # and a fresh save over the damage fully recovers
+        ck.save(payload)
+        assert sorted(ck.load()) == [0]

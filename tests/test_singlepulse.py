@@ -359,6 +359,61 @@ class TestInjectionRecovery:
         ]
         assert key(r1) == key(r2)
 
+    def test_sliced_event_merge_matches_full_run(self, tmp_path):
+        """Satellite (multi-host spsearch): per-slice partial runs
+        allgather-merged and finalized must reproduce the full run's
+        clustered candidate list — the single-process twin of
+        parallel/multihost.py:run_single_pulse_search (slice, merge
+        events with GLOBAL dm_idx, cluster globally)."""
+        from peasoup_tpu.parallel.multihost import dm_slice_for_process
+        from peasoup_tpu.pipeline.single_pulse import (
+            PartialSinglePulseResult,
+        )
+
+        path, plan, idx = make_sp_fil(tmp_path, name="slices.fil")
+        fil = read_filterbank(path)
+        cfg = SinglePulseConfig(dm_end=60.0, min_snr=7.0, n_widths=8)
+        search = SinglePulseSearch(cfg)
+        full = search.run(fil)
+
+        parts = []
+        for pid in range(3):
+            lo, hi = dm_slice_for_process(plan.ndm, 3, pid)
+            part = search.run(fil, dm_slice=(lo, hi), finalize=False)
+            # events come back with GLOBAL dm_idx, inside the slice
+            if len(part.events):
+                assert part.events["dm_idx"].min() >= lo
+                assert part.events["dm_idx"].max() < hi
+            parts.append(part)
+        merged = PartialSinglePulseResult(
+            events=np.concatenate([p.events for p in parts]),
+            dm_list=plan.dm_list,
+            widths=parts[0].widths,
+            timers=parts[0].timers,
+            nsamps=parts[0].nsamps,
+            n_overflowed=sum(p.n_overflowed for p in parts),
+            t_total_start=parts[0].t_total_start,
+        )
+        got = search.finalize(fil, merged)
+        key = lambda r: sorted(
+            (c.dm_idx, c.sample, c.width, round(c.snr, 4))
+            for c in r.candidates
+        )
+        assert key(got) == key(full)
+        assert got.candidates[0].dm_idx == idx
+
+    def test_run_single_pulse_search_single_process(self, tmp_path):
+        """The multihost driver degrades to the plain search when
+        process_count == 1 (every CI/CPU invocation)."""
+        from peasoup_tpu.parallel.multihost import run_single_pulse_search
+
+        path, plan, idx = make_sp_fil(tmp_path, name="mh1.fil")
+        fil = read_filterbank(path)
+        cfg = SinglePulseConfig(dm_end=60.0, min_snr=7.0, n_widths=8)
+        res = run_single_pulse_search(fil, cfg)
+        assert len(res.candidates) >= 1
+        assert res.candidates[0].dm_idx == idx
+
 
 # --------------------------------------------------------------------------
 # CLI
