@@ -1,0 +1,49 @@
+"""Static analysis for JAX/TPU hazards: ``peasoup-audit``.
+
+Two engines, one report:
+
+* **AST lints** (:mod:`.astlint`, rules in :mod:`.rules`): a small
+  rule-plugin framework over :mod:`ast` that encodes the hazards this
+  codebase stakes runtime guarantees on — host syncs inside jitted
+  code, Python control flow on tracers, float64 drift, non-atomic
+  writes to files the obs/campaign layers rewrite atomically,
+  thread-shared state mutated outside a lock, ``time.time()`` where
+  ``perf_counter`` is required.
+* **Program contracts** (:mod:`.contracts` over
+  :mod:`peasoup_tpu.ops.registry`): every registered jitted program is
+  abstract-evaled over a tiny representative shape set and its
+  jaxpr/StableHLO linted — no f64 ops (lowered under x64 so silent
+  downcasts become visible), no unexpected host callbacks or custom
+  calls, no oversized baked-in constants, donation matching what the
+  registry declares.
+
+Findings ratchet against a checked-in JSON baseline
+(``audit_baseline.json``): existing debt is tolerated, anything new
+fails the gate. Per-line suppression:
+``# audit: ignore[PSA006] -- reason`` (the reason is mandatory; a
+bare suppression is inactive).
+
+CLI: ``python -m peasoup_tpu.tools.audit`` (exit 0 clean, 1 new
+findings, 2 internal error), wired into ``scripts/check.sh``.
+"""
+
+from .findings import Finding, Baseline
+from .astlint import lint_source, lint_path, ModuleContext
+from .rules import all_rules
+from .contracts import ContractConfig, audit_program, audit_programs
+from .runner import AuditResult, run_audit, render_text
+
+__all__ = [
+    "Finding",
+    "Baseline",
+    "ModuleContext",
+    "lint_source",
+    "lint_path",
+    "all_rules",
+    "ContractConfig",
+    "audit_program",
+    "audit_programs",
+    "AuditResult",
+    "run_audit",
+    "render_text",
+]

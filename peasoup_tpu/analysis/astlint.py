@@ -1,0 +1,500 @@
+"""The AST lint engine: rule plugins over a per-module context.
+
+A rule is a subclass of :class:`Rule` registered with
+:func:`register_rule`; it receives a :class:`ModuleContext` (parsed
+tree, parent links, comment map, jit-scope analysis) and yields
+:class:`~.findings.Finding`\\ s. The engine owns the cross-cutting
+mechanics every rule needs:
+
+* **jit scopes** — which function bodies are staged out by
+  ``jax.jit``/``partial(jax.jit, ...)`` decorators, ``jax.jit(fn)``
+  wrapping, or by being passed as a ``lax.scan`` / ``while_loop`` /
+  ``fori_loop`` / ``cond`` body, including nested defs; plus which
+  parameters are static (``static_argnums``/``static_argnames``) and
+  which are tracers.
+* **tracer references** — whether an expression reads a tracer
+  parameter *as a value* (``x``) rather than through its static
+  metadata (``x.shape``, ``x.ndim``, ``x.dtype``, ``x.size``).
+* **suppressions** — ``# audit: ignore[PSA001,PSA006] -- reason``
+  drops same-line findings for those rules. The reason is mandatory:
+  a bare ``# audit: ignore[...]`` stays inactive (and the engine says
+  so), so every tolerated hazard carries its justification in-line.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+from .findings import Finding, SEV_ERROR
+
+SUPPRESS_RE = re.compile(
+    r"#\s*audit:\s*ignore\[([A-Za-z0-9_,\s]+)\]\s*(?:--\s*(\S.*))?"
+)
+
+# (callee, positional index) pairs whose argument is traced like a jit
+# body even without a jit decorator
+_TRACED_BODY_ARGS = {
+    ("scan", 0),
+    ("while_loop", 0),
+    ("while_loop", 1),
+    ("fori_loop", 2),
+    ("cond", 1),
+    ("cond", 2),
+    ("checkpoint", 0),
+    ("remat", 0),
+}
+
+# attribute reads that consume only static metadata of an array
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize", "aval",
+                 "sharding", "weak_type"}
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``jax.lax.scan`` -> "jax.lax.scan"; None for non-name chains."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    return dotted_name(node) in ("jax.jit", "jit", "pjit", "jax.pjit")
+
+
+def _literal_strs(node: ast.AST) -> list[str] | None:
+    """("a", "b") / "a" -> ["a", "b"]; None when not a literal."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if not (isinstance(el, ast.Constant)
+                    and isinstance(el.value, str)):
+                return None
+            out.append(el.value)
+        return out
+    return None
+
+
+def _literal_ints(node: ast.AST) -> list[int] | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if not (isinstance(el, ast.Constant)
+                    and isinstance(el.value, int)):
+                return None
+            out.append(el.value)
+        return out
+    return None
+
+
+@dataclass
+class JitInfo:
+    """How one function def is staged out."""
+
+    node: ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+    how: str  # "decorator" | "wrapped" | "traced-body" | "nested"
+    static_names: set[str] = field(default_factory=set)
+    # the jit decorator / jax.jit(...) call node, when there is one
+    jit_call: ast.Call | None = None
+
+    def param_names(self) -> list[str]:
+        a = self.node.args
+        names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return [n for n in names if n not in ("self", "cls")]
+
+    def tracer_names(self) -> set[str]:
+        return set(self.param_names()) - self.static_names
+
+
+class ModuleContext:
+    """Everything rules need about one source file."""
+
+    def __init__(self, source: str, relpath: str):
+        self.source = source
+        self.relpath = relpath.replace("\\", "/")
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source)
+        self._parents: dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[child] = parent
+        self.comments = self._collect_comments()
+        self.suppressions, self.inactive_suppressions = (
+            self._collect_suppressions()
+        )
+        self.jit_scopes = self._collect_jit_scopes()
+
+    # --- plumbing ----------------------------------------------------
+    def parent(self, node: ast.AST) -> ast.AST | None:
+        return self._parents.get(node)
+
+    def ancestors(self, node: ast.AST):
+        cur = self._parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parents.get(cur)
+
+    def source_line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def finding(self, rule, severity, node, message, fix_hint="") -> Finding:
+        line = getattr(node, "lineno", 0)
+        return Finding(
+            rule=rule,
+            severity=severity,
+            path=self.relpath,
+            line=line,
+            col=getattr(node, "col_offset", 0),
+            message=message,
+            fix_hint=fix_hint,
+            source_line=self.source_line(line).strip(),
+        )
+
+    # --- comments / suppressions ------------------------------------
+    def _collect_comments(self) -> dict[int, str]:
+        out: dict[int, str] = {}
+        try:
+            toks = tokenize.generate_tokens(
+                io.StringIO(self.source).readline
+            )
+            for tok in toks:
+                if tok.type == tokenize.COMMENT:
+                    out[tok.start[0]] = tok.string
+        except (tokenize.TokenError, IndentationError):
+            pass
+        return out
+
+    def _comment_only(self, line: int) -> bool:
+        text = self.source_line(line).strip()
+        return not text or text.startswith("#")
+
+    def _collect_suppressions(self):
+        """A trailing suppression covers its own line; a suppression on
+        a comment-only line covers the next code line (the repo's
+        88-column style rarely fits a trailing comment)."""
+        active: dict[int, set[str]] = {}
+        inactive: dict[int, set[str]] = {}
+        nlines = len(self.lines)
+        for line, comment in self.comments.items():
+            m = SUPPRESS_RE.search(comment)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            target = line
+            if self._comment_only(line):
+                target = next(
+                    (
+                        ln
+                        for ln in range(line + 1, nlines + 1)
+                        if not self._comment_only(ln)
+                    ),
+                    line,
+                )
+            dest = active if m.group(2) else inactive
+            dest.setdefault(target, set()).update(rules)
+        return active, inactive
+
+    def suppressed(self, finding: Finding) -> bool:
+        rules = self.suppressions.get(finding.line, ())
+        return finding.rule in rules or "ALL" in rules
+
+    # --- jit scope analysis -----------------------------------------
+    def _collect_jit_scopes(self) -> dict[ast.AST, JitInfo]:
+        scopes: dict[ast.AST, JitInfo] = {}
+        defs_by_name: dict[str, list[ast.AST]] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(node.name, []).append(node)
+
+        def add(node, how, jit_call=None):
+            if node in scopes:
+                return
+            info = JitInfo(node=node, how=how, jit_call=jit_call)
+            if jit_call is not None:
+                info.static_names = self._static_names(node, jit_call)
+            scopes[node] = info
+
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if _is_jax_jit(dec):
+                        add(node, "decorator")
+                    elif isinstance(dec, ast.Call):
+                        # @jax.jit(...) or @partial(jax.jit, ...)
+                        if _is_jax_jit(dec.func):
+                            add(node, "decorator", dec)
+                        elif (
+                            dotted_name(dec.func)
+                            in ("partial", "functools.partial")
+                            and dec.args
+                            and _is_jax_jit(dec.args[0])
+                        ):
+                            add(node, "decorator", dec)
+            elif isinstance(node, ast.Call):
+                callee = dotted_name(node.func) or ""
+                leaf = callee.rsplit(".", 1)[-1]
+                if _is_jax_jit(node.func) and node.args:
+                    fn = node.args[0]
+                    if isinstance(fn, ast.Name):
+                        for d in defs_by_name.get(fn.id, ()):
+                            add(d, "wrapped", node)
+                    elif isinstance(fn, ast.Lambda):
+                        add(fn, "wrapped", node)
+                elif callee.startswith(("jax.lax.", "lax.", "jax.")) or (
+                    leaf in {k for k, _ in _TRACED_BODY_ARGS}
+                ):
+                    for k, idx in _TRACED_BODY_ARGS:
+                        if leaf == k and len(node.args) > idx:
+                            fn = node.args[idx]
+                            if isinstance(fn, ast.Name):
+                                for d in defs_by_name.get(fn.id, ()):
+                                    add(d, "traced-body")
+                            elif isinstance(fn, ast.Lambda):
+                                add(fn, "traced-body")
+
+        # close over nesting: defs inside a jit scope are traced too
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(self.tree):
+                if not isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                ) or node in scopes:
+                    continue
+                for anc in self.ancestors(node):
+                    if anc in scopes:
+                        scopes[node] = JitInfo(node=node, how="nested")
+                        changed = True
+                        break
+        return scopes
+
+    def _static_names(self, fn_node, jit_call: ast.Call) -> set[str]:
+        """Static parameter names from a jit decorator/wrapper call."""
+        statics: set[str] = set()
+        a = getattr(fn_node, "args", None)
+        if a is None:
+            return statics
+        positional = [p.arg for p in a.posonlyargs + a.args]
+        for kw in jit_call.keywords:
+            if kw.arg == "static_argnames":
+                names = _literal_strs(kw.value)
+                if names:
+                    statics.update(names)
+            elif kw.arg == "static_argnums":
+                nums = _literal_ints(kw.value)
+                if nums:
+                    for i in nums:
+                        if 0 <= i < len(positional):
+                            statics.add(positional[i])
+        return statics
+
+    def enclosing_jit(self, node: ast.AST) -> JitInfo | None:
+        """Innermost jit scope containing ``node`` (or being it)."""
+        if node in self.jit_scopes:
+            return self.jit_scopes[node]
+        for anc in self.ancestors(node):
+            if anc in self.jit_scopes:
+                return self.jit_scopes[anc]
+        return None
+
+    def jit_root(self, node: ast.AST) -> JitInfo | None:
+        """The OUTERMOST jit scope containing ``node`` — its tracer
+        params are tracers for everything nested inside."""
+        found = None
+        if node in self.jit_scopes:
+            found = self.jit_scopes[node]
+        for anc in self.ancestors(node):
+            if anc in self.jit_scopes:
+                found = self.jit_scopes[anc]
+        return found
+
+    def tracer_names_at(self, node: ast.AST) -> set[str]:
+        """Names bound to tracers for code at ``node``: the union of
+        tracer params of every enclosing jit-scope function."""
+        names: set[str] = set()
+        chain = [node] + list(self.ancestors(node))
+        for n in chain:
+            info = self.jit_scopes.get(n)
+            if info is not None and not isinstance(n, ast.Lambda):
+                names |= info.tracer_names()
+            elif info is not None:
+                a = n.args
+                names |= {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+        return names
+
+    def references_tracer(self, expr: ast.AST, tracers: set[str]) -> bool:
+        """True when ``expr`` reads a tracer name as a *value* (not just
+        its static ``.shape``/``.ndim``/``.dtype``/``.size`` metadata,
+        and not ``len(x)``/``isinstance(x, ...)``)."""
+        if not tracers:
+            return False
+        parents: dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(expr):
+            for child in ast.iter_child_nodes(parent):
+                parents[child] = parent
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Name) or node.id not in tracers:
+                continue
+            p = parents.get(node)
+            if (
+                isinstance(p, ast.Attribute)
+                and p.value is node
+                and p.attr in _STATIC_ATTRS
+            ):
+                continue
+            if isinstance(p, ast.Call) and node in p.args:
+                callee = dotted_name(p.func)
+                if callee in ("len", "isinstance", "type"):
+                    continue
+            return True
+        return False
+
+    def in_lock(self, node: ast.AST) -> bool:
+        """True when ``node`` sits inside ``with <something lock-ish>:``."""
+        for anc in self.ancestors(node):
+            if isinstance(anc, ast.With):
+                for item in anc.items:
+                    name = dotted_name(item.context_expr) or ""
+                    if isinstance(item.context_expr, ast.Call):
+                        name = dotted_name(item.context_expr.func) or ""
+                    if "lock" in name.lower() or "mutex" in name.lower():
+                        return True
+        return False
+
+
+# --- rule plugin framework -------------------------------------------
+
+
+class Rule:
+    """One lint. Subclass, set the class attrs, implement check()."""
+
+    id: str = ""
+    severity: str = SEV_ERROR
+    title: str = ""
+    fix_hint: str = ""
+    # repo-relative path prefixes the rule applies to; () = everywhere
+    paths: tuple[str, ...] = ()
+    exclude: tuple[str, ...] = ()
+
+    def applies_to(self, relpath: str) -> bool:
+        if any(relpath.startswith(p) for p in self.exclude):
+            return False
+        return not self.paths or any(
+            relpath.startswith(p) for p in self.paths
+        )
+
+    def check(self, ctx: ModuleContext):
+        raise NotImplementedError
+
+    def finding(self, ctx, node, message, fix_hint=None) -> Finding:
+        return ctx.finding(
+            self.id,
+            self.severity,
+            node,
+            message,
+            self.fix_hint if fix_hint is None else fix_hint,
+        )
+
+
+_RULES: dict[str, type[Rule]] = {}
+
+
+def register_rule(cls: type[Rule]) -> type[Rule]:
+    if not cls.id:
+        raise ValueError(f"{cls.__name__}: rule id is required")
+    if cls.id in _RULES:
+        raise ValueError(f"duplicate rule id {cls.id}")
+    _RULES[cls.id] = cls
+    return cls
+
+
+def rule_classes() -> dict[str, type[Rule]]:
+    from . import rules  # noqa: F401  (registration side effect)
+
+    return dict(_RULES)
+
+
+# --- engine ----------------------------------------------------------
+
+
+def lint_source(
+    source: str, relpath: str, rule_ids=None
+) -> tuple[list[Finding], int]:
+    """Lint one module. Returns (findings, suppressed_count). A syntax
+    error becomes a PSA000 finding rather than an exception."""
+    classes = rule_classes()
+    if rule_ids is not None:
+        unknown = set(rule_ids) - set(classes)
+        if unknown:
+            raise ValueError(f"unknown rule ids: {sorted(unknown)}")
+        classes = {k: v for k, v in classes.items() if k in rule_ids}
+    try:
+        ctx = ModuleContext(source, relpath)
+    except SyntaxError as e:
+        return [
+            Finding(
+                rule="PSA000",
+                severity=SEV_ERROR,
+                path=relpath,
+                line=e.lineno or 0,
+                col=(e.offset or 1) - 1,
+                message=f"syntax error: {e.msg}",
+                source_line=(e.text or "").strip(),
+            )
+        ], 0
+    findings: list[Finding] = []
+    suppressed = 0
+    for cls in classes.values():
+        rule = cls()
+        if not rule.applies_to(ctx.relpath):
+            continue
+        for f in rule.check(ctx):
+            if ctx.suppressed(f):
+                suppressed += 1
+            else:
+                findings.append(f)
+    for line, rules in sorted(ctx.inactive_suppressions.items()):
+        if line in ctx.suppressions:
+            continue
+        findings.append(
+            Finding(
+                rule="PSA000",
+                severity=SEV_ERROR,
+                path=relpath,
+                line=line,
+                col=0,
+                message=(
+                    f"suppression for {sorted(rules)} has no reason and "
+                    "is inactive"
+                ),
+                fix_hint=(
+                    "write `# audit: ignore[RULE] -- why this is safe`"
+                ),
+                source_line=ctx.source_line(line).strip(),
+            )
+        )
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings, suppressed
+
+
+def lint_path(path: str, relpath: str, rule_ids=None):
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    return lint_source(source, relpath, rule_ids)

@@ -1,0 +1,607 @@
+"""The JAX/TPU hazard rules (PSA001-PSA010).
+
+Each rule encodes an invariant the pipeline stakes a runtime guarantee
+on; see the class docstrings for the failure mode each one prevents.
+Rules are small: the shared machinery (jit scopes, tracer references,
+suppressions) lives in :mod:`.astlint`.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .astlint import (
+    ModuleContext,
+    Rule,
+    dotted_name,
+    register_rule,
+)
+from .findings import SEV_ERROR, SEV_WARNING
+
+_NP = ("np", "numpy")
+_DEVICE_DIRS = (
+    "peasoup_tpu/ops/",
+    "peasoup_tpu/parallel/",
+    "peasoup_tpu/pipeline/",
+    "peasoup_tpu/plan/",
+)
+
+
+def _root(name: str | None) -> str:
+    return (name or "").split(".", 1)[0]
+
+
+@register_rule
+class HostSyncInJit(Rule):
+    """Host synchronisation inside a jitted/scan body.
+
+    ``.item()``, ``.tolist()``, ``float()``/``int()`` on a tracer,
+    ``jax.device_get`` and ``np.asarray`` all force a concrete value
+    mid-trace: at best a ConcretizationTypeError at runtime, at worst
+    (under ``io_callback``-style escapes) a silent device->host round
+    trip per step that serialises the whole pipeline.
+    """
+
+    id = "PSA001"
+    severity = SEV_ERROR
+    title = "host sync inside jitted code"
+    fix_hint = (
+        "keep the value on device (jnp), or hoist the host read out of "
+        "the jitted function"
+    )
+    paths = ("peasoup_tpu/",)
+
+    _SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+    _CASTS = {"float", "int", "bool", "complex"}
+
+    def check(self, ctx: ModuleContext):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if ctx.enclosing_jit(node) is None:
+                continue
+            callee = dotted_name(node.func)
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in self._SYNC_METHODS
+                and not node.args
+            ):
+                yield self.finding(
+                    ctx, node,
+                    f".{node.func.attr}() forces a host sync inside a "
+                    "jitted function",
+                )
+            elif callee in ("jax.device_get",):
+                yield self.finding(
+                    ctx, node,
+                    "jax.device_get() inside a jitted function is a "
+                    "host transfer",
+                )
+            elif callee is not None and _root(callee) in _NP and (
+                callee.rsplit(".", 1)[-1] in ("asarray", "array")
+            ):
+                yield self.finding(
+                    ctx, node,
+                    f"{callee}() materialises a tracer on the host "
+                    "inside a jitted function",
+                    "use jnp.asarray / keep the data as a jax Array",
+                )
+            elif callee in self._CASTS and node.args:
+                tracers = ctx.tracer_names_at(node)
+                if ctx.references_tracer(node.args[0], tracers):
+                    yield self.finding(
+                        ctx, node,
+                        f"{callee}() on a tracer concretises it inside "
+                        "a jitted function",
+                    )
+
+
+@register_rule
+class TracerBranch(Rule):
+    """Python ``if``/``while`` on a tracer value.
+
+    Control flow on a traced array either raises a
+    ConcretizationTypeError or — when the predicate happens to be
+    weakly concrete — silently bakes one branch into the compiled
+    program, so the other branch never runs for ANY later input.
+    """
+
+    id = "PSA002"
+    severity = SEV_ERROR
+    title = "Python branch on a tracer"
+    fix_hint = "use jnp.where / jax.lax.cond / jax.lax.select"
+    paths = ("peasoup_tpu/",)
+
+    def check(self, ctx: ModuleContext):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            if ctx.enclosing_jit(node) is None:
+                continue
+            test = node.test
+            if isinstance(test, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+            ):
+                continue  # `x is None` checks static structure
+            tracers = ctx.tracer_names_at(node)
+            if ctx.references_tracer(test, tracers):
+                kw = "if" if isinstance(node, ast.If) else "while"
+                yield self.finding(
+                    ctx, node,
+                    f"Python `{kw}` on a tracer value inside a jitted "
+                    "function",
+                )
+
+
+@register_rule
+class Float64InDeviceCode(Rule):
+    """float64 creeping into device code.
+
+    The pipeline is float32-by-design (peasoup's GPU lineage): an f64
+    op on TPU either fails to lower or silently runs at ~1/10th
+    throughput in the f64 emulation path, and an f64 constant doubles
+    its HBM footprint. ``jnp.float64`` is flagged anywhere;
+    ``np.float64``/``np.double``/``dtype="float64"`` only inside
+    jitted code (host-side f64 staging math is deliberate — the plan/
+    layer reproduces the reference's f64 behaviour).
+    """
+
+    id = "PSA003"
+    severity = SEV_ERROR
+    title = "float64 in device code"
+    fix_hint = "use float32 (the whole pipeline is f32-by-design)"
+    paths = ("peasoup_tpu/",)
+    exclude = ("peasoup_tpu/tools/",)
+
+    _F64 = {"float64", "double", "complex128"}
+
+    def check(self, ctx: ModuleContext):
+        for node in ast.walk(ctx.tree):
+            in_jit = ctx.enclosing_jit(node) is not None
+            name = dotted_name(node)
+            if name is not None and isinstance(node, ast.Attribute):
+                root, leaf = _root(name), name.rsplit(".", 1)[-1]
+                if leaf in self._F64 and (
+                    root in ("jnp", "jax") or (in_jit and root in _NP)
+                ):
+                    # skip the inner Attribute of e.g. np.float64(...)
+                    p = ctx.parent(node)
+                    yield self.finding(
+                        ctx, p if isinstance(p, ast.Call) else node,
+                        f"{name} in {'jitted' if in_jit else 'device'} "
+                        "code",
+                    )
+            elif isinstance(node, ast.keyword) and node.arg == "dtype":
+                # string dtypes only — named dtypes (np.float64) are
+                # caught by the Attribute branch above
+                if (
+                    in_jit
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)
+                    and node.value.value in self._F64
+                ):
+                    yield self.finding(
+                        ctx, node.value,
+                        "float64 dtype inside a jitted function",
+                    )
+
+
+@register_rule
+class DtypelessNpArray(Rule):
+    """``np.array([...])`` without an explicit dtype in device-adjacent
+    code.
+
+    NumPy infers float64 for Python floats, so a dtype-less literal
+    that later feeds jnp silently promotes (or silently DOWNCASTS when
+    jax truncates it back to f32 — two different sets of rounded
+    values depending on which path touched it first). An explicit
+    dtype documents which one is intended.
+    """
+
+    id = "PSA004"
+    severity = SEV_WARNING
+    title = "dtype-less np.array literal in device-adjacent code"
+    fix_hint = "pass dtype= explicitly (np.float32 for device inputs)"
+    paths = _DEVICE_DIRS
+
+    _LITERALS = (ast.List, ast.Tuple, ast.ListComp, ast.GeneratorExp)
+
+    def check(self, ctx: ModuleContext):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func)
+            if callee is None or _root(callee) not in _NP:
+                continue
+            if callee.rsplit(".", 1)[-1] != "array":
+                continue
+            if not node.args or not isinstance(node.args[0], self._LITERALS):
+                continue
+            if any(kw.arg == "dtype" for kw in node.keywords):
+                continue
+            yield self.finding(
+                ctx, node,
+                f"{callee}() of a literal without an explicit dtype",
+            )
+
+
+@register_rule
+class StaticArgHazard(Rule):
+    """Non-hashable or array-valued static jit arguments.
+
+    A static argument is a cache key: a list/dict/array default raises
+    ``TypeError: unhashable`` at the first call, and an array-typed
+    static parameter recompiles the program on every distinct value —
+    the silent-recompile hazard the campaign shape buckets exist to
+    avoid.
+    """
+
+    id = "PSA005"
+    severity = SEV_ERROR
+    title = "non-hashable / array-valued static jit argument"
+    fix_hint = (
+        "statics must be hashable scalars/tuples; pass arrays as traced "
+        "operands"
+    )
+    paths = ("peasoup_tpu/",)
+
+    _MUTABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                ast.SetComp)
+    _ARRAYISH = {"ndarray", "Array", "ArrayLike", "DeviceArray"}
+
+    def check(self, ctx: ModuleContext):
+        for info in ctx.jit_scopes.values():
+            call = info.jit_call
+            if call is not None:
+                for kw in call.keywords:
+                    if kw.arg not in ("static_argnums", "static_argnames"):
+                        continue
+                    if isinstance(kw.value, self._MUTABLE):
+                        yield self.finding(
+                            ctx, kw.value,
+                            f"{kw.arg} should be a literal tuple "
+                            "(a mutable value is not hashable as a "
+                            "cache key)",
+                        )
+            if isinstance(info.node, ast.Lambda) or not info.static_names:
+                continue
+            a = info.node.args
+            params = a.posonlyargs + a.args + a.kwonlyargs
+            defaults = dict(
+                zip([p.arg for p in a.args[::-1]], a.defaults[::-1])
+            )
+            defaults.update(
+                {
+                    p.arg: d
+                    for p, d in zip(a.kwonlyargs, a.kw_defaults)
+                    if d is not None
+                }
+            )
+            for p in params:
+                if p.arg not in info.static_names:
+                    continue
+                d = defaults.get(p.arg)
+                if d is not None and isinstance(d, self._MUTABLE):
+                    yield self.finding(
+                        ctx, d,
+                        f"static arg {p.arg!r} has an unhashable "
+                        "default",
+                    )
+                ann = p.annotation
+                ann_name = dotted_name(ann) if ann is not None else None
+                if ann_name and ann_name.rsplit(".", 1)[-1] in self._ARRAYISH:
+                    yield self.finding(
+                        ctx, p,
+                        f"static arg {p.arg!r} is annotated as an "
+                        f"array ({ann_name}): every distinct value "
+                        "recompiles, and jax Arrays are unhashable",
+                    )
+
+
+@register_rule
+class WallClockForDuration(Rule):
+    """``time.time()`` where ``perf_counter`` is required.
+
+    Wall clock steps under NTP slew: a duration measured with
+    ``time.time()`` can be negative or wildly wrong, which is exactly
+    how the telemetry layer once recorded negative JIT compile times.
+    Epoch *timestamps* (``*_unix`` fields, lease expiries shared
+    across hosts) are the legitimate use; name the target accordingly
+    or suppress with the reason.
+    """
+
+    id = "PSA006"
+    severity = SEV_WARNING
+    title = "time.time() where perf_counter is required"
+    fix_hint = (
+        "use time.perf_counter() for durations; for wall-clock epochs "
+        "store into a *_unix name or suppress with the reason"
+    )
+    paths = ("peasoup_tpu/",)
+
+    _OK_NAMES = ("unix", "epoch", "wallclock")
+
+    def _epoch_context(self, ctx: ModuleContext, node: ast.Call) -> bool:
+        parent = ctx.parent(node)
+        # walk up through arithmetic / conditional expressions
+        while isinstance(parent, (ast.BinOp, ast.IfExp, ast.BoolOp)):
+            node, parent = parent, ctx.parent(parent)
+        if isinstance(parent, ast.Assign):
+            for t in parent.targets:
+                name = (
+                    t.id if isinstance(t, ast.Name)
+                    else t.attr if isinstance(t, ast.Attribute)
+                    else ""
+                )
+                low = name.lower()
+                if low == "now" or any(s in low for s in self._OK_NAMES):
+                    return True
+        if isinstance(parent, ast.Dict):
+            for k, v in zip(parent.keys, parent.values):
+                if v is node and isinstance(k, ast.Constant) and any(
+                    s in str(k.value).lower() for s in self._OK_NAMES
+                ):
+                    return True
+        return False
+
+    def check(self, ctx: ModuleContext):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if dotted_name(node.func) != "time.time":
+                continue
+            if self._epoch_context(ctx, node):
+                continue
+            yield self.finding(
+                ctx, node,
+                "time.time() used outside an epoch-timestamp context",
+            )
+
+
+@register_rule
+class PrintInLibrary(Rule):
+    """``print()`` in library code.
+
+    The library speaks through the peasoup_tpu logger and the
+    telemetry manifest; stdout belongs to the CLIs (candidate tables
+    are parsed from it downstream — a stray print corrupts them).
+    """
+
+    id = "PSA007"
+    severity = SEV_ERROR
+    title = "print() in library code"
+    fix_hint = "use the peasoup_tpu logger (peasoup_tpu/obs/log.py)"
+    paths = ("peasoup_tpu/",)
+    exclude = ("peasoup_tpu/cli/", "peasoup_tpu/tools/")
+
+    def check(self, ctx: ModuleContext):
+        for node in ast.walk(ctx.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+            ):
+                yield self.finding(ctx, node, "print() in library code")
+
+
+@register_rule
+class NonAtomicSharedWrite(Rule):
+    """In-place JSON writes to shared files.
+
+    The obs/campaign layers rewrite ``status.json``, queue records and
+    rollups with tmp-file + ``os.replace`` so concurrent readers (the
+    watcher, other workers, the reaper) never see a torn file. A plain
+    ``open(path, "w") + json.dump`` in those layers reintroduces the
+    torn-read race.
+    """
+
+    id = "PSA008"
+    severity = SEV_ERROR
+    title = "non-atomic JSON write in a shared-file layer"
+    fix_hint = (
+        "write to a tempfile in the same directory and os.replace() "
+        "into place (see obs/heartbeat._atomic_write_json)"
+    )
+    paths = (
+        "peasoup_tpu/obs/",
+        "peasoup_tpu/campaign/",
+        "peasoup_tpu/pipeline/",
+        "peasoup_tpu/io/",
+    )
+
+    def _open_write_names(self, fn: ast.AST) -> dict[str, ast.AST]:
+        """as-names bound by `with open(_, "w"...)` in this function."""
+        out: dict[str, ast.AST] = {}
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.With):
+                continue
+            for item in node.items:
+                call = item.context_expr
+                if not (
+                    isinstance(call, ast.Call)
+                    and dotted_name(call.func) == "open"
+                ):
+                    continue
+                mode = None
+                if len(call.args) > 1 and isinstance(
+                    call.args[1], ast.Constant
+                ):
+                    mode = call.args[1].value
+                for kw in call.keywords:
+                    if kw.arg == "mode" and isinstance(
+                        kw.value, ast.Constant
+                    ):
+                        mode = kw.value.value
+                if not (isinstance(mode, str) and "w" in mode):
+                    continue
+                if isinstance(item.optional_vars, ast.Name):
+                    out[item.optional_vars.id] = call
+        return out
+
+    def check(self, ctx: ModuleContext):
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            has_replace = any(
+                isinstance(n, ast.Call)
+                and dotted_name(n.func) in ("os.replace", "os.rename")
+                for n in ast.walk(fn)
+            )
+            if has_replace:
+                continue
+            writers = self._open_write_names(fn)
+            if not writers:
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = dotted_name(node.func)
+                if callee == "json.dump" and len(node.args) >= 2:
+                    f = node.args[1]
+                    if isinstance(f, ast.Name) and f.id in writers:
+                        yield self.finding(
+                            ctx, node,
+                            "json.dump() into a plainly-opened file: a "
+                            "concurrent reader can see a torn write",
+                        )
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "write"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in writers
+                    and node.args
+                    and isinstance(node.args[0], ast.Call)
+                    and dotted_name(node.args[0].func) == "json.dumps"
+                ):
+                    yield self.finding(
+                        ctx, node,
+                        "f.write(json.dumps(...)) into a plainly-opened "
+                        "file: a concurrent reader can see a torn write",
+                    )
+
+
+@register_rule
+class UnlockedThreadShared(Rule):
+    """Mutation of thread-shared state outside a lock.
+
+    In classes that spawn a ``threading.Thread`` (the heartbeat, the
+    queue's lease renewer), attributes mutated from both the worker
+    thread and the main thread race unless guarded. Plain rebinding
+    is atomic under the GIL; this flags the compound operations that
+    are not: augmented assignment and in-place container mutation.
+    """
+
+    id = "PSA009"
+    severity = SEV_WARNING
+    title = "thread-shared mutation outside a lock"
+    fix_hint = (
+        "guard with `with self._lock:` (threading.Lock), or suppress "
+        "with the reason the access is single-threaded"
+    )
+    paths = ("peasoup_tpu/",)
+
+    _MUTATORS = {
+        "append", "extend", "insert", "remove", "pop", "popleft",
+        "appendleft", "clear", "update", "add", "discard",
+        "setdefault",
+    }
+
+    def _spawns_thread(self, cls: ast.ClassDef) -> bool:
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func) or ""
+                if name.endswith("Thread") and _root(name) in (
+                    "threading", "Thread",
+                ):
+                    return True
+        return False
+
+    def check(self, ctx: ModuleContext):
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            if not self._spawns_thread(cls):
+                continue
+            for method in cls.body:
+                if not isinstance(
+                    method, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ) or method.name == "__init__":
+                    continue
+                for node in ast.walk(method):
+                    if (
+                        isinstance(node, ast.AugAssign)
+                        and isinstance(node.target, ast.Attribute)
+                        and isinstance(node.target.value, ast.Name)
+                        and node.target.value.id == "self"
+                        and not ctx.in_lock(node)
+                    ):
+                        yield self.finding(
+                            ctx, node,
+                            f"self.{node.target.attr} augmented outside "
+                            f"a lock in thread-spawning class "
+                            f"{cls.name}",
+                        )
+                    elif (
+                        isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in self._MUTATORS
+                        and isinstance(node.func.value, ast.Attribute)
+                        and isinstance(node.func.value.value, ast.Name)
+                        and node.func.value.value.id == "self"
+                        and not ctx.in_lock(node)
+                    ):
+                        yield self.finding(
+                            ctx, node,
+                            f"self.{node.func.value.attr}."
+                            f"{node.func.attr}() outside a lock in "
+                            f"thread-spawning class {cls.name}",
+                        )
+
+
+@register_rule
+class NumpyOnTracer(Rule):
+    """NumPy called on a tracer inside jitted code.
+
+    ``np.sum(tracer)`` etc. either raises a TracerArrayConversionError
+    or — via ``__array__`` escapes — silently computes on host,
+    breaking the one-program-per-block design. (``np.array`` /
+    ``np.asarray`` are PSA001; this covers the rest of the np
+    namespace when an argument is a tracer.)
+    """
+
+    id = "PSA010"
+    severity = SEV_ERROR
+    title = "numpy op on a tracer inside jitted code"
+    fix_hint = "use the jnp equivalent inside jitted code"
+    paths = ("peasoup_tpu/",)
+
+    _EXCLUDED = {"asarray", "array"}  # PSA001's findings
+
+    def check(self, ctx: ModuleContext):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func)
+            if callee is None or _root(callee) not in _NP:
+                continue
+            if callee.rsplit(".", 1)[-1] in self._EXCLUDED:
+                continue
+            if ctx.enclosing_jit(node) is None:
+                continue
+            tracers = ctx.tracer_names_at(node)
+            if any(
+                ctx.references_tracer(a, tracers)
+                for a in list(node.args)
+                + [kw.value for kw in node.keywords]
+            ):
+                yield self.finding(
+                    ctx, node,
+                    f"{callee}() applied to a tracer inside a jitted "
+                    "function",
+                )
+
+
+def all_rules() -> dict[str, type[Rule]]:
+    from .astlint import rule_classes
+
+    return rule_classes()

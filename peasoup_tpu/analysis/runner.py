@@ -1,0 +1,168 @@
+"""Audit orchestration: both engines, the baseline ratchet, and the
+versioned ``audit.json`` report.
+
+The report is a machine-readable manifest like the telemetry one:
+versioned, schema-pinned by a checked-in JSON Schema
+(``analysis/audit.schema.json``) and validated by the same
+dependency-free validator (:mod:`peasoup_tpu.obs.schema`) before it is
+written — the audit cannot emit a report that its own consumers would
+reject.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+from .astlint import lint_path, rule_classes
+from .findings import Baseline, Finding
+
+AUDIT_SCHEMA = "peasoup_tpu.audit"
+AUDIT_VERSION = 1
+
+AUDIT_SCHEMA_PATH = os.path.join(
+    os.path.dirname(__file__), "audit.schema.json"
+)
+
+# directories never scanned by the AST engine
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+
+
+def package_files(root: str) -> list[tuple[str, str]]:
+    """(abspath, relpath) for every .py file under <root>/peasoup_tpu."""
+    pkg = os.path.join(root, "peasoup_tpu")
+    out = []
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d not in _SKIP_DIRS]
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            ap = os.path.join(dirpath, fname)
+            rp = os.path.relpath(ap, root).replace(os.sep, "/")
+            out.append((ap, rp))
+    return sorted(out)
+
+
+@dataclass
+class AuditResult:
+    findings: list[Finding] = field(default_factory=list)  # active
+    new: list[Finding] = field(default_factory=list)
+    baselined: list[Finding] = field(default_factory=list)
+    resolved: list[str] = field(default_factory=list)
+    suppressed: int = 0
+    files_scanned: int = 0
+    programs_checked: list[str] = field(default_factory=list)
+    rules: list[str] = field(default_factory=list)
+
+    @property
+    def clean(self) -> bool:
+        return not self.new
+
+    def to_manifest(self) -> dict:
+        return {
+            "schema": AUDIT_SCHEMA,
+            "version": AUDIT_VERSION,
+            "summary": {
+                "new": len(self.new),
+                "baselined": len(self.baselined),
+                "resolved": len(self.resolved),
+                "suppressed": self.suppressed,
+                "files_scanned": self.files_scanned,
+                "programs_checked": len(self.programs_checked),
+            },
+            "rules": sorted(self.rules),
+            "programs": sorted(self.programs_checked),
+            "findings": [f.to_json() for f in self.findings],
+            "resolved_fingerprints": sorted(self.resolved),
+        }
+
+
+def run_audit(
+    root: str,
+    *,
+    rule_ids=None,
+    ast_engine: bool = True,
+    contracts: bool = True,
+    baseline_path: str | None = None,
+    max_const_bytes: int | None = None,
+) -> AuditResult:
+    """Run both engines over the repo at ``root`` and apply the
+    baseline ratchet. Engine/internal errors propagate (the CLI maps
+    them to exit 2); per-file and per-program problems become
+    findings."""
+    result = AuditResult(rules=sorted(rule_classes()))
+    findings: list[Finding] = []
+
+    if ast_engine:
+        for abspath, relpath in package_files(root):
+            file_findings, nsup = lint_path(abspath, relpath, rule_ids)
+            findings.extend(file_findings)
+            result.suppressed += nsup
+            result.files_scanned += 1
+
+    if contracts:
+        from .contracts import ContractConfig, audit_programs
+
+        cfg = ContractConfig()
+        if max_const_bytes is not None:
+            cfg.max_const_bytes = max_const_bytes
+        report = audit_programs(cfg=cfg)
+        findings.extend(report.findings)
+        result.programs_checked = report.programs
+
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    result.findings = findings
+
+    baseline = Baseline()
+    if baseline_path is not None and os.path.exists(baseline_path):
+        baseline = Baseline.load(baseline_path)
+    result.new, result.baselined, result.resolved = baseline.apply(findings)
+    return result
+
+
+def write_report(result: AuditResult, path: str) -> None:
+    """Validate against the checked-in schema, then write atomically."""
+    from peasoup_tpu.obs.schema import validate
+
+    man = result.to_manifest()
+    with open(AUDIT_SCHEMA_PATH) as f:
+        validate(man, json.load(f))
+    from .findings import _atomic_write_json
+
+    _atomic_write_json(path, man)
+
+
+def render_text(result: AuditResult, verbose: bool = False) -> str:
+    """Human report: new findings in full, baselined summarised."""
+    lines: list[str] = []
+    for f in result.new:
+        lines.append(f.render())
+    if result.baselined:
+        if verbose:
+            lines.extend(f.render() for f in result.baselined)
+        else:
+            per_rule: dict[str, int] = {}
+            for f in result.baselined:
+                per_rule[f.rule] = per_rule.get(f.rule, 0) + 1
+            summary = ", ".join(
+                f"{r}x{n}" for r, n in sorted(per_rule.items())
+            )
+            lines.append(
+                f"{len(result.baselined)} baselined finding(s) "
+                f"({summary}) — tolerated, ratchet down with "
+                "--write-baseline after fixing"
+            )
+    if result.resolved:
+        lines.append(
+            f"{len(result.resolved)} baseline entr(ies) no longer "
+            "match — run --write-baseline to ratchet the debt down"
+        )
+    lines.append(
+        f"peasoup-audit: {len(result.new)} new, "
+        f"{len(result.baselined)} baselined, "
+        f"{result.suppressed} suppressed; "
+        f"{result.files_scanned} files, "
+        f"{len(result.programs_checked)} programs"
+    )
+    return "\n".join(lines)

@@ -126,6 +126,7 @@ class CandidateDB:
                 )
             )
             counts["single_pulse"] += 1
+        ingested_unix = time.time()
         with self._conn:  # one transaction: delete + reinsert
             self._conn.execute(
                 "DELETE FROM candidates WHERE job_id = ?", (job_id,)
@@ -140,7 +141,7 @@ class CandidateDB:
                     float(hdr.get("tsamp", 0) or 0),
                     int(float(hdr.get("nchans", 0) or 0)),
                     int(float(hdr.get("nsamples", 0) or 0)),
-                    time.time(),
+                    ingested_unix,
                 ),
             )
             self._conn.executemany(

@@ -27,3 +27,17 @@ def coincidence_mask(
     if axis_name is not None:
         count = jax.lax.psum(count, axis_name=axis_name)
     return (count < beam_thresh).astype(jnp.float32)
+
+
+# --- audit registry: thresh/beam_thresh traced as scalars (they are
+# data in the sharded driver too) ---
+from .registry import register_program, sds  # noqa: E402
+
+register_program(
+    "ops.coincidence.coincidence_mask",
+    lambda: (
+        coincidence_mask,
+        (sds((3, 64), "float32"), sds((), "float32"), sds((), "int32")),
+        {},
+    ),
+)

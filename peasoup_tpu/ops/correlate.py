@@ -91,3 +91,16 @@ def find_delays(beams, max_delay: int) -> DelayResult:
         beams, jnp.asarray(pairs), max_delay=max_delay
     )
     return DelayResult(pairs=pairs, distance=distance, lag=lag, power=power)
+
+
+# --- audit registry ---
+from .registry import register_program, sds  # noqa: E402
+
+register_program(
+    "ops.correlate.find_delays",
+    lambda: (
+        _find_delays,
+        (sds((3, 64), "float32"), sds((3, 2), "int32")),
+        {"max_delay": 4},
+    ),
+)

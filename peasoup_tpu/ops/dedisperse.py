@@ -33,7 +33,10 @@ def _shift_slice(row_b: jax.Array, delay: jax.Array, nb: int) -> jax.Array:
     """
     q = delay // 128
     s = delay % 128
-    v = jax.lax.dynamic_slice(row_b, (q, 0), (nb + 1, 128))
+    # the 0 start index must carry q's dtype: a bare Python 0
+    # canonicalises to i64 under enable_x64 and vmap then stacks
+    # mismatched index dtypes (audit contract pass traces under x64)
+    v = jax.lax.dynamic_slice(row_b, (q, jnp.int32(0)), (nb + 1, 128))
     a = jnp.roll(v, -s, axis=1)
     lane = jax.lax.broadcasted_iota(jnp.int32, (nb, 128), 1)
     return jnp.where(lane < 128 - s, a[:nb], a[1:]).reshape(-1)
@@ -581,3 +584,38 @@ def dedisperse(
         )
         outs.append(np.asarray(res))
     return np.concatenate(outs, axis=0)
+
+
+# --- audit registry: representative shapes for the contract engine
+# (peasoup_tpu/analysis/contracts.py); build thunks are lazy, nothing
+# traces at import time ---
+from .registry import register_program, sds  # noqa: E402
+
+register_program(
+    "ops.dedisperse.dedisperse_block",
+    lambda: (
+        dedisperse_block,
+        (sds((256, 8), "uint8"), sds((4, 8), "int32"), sds((8,), "float32")),
+        {"out_nsamps": 192},
+    ),
+)
+register_program(
+    "ops.dedisperse.unpack_fil_device",
+    lambda: (
+        unpack_fil_device,
+        (sds((128,), "uint8"),),
+        {"nbits": 2, "nsamps": 64, "nchans": 8},
+    ),
+)
+register_program(
+    "ops.dedisperse.subband_stage1",
+    lambda: (
+        _subband_stage1,
+        (
+            sds((2, 4, 512), "uint8"),
+            sds((2, 4), "float32"),
+            sds((2, 4), "int32"),
+        ),
+        {"nb1": 2},
+    ),
+)

@@ -137,7 +137,7 @@ def boxcar_snr(
         valid = (phases[None, :] < p0) & (w < p0)
         valid = jnp.broadcast_to(valid, sums.shape)
         snr_w = jnp.where(
-            valid, sums / (sigma * np.sqrt(float(w))), -jnp.inf
+            valid, sums / (sigma * np.float32(np.sqrt(w))), -jnp.inf
         )
         ph = jnp.argmax(snr_w, axis=-1).astype(jnp.int32)
         s_w = jnp.max(snr_w, axis=-1)
@@ -329,3 +329,12 @@ def collapse_periods(
         if all(abs(c.period - o.period) / o.period > tol for o in out):
             out.append(c)
     return out
+
+
+# --- audit registry: one octave program over a tiny fold grid ---
+from .registry import register_program, sds  # noqa: E402
+
+register_program(
+    "ops.ffa.octave",
+    lambda: (_octave_fn(8, (1, 2, 4)), (sds((2048,), "float32"),), {}),
+)

@@ -73,3 +73,16 @@ def fold_time_series_np(
     sums = np.bincount(flat, weights=x[:used].astype(np.float64), minlength=nints * nbins)
     counts = np.bincount(flat, minlength=nints * nbins) + 1.0
     return (sums / counts).reshape(nints, nbins)
+
+
+# --- audit registry ---
+from .registry import register_program, sds  # noqa: E402
+
+register_program(
+    "ops.fold.fold_time_series",
+    lambda: (
+        fold_time_series,
+        (sds((1024,), "float32"), sds((1024,), "int32")),
+        {"nbins": 16, "nints": 4},
+    ),
+)

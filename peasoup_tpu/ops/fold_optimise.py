@@ -102,7 +102,10 @@ def calculate_sn(
     """
     edge = int(width * 0.3 + 0.5)
     width_by_2 = int(width / 2.0 + 0.5)
-    rprof = np.array([prof[(bin - nbins // 2 + ii) % nbins] for ii in range(nbins)])
+    rprof = np.array(
+        [prof[(bin - nbins // 2 + ii) % nbins] for ii in range(nbins)],
+        dtype=prof.dtype,
+    )
     centre = nbins // 2 - 1
     upper = centre + (width_by_2 + edge)
     lower = centre - (width_by_2 + edge)
@@ -179,3 +182,31 @@ class FoldOptimiser:
                 )
             )
         return results
+
+
+# --- audit registry: the shift/template operands come from the module's
+# own host precompute (tiny at nbins=32) so the registered shapes stay
+# consistent with the builders ---
+from .registry import register_program  # noqa: E402
+
+
+def _example_optimise():
+    import jax
+
+    nbins, nints = 32, 8
+    shiftar = _shift_array(nbins, nints)
+    templates, _ = _templates_fft(nbins)
+    return (
+        _optimise_device,
+        (
+            jax.ShapeDtypeStruct((2, nints, nbins), np.float32),
+            shiftar.real.astype(np.float32),
+            shiftar.imag.astype(np.float32),
+            templates.real.astype(np.float32),
+            templates.imag.astype(np.float32),
+        ),
+        {"nbins": nbins, "nints": nints},
+    )
+
+
+register_program("ops.fold_optimise.optimise_device", _example_optimise)

@@ -277,3 +277,12 @@ def harmonic_sums(
             val = val + _gather_mxu(pp, nbins_pad, k, h)[..., :nbins]
         out.append(lvl_out(val, h))
     return out
+
+
+# --- audit registry ---
+from .registry import register_program, sds  # noqa: E402
+
+register_program(
+    "ops.harmonics.harmonic_sums",
+    lambda: (harmonic_sums, (sds((512,), "float32"),), {"nharms": 4}),
+)

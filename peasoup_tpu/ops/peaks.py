@@ -295,3 +295,50 @@ def cluster_peaks(
         peak_idx.append(cpeakidx)
         peak_snr.append(cpeak)
     return np.asarray(peak_idx, dtype=np.int64), np.asarray(peak_snr, dtype=np.float64)
+
+
+# --- audit registry ---
+from .registry import register_program, sds  # noqa: E402
+
+register_program(
+    "ops.peaks.find_peaks_device",
+    lambda: (
+        find_peaks_device,
+        (
+            sds((2, 256), "float32"),
+            sds((), "float32"),
+            sds((), "int32"),
+            sds((), "int32"),
+        ),
+        {"max_peaks": 64, "block": 64},
+    ),
+)
+register_program(
+    "ops.peaks.cluster_peaks_device",
+    lambda: (
+        cluster_peaks_device,
+        (sds((2, 64), "int32"), sds((2, 64), "float32"), sds((), "int32")),
+        {"min_gap": 30},
+    ),
+)
+register_program(
+    "ops.peaks.compact_peaks_device",
+    lambda: (
+        compact_peaks_device,
+        (sds((2, 64), "int32"), sds((2, 64), "float32"), sds((2,), "int32")),
+        {"total_pad": 128},
+    ),
+)
+register_program(
+    "ops.peaks.pack_chunk_results",
+    lambda: (
+        pack_chunk_results,
+        (
+            sds((2, 64), "int32"),
+            sds((2, 64), "float32"),
+            sds((2,), "int32"),
+            sds((2,), "int32"),
+        ),
+        {"total_pad": 128},
+    ),
+)

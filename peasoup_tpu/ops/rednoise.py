@@ -33,11 +33,18 @@ def median_scrunch5(x: jnp.ndarray) -> jnp.ndarray:
     if n == 2:
         return jnp.mean(x, axis=-1, keepdims=True)
     if n in (3, 4):
-        # median4 averages the two central values; jnp.median does too.
-        return jnp.median(x[..., :n], axis=-1, keepdims=True)
+        # median4 averages the two central values (bitwise identical
+        # to jnp.median's 0.5/0.5 linear interpolation at q=0.5)
+        s = jnp.sort(x[..., :n], axis=-1)
+        if n == 3:
+            return s[..., 1:2]
+        return 0.5 * (s[..., 1:2] + s[..., 2:3])
     m = n // 5
     blocks = x[..., : m * 5].reshape(*x.shape[:-1], m, 5)
-    return jnp.median(blocks, axis=-1)
+    # sort-and-take instead of jnp.median: the quantile position math
+    # runs in the weak float width (f64 under x64) and trips the
+    # audit's f64 contract; the middle order statistic is exact
+    return jnp.sort(blocks, axis=-1)[..., 2]
 
 
 def linear_stretch(x: jnp.ndarray, out_count: int) -> jnp.ndarray:
@@ -98,3 +105,26 @@ def whiten_fseries(x: jnp.ndarray, *, pos5: int, pos25: int) -> jnp.ndarray:
     fser = jnp.fft.rfft(x.astype(jnp.float32))
     med = running_median(form_power(fser), pos5=pos5, pos25=pos25)
     return deredden(fser, med)
+
+
+# --- audit registry ---
+from .registry import register_program, sds  # noqa: E402
+
+register_program(
+    "ops.rednoise.running_median",
+    lambda: (
+        running_median,
+        (sds((1024,), "float32"),),
+        {"pos5": 32, "pos25": 256},
+    ),
+)
+register_program(
+    "ops.rednoise.whiten_fseries",
+    # pos5/pos25 must stay static through the jit wrap (running_median
+    # takes them as static_argnames), so close over them
+    lambda: (
+        lambda x: whiten_fseries(x, pos5=8, pos25=64),
+        (sds((512,), "float32"),),
+        {},
+    ),
+)

@@ -1,0 +1,96 @@
+"""Registry of the jitted device programs in :mod:`peasoup_tpu.ops`.
+
+Every jitted entry point registers itself here with a **build thunk**
+that returns ``(fn, args, kwargs)`` over a tiny representative shape
+set (``ShapeDtypeStruct``\\ s — nothing is executed, only traced). The
+audit's contract engine (:mod:`peasoup_tpu.analysis.contracts`)
+abstract-evals each program and lints its jaxpr/StableHLO: no f64 ops,
+no unexpected host callbacks or custom calls, no oversized baked-in
+constants, donation matching the ``donate`` declaration.
+
+Registration is a one-liner at the bottom of each ops module, next to
+the program it describes, so adding a jitted entry point and
+registering it is the same diff. The thunks are lazy: nothing touches
+jax until the contract engine runs them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+# modules whose import populates the registry (ops/__init__ pulls in
+# all of these; listed explicitly so collect() works even if the
+# package re-exports change)
+_PROGRAM_MODULES = (
+    "peasoup_tpu.ops.dedisperse",
+    "peasoup_tpu.ops.spectrum",
+    "peasoup_tpu.ops.rednoise",
+    "peasoup_tpu.ops.zap",
+    "peasoup_tpu.ops.resample",
+    "peasoup_tpu.ops.harmonics",
+    "peasoup_tpu.ops.peaks",
+    "peasoup_tpu.ops.fold",
+    "peasoup_tpu.ops.fold_optimise",
+    "peasoup_tpu.ops.singlepulse",
+    "peasoup_tpu.ops.ffa",
+    "peasoup_tpu.ops.coincidence",
+    "peasoup_tpu.ops.correlate",
+)
+
+
+def sds(shape: tuple[int, ...], dtype: str):
+    """Shorthand for a ShapeDtypeStruct in registry build thunks."""
+    import jax
+    import numpy as np
+
+    return jax.ShapeDtypeStruct(shape, np.dtype(dtype))
+
+
+@dataclass(frozen=True)
+class ProgramSpec:
+    """One registered jitted program.
+
+    ``build`` returns ``(fn, args, kwargs)``; ``fn`` is either a
+    jit-wrapped callable (has ``.trace``) or a plain traceable
+    function the contract engine will wrap. ``donate`` lists argument
+    indices the DRIVER relies on being donated — the contract engine
+    fails the audit when declaration and lowering disagree in either
+    direction. ``allow_custom_calls`` extends the global custom-call
+    allowlist for this program only.
+    """
+
+    name: str
+    build: Callable[[], tuple[Callable, tuple, dict[str, Any]]]
+    donate: tuple[int, ...] = ()
+    allow_custom_calls: tuple[str, ...] = ()
+
+
+_REGISTRY: dict[str, ProgramSpec] = {}
+
+
+def register_program(
+    name: str,
+    build: Callable[[], tuple[Callable, tuple, dict[str, Any]]],
+    *,
+    donate: tuple[int, ...] = (),
+    allow_custom_calls: tuple[str, ...] = (),
+) -> None:
+    if name in _REGISTRY:
+        raise ValueError(f"duplicate program registration: {name}")
+    _REGISTRY[name] = ProgramSpec(
+        name=name,
+        build=build,
+        donate=tuple(donate),
+        allow_custom_calls=tuple(allow_custom_calls),
+    )
+
+
+def registered_programs() -> tuple[ProgramSpec, ...]:
+    """All registered programs, importing the ops modules first so
+    their registration side effects have happened."""
+    import importlib
+
+    for mod in _PROGRAM_MODULES:
+        importlib.import_module(mod)
+    return tuple(_REGISTRY[k] for k in sorted(_REGISTRY))

@@ -213,3 +213,44 @@ def resample_accel_quadratic(x: jnp.ndarray, af: jnp.ndarray) -> jnp.ndarray:
     shift = jnp.rint(af * quad).astype(jnp.int32)
     src = jnp.clip(jnp.arange(n, dtype=jnp.int32) + shift, 0, n - 1)
     return jnp.take(x, src)
+
+
+# --- audit registry ---
+from .registry import register_program, sds  # noqa: E402
+
+register_program(
+    "ops.resample.resample_accel",
+    lambda: (resample_accel, (sds((256,), "float32"), sds((4,), "float32")), {}),
+)
+register_program(
+    "ops.resample.resample_accel_quadratic",
+    lambda: (
+        resample_accel_quadratic,
+        (sds((256,), "float32"), sds((), "float32")),
+        {},
+    ),
+)
+register_program(
+    "ops.resample.resample_select",
+    lambda: (
+        resample_select,
+        (sds((4, 256), "float32"), sds((4, 3), "float32")),
+        {"smax": 4},
+    ),
+)
+register_program(
+    "ops.resample.resample_select_packed",
+    lambda: (
+        resample_select_packed,
+        (sds((4, 256), "float32"), sds((4, 3), "float32")),
+        {"smax": 4},
+    ),
+)
+register_program(
+    "ops.resample.resample_select_packed_planes",
+    lambda: (
+        resample_select_packed_planes,
+        (sds((4, 256), "float32"), sds((4, 3), "float32")),
+        {"smax": 4, "n1": 8, "n2": 16},
+    ),
+)

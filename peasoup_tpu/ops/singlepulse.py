@@ -253,3 +253,21 @@ def matched_filter_snr(amplitude: float, width: int, sigma: float) -> float:
     ``sigma`` — the oracle the injection-recovery test checks against:
     S/N = amplitude * sqrt(width) / sigma."""
     return float(amplitude) * float(np.sqrt(width)) / float(sigma)
+
+
+# --- audit registry: the per-block search program the spsearch driver
+# dispatches (jnp twin path), plus the normaliser standalone ---
+from .registry import register_program, sds  # noqa: E402
+
+register_program(
+    "ops.singlepulse.normalise_trials",
+    lambda: (normalise_trials, (sds((4, 1024), "float32"),), {}),
+)
+register_program(
+    "ops.singlepulse.single_pulse_search",
+    lambda: (
+        make_single_pulse_search_fn((1, 2, 4, 8), 7.0, 64, 8, 0),
+        (sds((2, 2048), "float32"),),
+        {},
+    ),
+)

@@ -60,3 +60,37 @@ def spectrum_stats(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarra
 def normalise(x: jnp.ndarray, mean: jnp.ndarray, std: jnp.ndarray) -> jnp.ndarray:
     """(x - mean) / std with broadcasting (kernels.cu:469-494)."""
     return (x - mean[..., None]) / std[..., None]
+
+
+# --- audit registry: these building blocks are pure jnp; the contract
+# engine stages each one standalone over a tiny shape set ---
+from .registry import register_program, sds  # noqa: E402
+
+register_program(
+    "ops.spectrum.form_power",
+    lambda: (form_power, (sds((128,), "complex64"),), {}),
+)
+register_program(
+    "ops.spectrum.form_interpolated",
+    lambda: (form_interpolated, (sds((128,), "complex64"),), {}),
+)
+register_program(
+    "ops.spectrum.form_interpolated_parts",
+    lambda: (
+        form_interpolated_parts,
+        (sds((128,), "float32"), sds((128,), "float32")),
+        {},
+    ),
+)
+register_program(
+    "ops.spectrum.spectrum_stats",
+    lambda: (spectrum_stats, (sds((4, 128), "float32"),), {}),
+)
+register_program(
+    "ops.spectrum.normalise",
+    lambda: (
+        normalise,
+        (sds((4, 128), "float32"), sds((4,), "float32"), sds((4,), "float32")),
+        {},
+    ),
+)

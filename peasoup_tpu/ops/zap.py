@@ -42,3 +42,12 @@ def birdie_mask(
 def zap_birdies(fseries: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
     """Replace masked bins of the complex spectrum with 1+0j."""
     return jnp.where(mask, jnp.asarray(1.0 + 0.0j, dtype=fseries.dtype), fseries)
+
+
+# --- audit registry ---
+from .registry import register_program, sds  # noqa: E402
+
+register_program(
+    "ops.zap.zap_birdies",
+    lambda: (zap_birdies, (sds((128,), "complex64"), sds((128,), "bool")), {}),
+)

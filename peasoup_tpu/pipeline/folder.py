@@ -146,7 +146,9 @@ class MultiFolder:
             xr = jax.vmap(lambda af: resample_accel_quadratic(xd, af))(
                 jnp.asarray(afs)
             )  # (K_pad, N)
-            periods = np.array([1.0 / cands[ci].freq for ci in ids_pad])
+            periods = np.array(
+                [1.0 / cands[ci].freq for ci in ids_pad], dtype=np.float64
+            )
             used = self.nints * (self.nsamps // self.nints)
             flat_bins = np.stack(
                 [

@@ -1,0 +1,172 @@
+"""``peasoup-audit`` — the static-analysis gate.
+
+Runs both engines (AST lints + jitted-program contracts) over the
+repo, applies the baseline ratchet, prints a human report and
+optionally writes the versioned ``audit.json``.
+
+Exit codes (scripts/check.sh relies on these):
+
+* ``0`` — clean: no findings outside the baseline
+* ``1`` — new findings (or, with ``--strict-resolved``, stale baseline
+  entries that should be ratcheted down)
+* ``2`` — internal error (engine crash, unreadable baseline, bad args)
+
+Usage::
+
+    python -m peasoup_tpu.tools.audit --baseline audit_baseline.json
+    python -m peasoup_tpu.tools.audit --write-baseline   # accept debt
+    python -m peasoup_tpu.tools.audit --list-rules
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import traceback
+
+
+def _repo_root() -> str:
+    # tools/ -> peasoup_tpu/ -> repo root
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="peasoup-audit",
+        description=(
+            "JAX-hazard static analysis: AST lints + jitted-program "
+            "jaxpr/StableHLO contract checks"
+        ),
+    )
+    p.add_argument(
+        "--root",
+        default=_repo_root(),
+        help="repo root to audit (default: the installed tree)",
+    )
+    p.add_argument(
+        "--baseline",
+        default=None,
+        help="ratchet baseline JSON (missing file = empty baseline)",
+    )
+    p.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="rewrite --baseline from the current findings and exit 0",
+    )
+    p.add_argument(
+        "--json",
+        dest="json_path",
+        default=None,
+        metavar="PATH",
+        help="write the versioned audit.json report here",
+    )
+    p.add_argument(
+        "--rules",
+        default=None,
+        help="comma-separated rule IDs to run (default: all)",
+    )
+    p.add_argument(
+        "--no-contracts",
+        action="store_true",
+        help="skip engine 2 (program contract checks)",
+    )
+    p.add_argument(
+        "--no-ast",
+        action="store_true",
+        help="skip engine 1 (AST lints)",
+    )
+    p.add_argument(
+        "--max-const-bytes",
+        type=int,
+        default=None,
+        help="baked-in constant size threshold (default 1 MiB)",
+    )
+    p.add_argument(
+        "--strict-resolved",
+        action="store_true",
+        help="fail (exit 1) when baseline entries no longer match",
+    )
+    p.add_argument(
+        "-v",
+        "--verbose",
+        action="store_true",
+        help="print baselined findings in full",
+    )
+    p.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the rule table and exit",
+    )
+    return p
+
+
+def _list_rules() -> int:
+    from peasoup_tpu.analysis.astlint import rule_classes
+
+    for rule_id, cls in sorted(rule_classes().items()):
+        print(f"{rule_id}  [{cls.severity:7s}]  {cls.title}")
+        if cls.fix_hint:
+            print(f"        hint: {cls.fix_hint}")
+    print(
+        "PSC101-PSC105 (contract engine): f64 ops, host callbacks / "
+        "unexpected custom calls, oversized baked-in constants, "
+        "donation mismatch, trace failure"
+    )
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        return _list_rules()
+    try:
+        from peasoup_tpu.analysis.findings import Baseline
+        from peasoup_tpu.analysis.runner import (
+            render_text,
+            run_audit,
+            write_report,
+        )
+
+        rule_ids = None
+        if args.rules:
+            rule_ids = [r.strip() for r in args.rules.split(",") if r.strip()]
+        result = run_audit(
+            args.root,
+            rule_ids=rule_ids,
+            ast_engine=not args.no_ast,
+            contracts=not args.no_contracts,
+            baseline_path=args.baseline,
+            max_const_bytes=args.max_const_bytes,
+        )
+        if args.write_baseline:
+            if not args.baseline:
+                print(
+                    "peasoup-audit: --write-baseline requires --baseline",
+                    file=sys.stderr,
+                )
+                return 2
+            Baseline.from_findings(result.findings).save(args.baseline)
+            print(
+                f"peasoup-audit: baseline written to {args.baseline} "
+                f"({len(result.findings)} finding(s) tolerated)"
+            )
+            return 0
+        if args.json_path:
+            write_report(result, args.json_path)
+        print(render_text(result, verbose=args.verbose))
+        if result.new:
+            return 1
+        if args.strict_resolved and result.resolved:
+            return 1
+        return 0
+    except Exception:
+        traceback.print_exc()
+        print("peasoup-audit: internal error (exit 2)", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

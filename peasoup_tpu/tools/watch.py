@@ -74,6 +74,7 @@ def render_status(st: dict, stale_after: float = 0.0) -> str:
             f"  *** STALLED: no progress for "
             f"{st.get('last_progress_age_s', 0.0):.0f}s ***"
         )
+    # audit: ignore[PSA006] -- staleness vs an on-disk epoch stamp
     age = time.time() - st.get("updated_unix", time.time())
     if stale_after and age > stale_after:
         lines.append(
@@ -139,6 +140,7 @@ def render_campaign_status(st: dict, stale_after: float = 0.0) -> str:
             f"  QUARANTINED {ql.get('job_id')} after "
             f"{ql.get('attempts')} attempts: {ql.get('last_error')}"
         )
+    # audit: ignore[PSA006] -- staleness vs an on-disk epoch stamp
     age = time.time() - st.get("updated_unix", time.time())
     if stale_after and age > stale_after:
         lines.append(

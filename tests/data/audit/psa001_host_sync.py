@@ -1,0 +1,33 @@
+# audit-path: peasoup_tpu/ops/fixture_host_sync.py
+"""Fixture: PSA001 — host syncs inside jitted/scan bodies."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def jitted_item(x):
+    return x.sum().item()  # expect[PSA001]
+
+
+@partial(jax.jit, static_argnames=("n",))
+def jitted_mixed(x, n):
+    y = float(x)  # expect[PSA001]
+    z = jax.device_get(x)  # expect[PSA001]
+    w = np.asarray(x)  # expect[PSA001]
+    k = float(n)  # ok: n is a static argument
+    m = int(x.shape[0])  # ok: shape metadata is concrete
+    return y, z, w, k, m
+
+
+def scan_user(xs):
+    def body(c, x):
+        return c + x.item(), None  # expect[PSA001]
+
+    return jax.lax.scan(body, 0.0, xs)
+
+
+def host_driver(x):
+    return float(np.asarray(x).sum())  # ok: plain host code, not jitted

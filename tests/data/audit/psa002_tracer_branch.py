@@ -1,0 +1,36 @@
+# audit-path: peasoup_tpu/ops/fixture_tracer_branch.py
+"""Fixture: PSA002 — Python control flow on tracer values."""
+from functools import partial
+
+import jax
+
+
+@jax.jit
+def branch_on_tracer(x):
+    if x.sum() > 0:  # expect[PSA002]
+        return x
+    return -x
+
+
+@jax.jit
+def loop_on_tracer(x):
+    while x > 0:  # expect[PSA002]
+        x = x - 1
+    return x
+
+
+@partial(jax.jit, static_argnames=("flag",))
+def static_and_structural(x, flag):
+    if flag:  # ok: static argument
+        return x * 2
+    if x is None:  # ok: structural None check
+        return x
+    if x.ndim == 2:  # ok: shape metadata
+        return x.sum(axis=0)
+    return x
+
+
+def host_branch(x):
+    if x > 0:  # ok: not jitted
+        return x
+    return -x

@@ -1,0 +1,27 @@
+# audit-path: peasoup_tpu/ops/fixture_float64.py
+"""Fixture: PSA003 — float64 in device code."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def f64_in_jit(x):
+    y = x.astype(np.float64)  # expect[PSA003]
+    z = x * np.float64(2.0)  # expect[PSA003]
+    w = jnp.zeros(4, dtype="float64")  # expect[PSA003]
+    return y, z, w
+
+
+def jnp_f64_on_host(x):
+    return jnp.float64(x)  # expect[PSA003]
+
+
+def host_staging(vals):
+    k = np.arange(8, dtype=np.float64)  # ok: host staging math
+    return np.asarray(vals, dtype=np.float64) + k  # ok: host f64
+
+
+@jax.jit
+def f32_everywhere(x):
+    return x.astype(jnp.float32) * np.float32(2.0)  # ok: f32

@@ -1,0 +1,11 @@
+# audit-path: peasoup_tpu/ops/fixture_np_array.py
+"""Fixture: PSA004 — dtype-less np.array literals."""
+import numpy as np
+
+
+def stage_constants(existing):
+    a = np.array([1.0, 2.0, 3.0])  # expect[PSA004]
+    b = np.array([x * 2 for x in range(4)])  # expect[PSA004]
+    c = np.array([1.0, 2.0], dtype=np.float32)  # ok: explicit dtype
+    d = np.asarray(existing)  # ok: conversion, not a literal
+    return a, b, c, d

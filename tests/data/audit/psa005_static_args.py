@@ -1,0 +1,27 @@
+# audit-path: peasoup_tpu/ops/fixture_static_args.py
+"""Fixture: PSA005 — non-hashable / array-valued static jit args."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, static_argnames=("widths",))
+def mutable_default(x, widths=[1, 2, 4]):  # expect[PSA005]
+    return x * len(widths)
+
+
+@partial(jax.jit, static_argnames=("mask",))
+def array_static(x, mask: jax.Array):  # expect[PSA005]
+    return x * mask
+
+
+def helper(x, n):
+    return x * n
+
+
+jitted_helper = jax.jit(helper, static_argnums=[1])  # expect[PSA005]
+
+
+@partial(jax.jit, static_argnames=("n", "mode"))
+def good_static(x, n: int = 4, mode: str = "conv"):  # ok: hashable
+    return x * n

@@ -1,0 +1,22 @@
+# audit-path: peasoup_tpu/obs/fixture_time_time.py
+"""Fixture: PSA006 — time.time() where perf_counter is required."""
+import time
+
+
+def measure(fn):
+    t0 = time.time()  # expect[PSA006]
+    fn()
+    return time.time() - t0  # expect[PSA006]
+
+
+class Snapshotter:
+    def stamp(self):
+        self.created_unix = time.time()  # ok: epoch timestamp
+        now = time.time()  # ok: conventional epoch name
+        return {"updated_unix": time.time(), "now": now}  # ok: epoch
+
+
+def right_way(fn):
+    t0 = time.perf_counter()  # ok: monotonic duration clock
+    fn()
+    return time.perf_counter() - t0

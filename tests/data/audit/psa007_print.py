@@ -1,0 +1,10 @@
+# audit-path: peasoup_tpu/pipeline/fixture_print.py
+"""Fixture: PSA007 — print() in library code."""
+from peasoup_tpu.obs.log import get_logger
+
+log = get_logger("fixture")
+
+
+def report(x):
+    print("value", x)  # expect[PSA007]
+    log.info("value %s", x)  # ok: the library logger

@@ -1,0 +1,26 @@
+# audit-path: peasoup_tpu/obs/fixture_atomic_write.py
+"""Fixture: PSA008 — non-atomic JSON writes to shared files."""
+import json
+import os
+
+
+def write_status(path, doc):
+    with open(path, "w") as f:
+        json.dump(doc, f)  # expect[PSA008]
+
+
+def write_status_dumps(path, doc):
+    with open(path, "w") as f:
+        f.write(json.dumps(doc))  # expect[PSA008]
+
+
+def write_atomic(path, doc):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)  # ok: os.replace below makes it atomic
+    os.replace(tmp, path)
+
+
+def read_back(path):
+    with open(path) as f:  # ok: read mode
+        return json.load(f)

@@ -1,0 +1,30 @@
+# audit-path: peasoup_tpu/obs/fixture_thread_lock.py
+"""Fixture: PSA009 — thread-shared mutation outside a lock."""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._count = 0
+        self._items = []
+        self._lock = threading.Lock()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run)
+        self._thread.start()
+
+    def _run(self):
+        self._count += 1  # expect[PSA009]
+        self._items.append(1)  # expect[PSA009]
+        with self._lock:
+            self._count += 1  # ok: guarded
+            self._items.append(2)  # ok: guarded
+
+
+class NotThreaded:
+    def __init__(self):
+        self._count = 0
+
+    def bump(self):
+        self._count += 1  # ok: no thread spawned by this class

@@ -1,0 +1,18 @@
+# audit-path: peasoup_tpu/ops/fixture_numpy_tracer.py
+"""Fixture: PSA010 — numpy ops applied to tracers inside jit."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def np_on_tracer(x):
+    m = np.sum(x)  # expect[PSA010]
+    c = np.clip(x, 0.0, 1.0)  # expect[PSA010]
+    s = np.float32(2.0)  # ok: host scalar constant
+    k = np.log2(x.shape[0])  # ok: shape metadata is concrete
+    return m + jnp.sum(c) * s * k
+
+
+def host_numpy(x):
+    return np.sum(x)  # ok: not jitted
