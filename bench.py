@@ -482,9 +482,28 @@ def main() -> int:
     # fetch sizes, which changes compiled shapes — the second run
     # compiles at the learned sizes, so the timed runs below are
     # compile-free (a single warm-up left a ~2 s XLA compile inside the
-    # first timed run, profiled in r3).
-    search.run(fil)
-    warm = search.run(fil)
+    # first timed run, profiled in r3). Telemetry around the warm-ups
+    # splits compile cost out of the record: backend-compile count and
+    # seconds, persistent-cache hits vs misses (a cache-served compile
+    # is a disk deserialise, not XLA work — the trajectory should
+    # distinguish compile-cache wins from kernel wins).
+    from peasoup_tpu.obs.telemetry import (
+        RunTelemetry,
+        persistent_cache_counters,
+    )
+
+    tel = RunTelemetry()
+    t0 = time.time()
+    with tel.activate():
+        search.run(fil)
+        warm = search.run(fil)
+    first_run_wall_s = time.time() - t0
+    cache_hits, cache_misses = persistent_cache_counters(tel)
+    compile_events = {
+        k: v for k, v in tel.jit.items() if "backend_compile" in k
+    }
+    compile_count = int(sum(v[0] for v in compile_events.values()))
+    compile_backend_s = float(sum(v[1] for v in compile_events.values()))
 
     # Steady-state timing: MEDIAN of 5 runs (the chip sits behind a
     # shared tunnel with +-20-30% wall-clock noise; r02's best-of-3
@@ -606,6 +625,18 @@ def main() -> int:
                 "wall_median_s": round(searching, 3),
                 "wall_all_s": [round(t, 3) for t in times],
                 "wall_trials_per_sec": round(wall_value, 2),
+                # compile/execute split (both warm-up runs): wall of
+                # the warm-up phase vs the steady-state medians above,
+                # backend-compile seconds by jax.monitoring, and the
+                # persistent compilation cache's hit/miss tally (hits
+                # deserialise from utils/cache.py's on-disk cache —
+                # an AOT-warmed or second bench process shows ~all
+                # hits and a collapsed warmup wall)
+                "warmup_wall_s": round(first_run_wall_s, 3),
+                "compile_programs": compile_count,
+                "compile_backend_s": round(compile_backend_s, 3),
+                "persistent_cache_hits": cache_hits,
+                "persistent_cache_misses": cache_misses,
                 "production_dedupe_wall_median_s": round(dedupe_median, 3),
                 "production_dedupe_device_busy_s": round(dedupe_device_s, 3),
                 "production_dedupe_device_all_s": [

@@ -81,6 +81,8 @@ def build_status(root: str, queue: JobQueue | None = None) -> dict:
             eta_s = round(remaining / throughput, 3) if remaining else 0.0
 
     n_candidates = sum(int(d.get("n_candidates", 0) or 0) for d in done)
+    warmup_s = sum(float(d.get("warmup_s", 0) or 0) for d in done)
+    warmed_jobs = sum(1 for d in done if d.get("warmup_s") is not None)
     quarantined = [
         {
             "job_id": q.get("job_id"),
@@ -102,6 +104,10 @@ def build_status(root: str, queue: JobQueue | None = None) -> dict:
         "throughput_jobs_per_s": throughput,
         "eta_s": eta_s,
         "candidates_total": n_candidates,
+        # AOT warmup rollup: seconds spent compiling ahead of data
+        # across all workers' first-of-bucket jobs (perf/warmup.py)
+        "warmup_total_s": round(warmup_s, 3),
+        "warmup_jobs": warmed_jobs,
     }
 
 

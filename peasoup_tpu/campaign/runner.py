@@ -74,6 +74,17 @@ class CampaignConfig:
     backoff_base_s: float = 2.0
     heartbeat_interval: float = 2.0
     bucket_nsamps: list | None = None  # explicit ladder override
+    # AOT warmup: compile a new bucket's programs on a background
+    # thread (overlapping the first observation's filterbank read)
+    # before the pipeline touches data — the first job of a warmed
+    # bucket then reports jit_programs_compiled == 0 like its
+    # successors. "dryrun" runs the real pipeline once over a
+    # synthetic bucket-shaped observation (exact: every driver-side
+    # shape traces); "aot" only lower().compile()s the registry
+    # through its ShapeCtx hooks (cheaper: no data execution, but
+    # driver-internal shapes are approximated). See perf/warmup.py.
+    warmup: bool = True
+    warmup_mode: str = "dryrun"  # "dryrun" | "aot"
 
     def to_doc(self) -> dict:
         return {
@@ -268,21 +279,32 @@ def _build_config(cls, overrides: dict, **fixed):
 
 
 def jit_programs_compiled(tel: RunTelemetry) -> int:
-    """Backend programs compiled during this telemetry's run (the
-    jax.monitoring backend_compile counter). Zero on a job whose every
-    program came out of the in-process jit caches."""
-    return int(
+    """Backend programs REALLY compiled during this telemetry's run:
+    the jax.monitoring backend_compile counter minus persistent-cache
+    hits (a cache hit still emits a backend_compile duration event
+    while it deserialises the stored executable, but no XLA compile
+    ran). Zero on a job whose every program came out of the in-process
+    jit caches or the warmed persistent cache."""
+    from ..obs.telemetry import persistent_cache_counters
+
+    compiled = int(
         sum(v[0] for k, v in tel.jit.items() if "backend_compile" in k)
     )
+    hits, _ = persistent_cache_counters(tel)
+    return max(0, compiled - hits)
 
 
 def run_observation(
     job: Job, overrides: dict, job_dir: str, tel: RunTelemetry,
     bucket_ladder: list[int] | None = None,
+    warmer: "_BucketWarmer | None" = None,
 ) -> dict:
     """Execute one observation end-to-end inside this process and write
     its outputs (overview.xml + pipeline-specific candidate files)
-    under ``job_dir``. Returns the done-record info dict."""
+    under ``job_dir``. Returns the done-record info dict. ``warmer``
+    is an in-flight bucket warmup joined after the filterbank read —
+    I/O and compile overlap — whose stats land in the telemetry and
+    done record."""
     from ..io.output import (
         CandidateFileWriter,
         OutputFileWriter,
@@ -307,6 +329,18 @@ def run_observation(
         tel.event(
             "campaign_pad", orig_nsamps=orig_nsamps,
             padded_nsamps=int(fil.nsamps),
+        )
+
+    warmup_stats = None
+    if warmer is not None:
+        tel.set_stage("warmup")
+        warmup_stats = warmer.result()
+        tel.event("warmup", **warmup_stats)
+        tel.add_timer("warmup", float(warmup_stats["seconds"]))
+        tel.gauge("warmup.seconds", float(warmup_stats["seconds"]))
+        tel.gauge(
+            "warmup.programs_compiled",
+            int(warmup_stats["programs_compiled"]),
         )
 
     outdir = job_dir.rstrip("/")
@@ -367,13 +401,52 @@ def run_observation(
         n_cands = len(result.candidates)
 
     tel.gauge("candidates.written", n_cands)
-    return {
+    info = {
         "n_candidates": n_cands,
         "pipeline": job.pipeline,
         "bucket": list(job.bucket) if job.bucket else None,
         "duration_s": round(time.perf_counter() - t0, 3),
         "padded_from": orig_nsamps if fil.nsamps != orig_nsamps else None,
     }
+    if warmup_stats is not None:
+        info["warmup_s"] = float(warmup_stats["seconds"])
+        info["warmup"] = warmup_stats
+    return info
+
+
+class _BucketWarmer(threading.Thread):
+    """Background AOT warmup for one shape bucket, started when a
+    worker claims the first job of a bucket it has not warmed yet. It
+    overlaps the job's filterbank read: the driver joins (``result``)
+    after reading, before the pipeline dispatches. Runs on its own
+    thread context, so its compiles never count against the job's
+    telemetry JIT stats — by the time the pipeline runs, every program
+    is in the in-process jit caches (dryrun) or the persistent
+    compilation cache (aot)."""
+
+    def __init__(
+        self, bucket: tuple, pipeline: str, overrides: dict,
+        scratch_dir: str, mode: str,
+    ) -> None:
+        super().__init__(name="campaign-warmup", daemon=True)
+        self._args = (bucket, pipeline, overrides, scratch_dir, mode)
+        self._stats: dict | None = None
+
+    def run(self) -> None:
+        from ..perf.warmup import warm_bucket
+
+        self._stats = warm_bucket(*self._args)
+
+    def result(self, timeout: float | None = None) -> dict:
+        self.join(timeout=timeout)
+        if self._stats is None:  # thread died before warm_bucket ran
+            bucket, _, _, _, mode = self._args
+            return {
+                "bucket": list(bucket), "mode": mode, "seconds": 0.0,
+                "programs_compiled": 0, "cache_hits": 0,
+                "error": "warmup thread produced no result",
+            }
+        return self._stats
 
 
 class _LeaseRenewer(threading.Thread):
@@ -418,6 +491,7 @@ class CampaignRunner:
         )
         self.worker_id = worker_id or JobQueue.default_worker_id()
         self._last_bucket: tuple | None = None
+        self._warmed_buckets: set[tuple] = set()
         # the persistent XLA cache backs the in-process caches across
         # worker restarts (utils/cache.py)
         from ..utils.cache import enable_compilation_cache
@@ -445,6 +519,23 @@ class CampaignRunner:
         )
         renewer = _LeaseRenewer(self.queue, claim)
         renewer.start()
+        warmer = None
+        if (
+            self.campaign.warmup
+            and job.bucket
+            and tuple(job.bucket) not in self._warmed_buckets
+        ):
+            # first job of a bucket this worker has not warmed: compile
+            # its programs on a background thread while the filterbank
+            # reads (run_observation joins before dispatching)
+            warmer = _BucketWarmer(
+                tuple(job.bucket), job.pipeline,
+                {**self.campaign.config, **job.config},
+                os.path.join(self.root, "warmup", job.job_id),
+                self.campaign.warmup_mode,
+            )
+            warmer.start()
+            self._warmed_buckets.add(tuple(job.bucket))
         recorder = FlightRecorder(
             tel,
             os.path.join(job_dir, "flight.json"),
@@ -462,6 +553,7 @@ class CampaignRunner:
                     info = run_observation(
                         job, overrides, job_dir, tel,
                         bucket_ladder=self.campaign.bucket_nsamps,
+                        warmer=warmer,
                     )
                     compiled = jit_programs_compiled(tel)
                     info["jit_programs_compiled"] = compiled

@@ -86,6 +86,18 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--bucket-nsamps", default=None,
                      help="comma-separated explicit nsamps bucket ladder "
                      "(default: powers of two and 3*2^(k-1))")
+    run.add_argument("--warmup", action=argparse.BooleanOptionalAction,
+                     default=True,
+                     help="AOT-compile each new bucket's programs on a "
+                     "background thread before its first job touches "
+                     "data (default on; --no-warmup disables)")
+    run.add_argument("--warmup-mode", default="dryrun",
+                     choices=["dryrun", "aot"],
+                     help="dryrun = run the pipeline once over a "
+                     "synthetic bucket-shaped observation (exact, "
+                     "costs one observation's device work); aot = "
+                     "lower+compile the registry at bucket shapes only "
+                     "(cheap, approximate) (default dryrun)")
     run.add_argument("--max-jobs", type=int, default=None,
                      help="stop this worker after N jobs (default: run "
                      "until the campaign drains)")
@@ -156,6 +168,8 @@ def _cmd_run(args) -> int:
             max_attempts=args.max_attempts,
             backoff_base_s=args.backoff,
             bucket_nsamps=ladder,
+            warmup=args.warmup,
+            warmup_mode=args.warmup_mode,
         ),
     )
     queue = JobQueue(

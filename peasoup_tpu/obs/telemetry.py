@@ -60,6 +60,12 @@ _ACTIVE: contextvars.ContextVar["RunTelemetry | None"] = (
 # on a slow cache hit and don't belong in a compile-time table.
 _JIT_EVENT_KEYS = ("compile", "lower")
 _JIT_EVENT_SKIP = ("saved",)
+# count events worth keeping as counters: persistent compilation-cache
+# traffic. A cache HIT still emits a backend_compile duration event
+# (the executable deserialises inside the compile path), so "programs
+# really compiled" is backend_compile count minus cache_hits — the
+# split campaign done-records and bench.py report.
+_JIT_COUNT_EVENT_MARK = "/jax/compilation_cache/"
 _jit_listener_installed = False
 
 
@@ -90,8 +96,24 @@ def _install_jit_listener() -> None:
                 tel.record_jit(event, float(duration))
 
         monitoring.register_event_duration_secs_listener(_on_duration)
+
+        def _on_event(event: str, **kw) -> None:
+            tel = _ACTIVE.get()
+            if tel is not None and _JIT_COUNT_EVENT_MARK in event:
+                tel.incr(event.strip("/").replace("/", "."))
+
+        monitoring.register_event_listener(_on_event)
     except Exception:
         pass  # no monitoring API: manifests simply lack jit stats
+
+
+def persistent_cache_counters(tel: "RunTelemetry") -> tuple[int, int]:
+    """(hits, misses) of the persistent XLA compilation cache recorded
+    by this telemetry's run — both 0 when the cache is disabled."""
+    return (
+        int(tel.counters.get("jax.compilation_cache.cache_hits", 0)),
+        int(tel.counters.get("jax.compilation_cache.cache_misses", 0)),
+    )
 
 
 class RunTelemetry:

@@ -587,9 +587,42 @@ def dedisperse(
 
 
 # --- audit registry: representative shapes for the contract engine
-# (peasoup_tpu/analysis/contracts.py); build thunks are lazy, nothing
-# traces at import time ---
+# (peasoup_tpu/analysis/contracts.py) plus ShapeCtx hooks so the AOT
+# warmup (peasoup_tpu/perf/warmup.py) can compile at a campaign
+# bucket's production geometry; build thunks are lazy, nothing traces
+# at import time ---
 from .registry import register_program, sds  # noqa: E402
+
+
+def _param_dedisperse_block(ctx):
+    # the single-channel-chunk driver path: full filterbank against
+    # one dedisp_block of delay rows, quantized at the bucket's
+    # data-independent output scale (scale is a static argname, so it
+    # is part of the compiled program's identity)
+    d = max(1, min(ctx.dedisp_block, ctx.ndm))
+    return (
+        dedisperse_block,
+        (
+            sds((ctx.nsamps, ctx.nchans), "uint8"),
+            sds((d, ctx.nchans), "int32"),
+            sds((ctx.nchans,), "float32"),
+        ),
+        {
+            "out_nsamps": ctx.out_nsamps,
+            "scale": output_scale(ctx.nbits, ctx.nchans),
+        },
+    )
+
+
+def _param_unpack(ctx):
+    if ctx.nbits not in (1, 2, 4):  # byte data uploads unpacked
+        return None
+    return (
+        unpack_fil_device,
+        (sds((ctx.nsamps * ctx.nchans * ctx.nbits // 8,), "uint8"),),
+        {"nbits": ctx.nbits, "nsamps": ctx.nsamps, "nchans": ctx.nchans},
+    )
+
 
 register_program(
     "ops.dedisperse.dedisperse_block",
@@ -598,6 +631,7 @@ register_program(
         (sds((256, 8), "uint8"), sds((4, 8), "int32"), sds((8,), "float32")),
         {"out_nsamps": 192},
     ),
+    param=_param_dedisperse_block,
 )
 register_program(
     "ops.dedisperse.unpack_fil_device",
@@ -606,6 +640,7 @@ register_program(
         (sds((128,), "uint8"),),
         {"nbits": 2, "nsamps": 64, "nchans": 8},
     ),
+    param=_param_unpack,
 )
 register_program(
     "ops.dedisperse.subband_stage1",
@@ -617,5 +652,28 @@ register_program(
             sds((2, 4), "int32"),
         ),
         {"nb1": 2},
+    ),
+)
+register_program(
+    "ops.dedisperse.subband_stage1_batched",
+    lambda: (
+        _stage1_batched(2),
+        (
+            sds((2, 4, 512), "uint8"),
+            sds((2, 4), "float32"),
+            sds((3, 2, 4), "int32"),  # vmapped over DM groups
+        ),
+        {},
+    ),
+)
+register_program(
+    "ops.dedisperse.subband_stage2",
+    lambda: (
+        _stage2_batched(192, True, 1.0),
+        (
+            sds((2, 4, 4, 128), "float32"),  # (G, S, T/128, 128) blocked
+            sds((2, 3, 4), "int32"),  # (G, D, S) stage-2 delays
+        ),
+        {},
     ),
 )

@@ -3,15 +3,27 @@
 Every jitted entry point registers itself here with a **build thunk**
 that returns ``(fn, args, kwargs)`` over a tiny representative shape
 set (``ShapeDtypeStruct``\\ s — nothing is executed, only traced). The
-audit's contract engine (:mod:`peasoup_tpu.analysis.contracts`)
-abstract-evals each program and lints its jaxpr/StableHLO: no f64 ops,
-no unexpected host callbacks or custom calls, no oversized baked-in
-constants, donation matching the ``donate`` declaration.
+registry feeds three consumers:
+
+* the audit's contract engine (:mod:`peasoup_tpu.analysis.contracts`)
+  abstract-evals each program and lints its jaxpr/StableHLO: no f64
+  ops, no unexpected host callbacks or custom calls, no oversized
+  baked-in constants, donation matching the ``donate`` declaration;
+* the AOT warmup pass (:mod:`peasoup_tpu.perf.warmup`)
+  ``lower().compile()``\\ s every program ahead of time, populating the
+  persistent compilation cache so later processes cold-start warm —
+  optionally at the **production shapes** of a campaign bucket via the
+  per-program :class:`ShapeCtx` parameterisation hook;
+* the per-program microbenchmarks (:mod:`peasoup_tpu.perf.microbench`)
+  execute each program over materialised representative arrays and
+  ratchet the timings in CI (``peasoup-perf``).
 
 Registration is a one-liner at the bottom of each ops module, next to
 the program it describes, so adding a jitted entry point and
-registering it is the same diff. The thunks are lazy: nothing touches
-jax until the contract engine runs them.
+registering it is the same diff — and :func:`unregistered_entry_points`
+(gated in CI by ``peasoup-perf check`` and tests/test_perf.py) catches
+any top-level jitted program that skips it. The thunks are lazy:
+nothing touches jax until a consumer runs them.
 """
 
 from __future__ import annotations
@@ -48,6 +60,32 @@ def sds(shape: tuple[int, ...], dtype: str):
 
 
 @dataclass(frozen=True)
+class ShapeCtx:
+    """Concrete production geometry for parameterised AOT warmup.
+
+    One ShapeCtx describes the shapes a campaign bucket implies
+    (:func:`peasoup_tpu.perf.warmup.shape_ctx_for_bucket` derives it
+    from a bucket key + pipeline config using the drivers' own plan
+    machinery). A program's ``param`` hook maps the ctx to the build
+    spec the driver would trace at those shapes, so warmup compiles
+    the production programs, not the tiny representative ones.
+    """
+
+    nsamps: int  # padded observation length (the bucket rung)
+    nchans: int
+    nbits: int
+    ndm: int  # DM trials in the plan
+    out_nsamps: int  # dedispersed trial length
+    dm_block: int  # DM trials per device wave (driver formula)
+    dedisp_block: int  # dedispersion DM-block size
+    widths: tuple[int, ...] = ()  # single-pulse boxcar bank
+    min_snr: float = 6.0
+    max_events: int = 256
+    decimate: int = 32
+    pallas_span: int = 0
+
+
+@dataclass(frozen=True)
 class ProgramSpec:
     """One registered jitted program.
 
@@ -57,16 +95,51 @@ class ProgramSpec:
     indices the DRIVER relies on being donated — the contract engine
     fails the audit when declaration and lowering disagree in either
     direction. ``allow_custom_calls`` extends the global custom-call
-    allowlist for this program only.
+    allowlist for this program only. ``param`` is the optional
+    shape-parameterisation hook: given a :class:`ShapeCtx` it returns
+    the build spec at that production geometry (or None when the
+    program does not apply to the ctx, e.g. the sub-byte unpacker on
+    an 8-bit bucket).
     """
 
     name: str
     build: Callable[[], tuple[Callable, tuple, dict[str, Any]]]
     donate: tuple[int, ...] = ()
     allow_custom_calls: tuple[str, ...] = ()
+    param: (
+        Callable[[ShapeCtx], tuple[Callable, tuple, dict[str, Any]] | None]
+        | None
+    ) = None
+
+    def build_for(
+        self, ctx: ShapeCtx | None = None
+    ) -> tuple[Callable, tuple, dict[str, Any]] | None:
+        """The build spec at ``ctx`` shapes via the ``param`` hook, or
+        the representative spec when no ctx is given. None when the
+        program has no parameterisation for this ctx (ctx-mode callers
+        skip it rather than warm an irrelevant shape)."""
+        if ctx is None:
+            return self.build()
+        if self.param is None:
+            return None
+        return self.param(ctx)
 
 
 _REGISTRY: dict[str, ProgramSpec] = {}
+
+# Top-level jitted entry points whose compiled program registers under
+# a different public name (builder-pattern factories). Keyed by
+# "ops.<module>.<function>" as detected by unregistered_entry_points().
+REGISTRY_ALIASES = {
+    "ops.ffa._octave_fn": "ops.ffa.octave",
+    "ops.singlepulse.make_single_pulse_search_fn": (
+        "ops.singlepulse.single_pulse_search"
+    ),
+    "ops.dedisperse._stage1_batched": (
+        "ops.dedisperse.subband_stage1_batched"
+    ),
+    "ops.dedisperse._stage2_batched": "ops.dedisperse.subband_stage2",
+}
 
 
 def register_program(
@@ -75,6 +148,10 @@ def register_program(
     *,
     donate: tuple[int, ...] = (),
     allow_custom_calls: tuple[str, ...] = (),
+    param: (
+        Callable[[ShapeCtx], tuple[Callable, tuple, dict[str, Any]] | None]
+        | None
+    ) = None,
 ) -> None:
     if name in _REGISTRY:
         raise ValueError(f"duplicate program registration: {name}")
@@ -83,6 +160,7 @@ def register_program(
         build=build,
         donate=tuple(donate),
         allow_custom_calls=tuple(allow_custom_calls),
+        param=param,
     )
 
 
@@ -94,3 +172,94 @@ def registered_programs() -> tuple[ProgramSpec, ...]:
     for mod in _PROGRAM_MODULES:
         importlib.import_module(mod)
     return tuple(_REGISTRY[k] for k in sorted(_REGISTRY))
+
+
+# --------------------------------------------------------------------------
+# registry completeness: no jitted entry point escapes the registry
+# --------------------------------------------------------------------------
+
+def _jit_entry_points_in(path: str, modname: str) -> list[str]:
+    """AST scan of one ops module for top-level jitted entry points:
+    module-level functions decorated with ``jax.jit`` /
+    ``partial(jax.jit, ...)``, module-level ``name = jax.jit(...)``
+    assignments, and builder functions that ``return jax.jit(...)``
+    (the lru_cache'd factory pattern)."""
+    import ast
+
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+
+    def is_jax_jit(node: ast.AST) -> bool:
+        return (
+            isinstance(node, ast.Attribute)
+            and node.attr == "jit"
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "jax"
+        )
+
+    def decorated_jit(dec: ast.AST) -> bool:
+        if is_jax_jit(dec):
+            return True
+        # partial(jax.jit, ...) / functools.partial(jax.jit, ...)
+        if isinstance(dec, ast.Call) and any(
+            is_jax_jit(a) for a in dec.args
+        ):
+            return True
+        return False
+
+    found = []
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef):
+            if any(decorated_jit(d) for d in node.decorator_list):
+                found.append(f"{modname}.{node.name}")
+                continue
+            for sub in ast.walk(node):
+                if (
+                    isinstance(sub, ast.Return)
+                    and isinstance(sub.value, ast.Call)
+                    and is_jax_jit(sub.value.func)
+                ):
+                    found.append(f"{modname}.{node.name}")
+                    break
+        elif isinstance(node, ast.Assign):
+            if (
+                isinstance(node.value, ast.Call)
+                and is_jax_jit(node.value.func)
+                and node.targets
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                found.append(f"{modname}.{node.targets[0].id}")
+    return found
+
+
+def unregistered_entry_points() -> list[str]:
+    """Top-level jitted entry points in ops/ (Pallas kernels excluded —
+    their contract/warmup story is a ROADMAP item) with no registry
+    coverage: neither a same-name registration (modulo a leading
+    underscore) nor a REGISTRY_ALIASES mapping. Empty means every
+    program is warmed, contract-checked and benchmarked."""
+    import os
+
+    registered = {s.name for s in registered_programs()}
+    missing = []
+    ops_dir = os.path.dirname(os.path.abspath(__file__))
+    # every ops module on disk, not just _PROGRAM_MODULES — a new
+    # module that forgot BOTH the registration and the module list is
+    # exactly what this gate exists to catch
+    for fname in sorted(os.listdir(ops_dir)):
+        if not fname.endswith(".py") or fname in (
+            "__init__.py", "registry.py"
+        ):
+            continue
+        short = fname[:-3]
+        path = os.path.join(ops_dir, fname)
+        for ep in _jit_entry_points_in(path, f"ops.{short}"):
+            mod_prefix, fn_name = ep.rsplit(".", 1)
+            candidates = {
+                ep,
+                f"{mod_prefix}.{fn_name.lstrip('_')}",
+                REGISTRY_ALIASES.get(ep, ""),
+            }
+            if not (candidates & registered):
+                missing.append(ep)
+    return sorted(missing)

@@ -256,12 +256,40 @@ def matched_filter_snr(amplitude: float, width: int, sigma: float) -> float:
 
 
 # --- audit registry: the per-block search program the spsearch driver
-# dispatches (jnp twin path), plus the normaliser standalone ---
+# dispatches (jnp twin path), plus the normaliser standalone; the
+# ShapeCtx hooks rebuild both at a campaign bucket's production
+# geometry (dm_block x out_nsamps, the bucket's width bank) so AOT
+# warmup compiles the programs the driver will actually dispatch ---
 from .registry import register_program, sds  # noqa: E402
+
+
+def _param_search(ctx):
+    if not ctx.widths:  # periodicity-only ctx: no boxcar bank
+        return None
+    return (
+        make_single_pulse_search_fn(
+            tuple(int(w) for w in ctx.widths), float(ctx.min_snr),
+            int(ctx.max_events), int(ctx.decimate), int(ctx.pallas_span),
+        ),
+        (sds((ctx.dm_block, ctx.out_nsamps), "uint8"),),
+        {},
+    )
+
+
+def _param_normalise(ctx):
+    if not ctx.widths:
+        return None
+    return (
+        normalise_trials,
+        (sds((ctx.dm_block, ctx.out_nsamps), "float32"),),
+        {},
+    )
+
 
 register_program(
     "ops.singlepulse.normalise_trials",
     lambda: (normalise_trials, (sds((4, 1024), "float32"),), {}),
+    param=_param_normalise,
 )
 register_program(
     "ops.singlepulse.single_pulse_search",
@@ -270,4 +298,5 @@ register_program(
         (sds((2, 2048), "float32"),),
         {},
     ),
+    param=_param_search,
 )

@@ -1,0 +1,39 @@
+"""Performance subsystem: AOT warmup over the program registry,
+per-program microbenchmarks, and the perf-regression ratchet.
+
+- :mod:`peasoup_tpu.perf.warmup` — ``jax.jit(...).lower().compile()``
+  every registered program ahead of time, populating the persistent
+  compilation cache (utils/cache.py) so later processes cold-start
+  warm; parameterisable to a campaign bucket's production shapes.
+- :mod:`peasoup_tpu.perf.microbench` — materialise each registry
+  entry's representative shapes and time median-of-k
+  ``block_until_ready`` executions into a schema-validated perf.json.
+- :mod:`peasoup_tpu.perf.ratchet` — compare a perf.json against the
+  checked-in ``perf_baseline.json`` (structural invariants everywhere,
+  timing ratchets on real backends), the way ``audit_baseline.json``
+  ratchets audit findings.
+
+CLI: ``peasoup-perf warmup|bench|check`` (tools/perf.py).
+"""
+
+from .microbench import PERF_SCHEMA, PERF_VERSION, run_microbench
+from .ratchet import (
+    BASELINE_SCHEMA,
+    check_perf,
+    load_baseline,
+    write_baseline,
+)
+from .warmup import WarmupReport, warm_bucket, warm_registry
+
+__all__ = [
+    "BASELINE_SCHEMA",
+    "PERF_SCHEMA",
+    "PERF_VERSION",
+    "WarmupReport",
+    "check_perf",
+    "load_baseline",
+    "run_microbench",
+    "warm_bucket",
+    "warm_registry",
+    "write_baseline",
+]

@@ -1,0 +1,212 @@
+"""The perf-regression ratchet: perf.json vs perf_baseline.json.
+
+Mirrors the audit's ``audit_baseline.json`` discipline. The checked-in
+baseline pins, per program, the tolerated execute/compile medians and
+the structural facts (the program set itself). ``peasoup-perf check``
+compares a fresh perf.json against it:
+
+* **structural invariants** gate everywhere (CPU CI included): every
+  baseline program must still exist and still compile/run (a deleted
+  or broken registry program is a regression, not a shrinkage), no
+  jitted entry point may be missing from the registry
+  (ops.registry.unregistered_entry_points), and — checked by the CLI,
+  not here — a warm registry pass must be 100% persistent-cache hits
+  with zero real recompiles.
+* **timing ratchets** apply on real backends (or with ``timing="on"``):
+  a program whose execute median exceeds baseline x tolerance fails.
+  CPU timings are recorded in the baseline for reference but gate
+  nothing by default — shared-runner CI wall clocks are weather, not
+  regressions; the device-anchored TPU numbers are the contract.
+
+New programs never fail the check (growth is the point); they are
+reported so the baseline can be re-pinned (``--write-baseline``),
+which is also how a legitimate speedup or an accepted slowdown is
+recorded — the file only changes deliberately, in review.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+BASELINE_SCHEMA = "peasoup_tpu.perf_baseline"
+BASELINE_VERSION = 1
+
+# default execute-median tolerance: generous enough to ride out
+# device-clock jitter, tight enough that a real kernel regression
+# (2x = a lost fusion, a serialised scan) trips it
+DEFAULT_TOLERANCE = 1.6
+# compile time is noisier (cache state, XLA version); ratchet it
+# loosely — its job is catching a program whose compile EXPLODES
+# (e.g. an unrolled loop), not 20% drift
+DEFAULT_COMPILE_TOLERANCE = 4.0
+
+
+@dataclass
+class PerfProblem:
+    """One ratchet violation."""
+
+    kind: str  # missing_program | program_error | slower | compile_slower
+    # | unregistered_entry_point | schema
+    program: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.program}: [{self.kind}] {self.message}"
+
+
+def baseline_from_perf(
+    doc: dict,
+    tolerance: float = DEFAULT_TOLERANCE,
+    compile_tolerance: float = DEFAULT_COMPILE_TOLERANCE,
+) -> dict:
+    """Pin a baseline document from a perf.json run (programs with
+    errors are excluded — a broken program must be fixed, not
+    baselined)."""
+    programs = {
+        name: {
+            "execute_median_s": rec["execute_median_s"],
+            "compile_s": rec["compile_s"],
+            "args": rec.get("args", []),
+        }
+        for name, rec in sorted(doc["programs"].items())
+        if not rec.get("error")
+    }
+    return {
+        "schema": BASELINE_SCHEMA,
+        "version": BASELINE_VERSION,
+        "generated_by": "peasoup-perf check --write-baseline",
+        "backend": doc["backend"],
+        "device_kind": doc.get("device_kind", "unknown"),
+        "tolerance": tolerance,
+        "compile_tolerance": compile_tolerance,
+        "programs": programs,
+    }
+
+
+def load_baseline(path: str) -> dict:
+    import json
+
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("schema") != BASELINE_SCHEMA:
+        raise ValueError(
+            f"{path}: not a {BASELINE_SCHEMA} document "
+            f"(schema={doc.get('schema')!r})"
+        )
+    if not isinstance(doc.get("programs"), dict):
+        raise ValueError(f"{path}: baseline lacks a programs map")
+    return doc
+
+
+def write_baseline(doc: dict, path: str) -> None:
+    import json
+    import os
+    import tempfile
+
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def timing_applies(perf_doc: dict, baseline: dict, timing: str) -> bool:
+    """Whether the timing ratchet gates this comparison. ``auto``:
+    only when backends match and the backend is a real accelerator —
+    CPU CI machines measure scheduler weather."""
+    if timing == "on":
+        return True
+    if timing == "off":
+        return False
+    same = perf_doc.get("backend") == baseline.get("backend")
+    return same and perf_doc.get("backend") != "cpu"
+
+
+def check_perf(
+    perf_doc: dict,
+    baseline: dict,
+    timing: str = "auto",
+) -> tuple[list[PerfProblem], list[str]]:
+    """Compare a perf.json against the baseline. Returns (problems,
+    notices): problems fail the gate, notices (new unbaselined
+    programs, timing skipped) inform the report."""
+    problems: list[PerfProblem] = []
+    notices: list[str] = []
+    recs = perf_doc.get("programs", {})
+    base = baseline.get("programs", {})
+
+    for name, b in sorted(base.items()):
+        rec = recs.get(name)
+        if rec is None:
+            problems.append(
+                PerfProblem(
+                    "missing_program", name,
+                    "in the baseline but absent from this run — a "
+                    "registry program disappeared (deliberate removals "
+                    "re-pin with --write-baseline)",
+                )
+            )
+            continue
+        if rec.get("error"):
+            problems.append(
+                PerfProblem(
+                    "program_error", name,
+                    f"failed to compile/execute: {rec['error']}",
+                )
+            )
+            continue
+        if not timing_applies(perf_doc, baseline, timing):
+            continue
+        tol = float(b.get("tolerance") or baseline.get(
+            "tolerance", DEFAULT_TOLERANCE
+        ))
+        limit = float(b["execute_median_s"]) * tol
+        if float(rec["execute_median_s"]) > limit:
+            problems.append(
+                PerfProblem(
+                    "slower", name,
+                    f"execute median {rec['execute_median_s']:.6f}s > "
+                    f"{limit:.6f}s (baseline "
+                    f"{b['execute_median_s']:.6f}s x {tol:g})",
+                )
+            )
+        ctol = float(b.get("compile_tolerance") or baseline.get(
+            "compile_tolerance", DEFAULT_COMPILE_TOLERANCE
+        ))
+        # only ratchet cold compiles: a cache-served compile measures
+        # deserialisation, not XLA
+        if not rec.get("compile_cache_hit") and float(
+            rec.get("compile_s", 0.0)
+        ) > float(b["compile_s"]) * ctol:
+            problems.append(
+                PerfProblem(
+                    "compile_slower", name,
+                    f"compile {rec['compile_s']:.3f}s > "
+                    f"{float(b['compile_s']) * ctol:.3f}s (baseline "
+                    f"{b['compile_s']:.3f}s x {ctol:g})",
+                )
+            )
+
+    new = sorted(set(recs) - set(base))
+    if new:
+        notices.append(
+            f"{len(new)} program(s) not in the baseline (re-pin with "
+            f"--write-baseline): {', '.join(new[:8])}"
+            + ("..." if len(new) > 8 else "")
+        )
+    if not timing_applies(perf_doc, baseline, timing):
+        notices.append(
+            "timing ratchet skipped "
+            f"(backend {perf_doc.get('backend')!r} vs baseline "
+            f"{baseline.get('backend')!r}, timing={timing}); structural "
+            "invariants only"
+        )
+    return problems, notices

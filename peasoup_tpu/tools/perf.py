@@ -1,0 +1,274 @@
+"""``peasoup-perf`` — AOT warmup, microbenchmarks, regression ratchet.
+
+Subcommands:
+
+* ``warmup`` — AOT-compile every registered program (representative
+  shapes), populating the persistent compilation cache so later
+  processes cold-start warm. Run it once per machine/toolchain; it is
+  also what campaign workers do per bucket automatically.
+* ``bench`` — per-program microbenchmarks into a schema-validated
+  ``perf.json`` (default ./perf.json).
+* ``check`` — compare a perf.json against the checked-in
+  ``perf_baseline.json``: structural invariants everywhere (program
+  set intact, registry completeness, warm pass 100% cache hits with
+  zero recompiles), timing ratchets on real backends. ``--write-
+  baseline`` re-pins the baseline from the perf.json.
+
+Exit codes (scripts/check.sh relies on these, mirroring peasoup-audit):
+
+* ``0`` — clean
+* ``1`` — regression (or missing/broken/unregistered program)
+* ``2`` — internal error (bad args, unreadable files, engine crash)
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import traceback
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="peasoup-perf",
+        description=(
+            "AOT warmup over the program registry, per-program "
+            "microbenchmarks, and the perf-regression ratchet"
+        ),
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    w = sub.add_parser(
+        "warmup",
+        help="AOT-compile every registered program into the "
+        "persistent compilation cache",
+    )
+    w.add_argument(
+        "--programs", default=None,
+        help="comma-separated program names (default: all)",
+    )
+    w.add_argument(
+        "--json", dest="json_path", default=None, metavar="PATH",
+        help="also write the warmup report as JSON",
+    )
+
+    b = sub.add_parser(
+        "bench", help="microbenchmark every registered program"
+    )
+    b.add_argument(
+        "-o", "--output", default="perf.json",
+        help="perf.json output path (default ./perf.json)",
+    )
+    b.add_argument(
+        "--reps", type=int, default=5,
+        help="timed executions per program (median reported; default 5)",
+    )
+    b.add_argument(
+        "--programs", default=None,
+        help="comma-separated program names (default: all)",
+    )
+
+    c = sub.add_parser(
+        "check", help="ratchet a perf.json against the baseline"
+    )
+    c.add_argument(
+        "--perf", default="perf.json",
+        help="perf.json to check (default ./perf.json)",
+    )
+    c.add_argument(
+        "--baseline", default="perf_baseline.json",
+        help="checked-in baseline (default ./perf_baseline.json)",
+    )
+    c.add_argument(
+        "--timing", choices=("auto", "on", "off"), default="auto",
+        help="timing ratchet: auto = only on matching non-CPU "
+        "backends (default), on = always, off = structural only",
+    )
+    c.add_argument(
+        "--no-warm", action="store_true",
+        help="skip the warm-registry invariant (zero recompiles / all "
+        "persistent-cache hits after a bench in the same cache dir)",
+    )
+    c.add_argument(
+        "--write-baseline", action="store_true",
+        help="re-pin --baseline from the perf.json and exit 0",
+    )
+    return p
+
+
+def _cmd_warmup(args) -> int:
+    from peasoup_tpu.perf.warmup import warm_registry
+
+    programs = (
+        [s.strip() for s in args.programs.split(",") if s.strip()]
+        if args.programs else None
+    )
+    rep = warm_registry(programs=programs)
+    for pw in rep.programs:
+        state = (
+            "ERROR " + (pw.error or "")
+            if pw.error
+            else ("cache hit" if pw.cache_hit else "compiled")
+        )
+        print(f"  {pw.name}: {pw.seconds:.3f}s  {state}")
+    print(
+        f"peasoup-perf warmup: {len(rep.programs)} programs in "
+        f"{rep.seconds:.1f}s ({rep.compiled} compiled, "
+        f"{rep.cache_hits} persistent-cache hits"
+        + (f", cache {rep.cache_dir}" if rep.cache_dir else ", NO cache")
+        + ")"
+    )
+    if args.json_path:
+        import json
+
+        with open(args.json_path, "w") as f:
+            json.dump(rep.to_doc(), f, indent=2)
+            f.write("\n")
+    return 1 if rep.errors else 0
+
+
+def _cmd_bench(args) -> int:
+    from peasoup_tpu.perf.microbench import run_microbench, write_perf
+
+    programs = (
+        [s.strip() for s in args.programs.split(",") if s.strip()]
+        if args.programs else None
+    )
+    doc = run_microbench(reps=args.reps, programs=programs)
+    write_perf(doc, args.output)
+    for name, rec in sorted(doc["programs"].items()):
+        if rec["error"]:
+            print(f"  {name}: ERROR {rec['error']}")
+        else:
+            print(
+                f"  {name}: compile {rec['compile_s'] * 1e3:8.1f} ms"
+                f"{' (cache)' if rec['compile_cache_hit'] else '        '}"
+                f"  execute {rec['execute_median_s'] * 1e6:10.1f} us"
+            )
+    t = doc["totals"]
+    print(
+        f"peasoup-perf bench: {t['programs']} programs on "
+        f"{doc['backend']} ({doc['device_kind']}) in {t['wall_s']:.1f}s "
+        f"-> {args.output}"
+        + (f"  [{t['errors']} ERRORS]" if t["errors"] else "")
+    )
+    return 1 if t["errors"] else 0
+
+
+def _warm_invariant(problems, notices, programs=None) -> None:
+    """The zero-recompile contract: with the persistent cache
+    populated (a bench/warmup ran in this cache dir), re-lowering the
+    benched programs must be pure cache hits — a miss means a
+    program's lowering drifted from what was just benched
+    (non-deterministic tracing, environment leakage into the jaxpr)
+    and campaign workers would silently recompile on every restart."""
+    from peasoup_tpu.perf.ratchet import PerfProblem
+    from peasoup_tpu.perf.warmup import warm_registry
+
+    rep = warm_registry(programs=programs)
+    if rep.cache_dir is None:
+        notices.append(
+            "warm invariant skipped: persistent compilation cache "
+            "unavailable"
+        )
+        return
+    for pw in rep.programs:
+        if pw.error:
+            problems.append(
+                PerfProblem("program_error", pw.name, pw.error)
+            )
+        elif pw.compiled:
+            problems.append(
+                PerfProblem(
+                    "recompiled_warm", pw.name,
+                    "recompiled on warm shapes (persistent-cache miss "
+                    "straight after bench): the program's lowering is "
+                    "not stable across processes",
+                )
+            )
+    notices.append(
+        f"warm invariant: {rep.cache_hits}/{len(rep.programs)} "
+        f"persistent-cache hits, {rep.compiled} recompiles"
+    )
+
+
+def _cmd_check(args) -> int:
+    import os
+
+    from peasoup_tpu.ops.registry import unregistered_entry_points
+    from peasoup_tpu.perf.microbench import load_perf
+    from peasoup_tpu.perf.ratchet import (
+        PerfProblem,
+        baseline_from_perf,
+        check_perf,
+        load_baseline,
+        write_baseline,
+    )
+
+    perf_doc = load_perf(args.perf)
+    if args.write_baseline:
+        write_baseline(baseline_from_perf(perf_doc), args.baseline)
+        n = len([
+            r for r in perf_doc["programs"].values() if not r["error"]
+        ])
+        print(
+            f"peasoup-perf: baseline written to {args.baseline} "
+            f"({n} program(s) pinned on {perf_doc['backend']})"
+        )
+        return 0
+    if not os.path.exists(args.baseline):
+        print(
+            f"peasoup-perf: baseline {args.baseline} missing "
+            "(create one with: peasoup-perf check --write-baseline)",
+            file=sys.stderr,
+        )
+        return 2
+    baseline = load_baseline(args.baseline)
+    problems, notices = check_perf(
+        perf_doc, baseline, timing=args.timing
+    )
+    for ep in unregistered_entry_points():
+        problems.append(
+            PerfProblem(
+                "unregistered_entry_point", ep,
+                "top-level jitted entry point with no registry entry — "
+                "it escapes warmup, contracts and benchmarks; register "
+                "it next to the op (see ops/registry.py)",
+            )
+        )
+    if not args.no_warm:
+        # only the programs this perf.json covers: a subset bench must
+        # not flag the rest of the registry as cold
+        _warm_invariant(
+            problems, notices, programs=sorted(perf_doc["programs"])
+        )
+    for n in notices:
+        print(f"note: {n}")
+    for pr in problems:
+        print(pr.render())
+    if problems:
+        print(f"peasoup-perf check: {len(problems)} problem(s)")
+        return 1
+    print(
+        f"peasoup-perf check: OK ({len(baseline['programs'])} baseline "
+        f"programs, backend {perf_doc['backend']})"
+    )
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return {
+            "warmup": _cmd_warmup,
+            "bench": _cmd_bench,
+            "check": _cmd_check,
+        }[args.cmd](args)
+    except Exception:
+        traceback.print_exc()
+        print("peasoup-perf: internal error (exit 2)", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
