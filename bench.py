@@ -424,31 +424,14 @@ def _bench_big_grid(force_wall: bool) -> dict:
     }
 
 
-def _median(xs: list) -> float:
-    """True median (mean of the middle pair for even counts — a failed
-    trace can shrink an odd sample set to an even one, and the
-    upper-middle element would then be a max mislabeled as a median)."""
-    if not xs:
-        return 0.0
-    xs = sorted(xs)
-    n = len(xs)
-    mid = n // 2
-    return xs[mid] if n % 2 else 0.5 * (xs[mid - 1] + xs[mid])
-
-
-def _device_busy_seconds(run) -> float:
-    """Total device-busy seconds of one ``run()`` call via the shared
-    profiler-trace parser (peasoup_tpu.tools.scope_trace). 0.0 when
-    tracing fails — callers fall back to wall-clock."""
-    try:
-        from peasoup_tpu.tools.scope_trace import scope_trace
-
-        with scope_trace() as res:
-            run()
-        return res.device_s
-    except Exception as exc:  # profiling is best-effort
-        print(f"device-time trace failed: {exc!r}", file=sys.stderr)
-        return 0.0
+# the BENCH protocol and peasoup-perf share ONE measurement path
+# (peasoup_tpu/perf/measure.py): median semantics, the median-of-k
+# block_until_ready discipline, and the device-anchored trace parse —
+# so the trajectory files and the CI ratchet can never drift apart
+from peasoup_tpu.perf.measure import (  # noqa: E402
+    device_busy_seconds as _device_busy_seconds,
+    median as _median,
+)
 
 
 def main() -> int:

@@ -17,7 +17,41 @@ from __future__ import annotations
 import os
 from dataclasses import dataclass
 
+import numpy as np
+
 DADA_HDR_SIZE = 4096
+
+# canonical ``KEY -> field`` mapping shared by the parser and the
+# writer (order is the order keys are emitted by tofile/write_dada)
+_DADA_KEYS: tuple[tuple[str, str], ...] = (
+    ("HDR_VERSION", "header_version"),
+    ("HDR_SIZE", "header_size"),
+    ("BW", "bw"),
+    ("FREQ", "freq"),
+    ("NANT", "nant"),
+    ("NCHAN", "nchan"),
+    ("NDIM", "ndim"),
+    ("NPOL", "npol"),
+    ("NBIT", "nbit"),
+    ("TSAMP", "tsamp"),
+    ("OSAMP_RATIO", "osamp_ratio"),
+    ("SOURCE", "source_name"),
+    ("RA", "ra"),
+    ("DEC", "dec"),
+    ("PROC_FILE", "proc_file"),
+    ("MODE", "mode"),
+    ("OBSERVER", "observer"),
+    ("PID", "pid"),
+    ("OBS_OFFSET", "obs_offset"),
+    ("TELESCOPE", "telescope"),
+    ("INSTRUMENT", "instrument"),
+    ("DSB", "dsb"),
+    ("FILE_SIZE", "dada_filesize"),
+    ("BYTES_PER_SECOND", "bytes_per_sec"),
+    ("UTC_START", "utc_start"),
+    ("ANT_ID", "ant_id"),
+    ("FILE_NUMBER", "file_no"),
+)
 
 
 @dataclass
@@ -59,6 +93,14 @@ class DadaHeader:
             f.seek(0, os.SEEK_END)
             payload = max(f.tell() - DADA_HDR_SIZE, 0)
         text = raw.decode("ascii", errors="replace")
+        # PSRDADA headers allow '#'-prefixed comment lines; drop them
+        # (and trailing NUL padding) before the substring search so a
+        # commented-out key can never shadow the live one
+        text = "\n".join(
+            ln
+            for ln in text.replace("\x00", "").splitlines()
+            if not ln.lstrip().startswith("#")
+        )
 
         def value(key: str) -> str:
             # substring search like the reference's get_value
@@ -117,3 +159,59 @@ class DadaHeader:
         denom = max(h.nchan, 1) * max(h.nant, 1) * max(h.npol, 1) * 2
         h.nsamples = payload // denom
         return h
+
+    def header_text(self) -> str:
+        """The ``KEY value`` header block (no padding): every mapped
+        field with a non-default value, in canonical key order.
+        HDR_SIZE is always emitted (readers use it to find the
+        payload)."""
+        lines = []
+        for key, field_name in _DADA_KEYS:
+            v = getattr(self, field_name)
+            if key == "HDR_SIZE":
+                v = v or DADA_HDR_SIZE
+            if v == 0 or v == 0.0 or v == "":
+                if key != "HDR_SIZE":
+                    continue
+            if isinstance(v, float):
+                v = f"{v:.12g}"
+            lines.append(f"{key} {v}")
+        return "\n".join(lines) + "\n"
+
+    def tofile(
+        self,
+        filename: str | os.PathLike,
+        payload: "np.ndarray | bytes | None" = None,
+    ) -> None:
+        """Write a .dada file: the header text NUL-padded to
+        DADA_HDR_SIZE bytes, then the raw payload. Atomic
+        (tmp + os.replace) so a tailing stream reader never sees a
+        torn segment appear."""
+        text = self.header_text().encode("ascii")
+        if len(text) > DADA_HDR_SIZE:
+            raise ValueError(
+                f"header text ({len(text)} bytes) exceeds "
+                f"DADA_HDR_SIZE={DADA_HDR_SIZE}"
+            )
+        body = b"" if payload is None else (
+            payload if isinstance(payload, bytes)
+            else np.ascontiguousarray(payload, dtype=np.uint8).tobytes()
+        )
+        tmp = os.fspath(filename) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(text.ljust(DADA_HDR_SIZE, b"\x00"))
+            f.write(body)
+        os.replace(tmp, os.fspath(filename))
+
+
+def write_dada(
+    filename: str | os.PathLike,
+    payload: "np.ndarray | bytes",
+    **fields,
+) -> DadaHeader:
+    """Synthesise a valid .dada stream segment from header ``fields``
+    (DadaHeader field names) + payload samples — the helper the replay
+    source and the tests use to build PSRDADA-style streams."""
+    h = DadaHeader(**fields)
+    h.tofile(filename, payload)
+    return h

@@ -166,6 +166,18 @@ class FlightRecorder:
             "events": list(self._ring),
         }
         try:
+            # live status sections (e.g. the streaming driver's queue/
+            # latency/drop state) are abort forensics too
+            doc.update(
+                {
+                    k: v
+                    for k, v in tel.snapshot_sections().items()
+                    if k not in doc
+                }
+            )
+        except Exception:
+            pass  # a section provider must never block the dump
+        try:
             d = os.path.dirname(self.path)
             if d:
                 os.makedirs(d, exist_ok=True)

@@ -32,7 +32,11 @@ import time
 from .log import get_logger
 
 STATUS_SCHEMA = "peasoup_tpu.status"
-STATUS_VERSION = 1
+# v2: optional named status sections from
+# RunTelemetry.set_status_section (e.g. the streaming driver's
+# "streaming" block with input rate / queue depth / latency-vs-SLO /
+# drop tallies). Watchers .get() them; absent for batch runs.
+STATUS_VERSION = 2
 
 log = get_logger("obs.heartbeat")
 
@@ -200,6 +204,11 @@ class Heartbeat:
         # audit: ignore[PSA009] -- single writer: only the beat thread
         # increments, and stop() joins it before the final beat
         self._seq += 1
+        sections = {}
+        try:
+            sections = tel.snapshot_sections()
+        except Exception:
+            pass  # a section provider must never fail the beat
         return {
             "schema": STATUS_SCHEMA,
             "version": STATUS_VERSION,
@@ -217,6 +226,15 @@ class Heartbeat:
             "counters": dict(tel.counters),
             "gauges": dict(tel.gauges),
             "events_tail": list(tel.events[-self.event_tail :]),
+        } | {
+            k: v for k, v in sections.items()
+            # a section can never shadow a core snapshot key
+            if k not in (
+                "schema", "version", "run_id", "pid", "hostname", "seq",
+                "updated_unix", "uptime_s", "done", "stage", "progress",
+                "stalled", "last_progress_age_s", "counters", "gauges",
+                "events_tail",
+            )
         }
 
     def _beat(self, final: bool = False) -> None:

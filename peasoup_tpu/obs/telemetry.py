@@ -47,7 +47,9 @@ MANIFEST_SCHEMA = "peasoup_tpu.telemetry"
 # tools/report.py --merge) and the optional aborted/abort_reason pair
 # written by the crash flight recorder (obs/flight.py). Readers must
 # .get() keys newer than a manifest's version — see tools/report.py.
-MANIFEST_VERSION = 2
+# v3: optional status sections (e.g. the streaming driver's
+# ``streaming`` block) snapshotted into the manifest at write time.
+MANIFEST_VERSION = 3
 
 _ACTIVE: contextvars.ContextVar["RunTelemetry | None"] = (
     contextvars.ContextVar("peasoup_tpu_telemetry", default=None)
@@ -145,6 +147,12 @@ class RunTelemetry:
         self._stage_stack: list[str] = []
         self.progress_state: dict = {}
         self._listeners: list = []
+        # named live-status providers (name -> zero-arg callable or
+        # plain dict); snapshotted by the status.json heartbeat AND
+        # into the manifest — how a long-lived driver (the streaming
+        # loop) exposes a structured section without the heartbeat
+        # knowing its schema
+        self.status_sections: dict = {}
         if enabled:
             _install_jit_listener()
 
@@ -185,6 +193,25 @@ class RunTelemetry:
             except Exception:
                 pass  # a broken listener must never fail the run
         return rec
+
+    def set_status_section(self, name: str, provider) -> None:
+        """Register a named status section: ``provider`` is a zero-arg
+        callable returning a JSON-serialisable dict (or a plain dict).
+        Heartbeat snapshots and the manifest embed it top-level under
+        ``name`` (pick names the schema knows, e.g. ``streaming``)."""
+        if self.enabled:
+            self.status_sections[name] = provider
+
+    def snapshot_sections(self) -> dict:
+        """Evaluate every registered status section (a failing provider
+        yields an ``error`` stub rather than failing the snapshot)."""
+        out = {}
+        for name, provider in self.status_sections.items():
+            try:
+                out[name] = provider() if callable(provider) else provider
+            except Exception as exc:
+                out[name] = {"error": f"{type(exc).__name__}: {exc!s:.200}"}
+        return out
 
     def add_listener(self, fn) -> None:
         """Subscribe ``fn(record)`` to every event as it is recorded
@@ -376,6 +403,9 @@ class RunTelemetry:
             "events": self.events,
             "device_trace": self.device_trace,
         }
+        for name, val in self.snapshot_sections().items():
+            if name not in man:  # sections can never shadow core keys
+                man[name] = val
         if aborted:
             man["aborted"] = True
             man["abort_reason"] = abort_reason

@@ -45,6 +45,7 @@ _PROGRAM_MODULES = (
     "peasoup_tpu.ops.fold",
     "peasoup_tpu.ops.fold_optimise",
     "peasoup_tpu.ops.singlepulse",
+    "peasoup_tpu.ops.streaming",
     "peasoup_tpu.ops.ffa",
     "peasoup_tpu.ops.coincidence",
     "peasoup_tpu.ops.correlate",
@@ -83,6 +84,11 @@ class ShapeCtx:
     max_events: int = 256
     decimate: int = 32
     pallas_span: int = 0
+    # streaming geometry (peasoup_tpu/stream/): dedispersed samples per
+    # chunk and carried-tail length; 0 = not a streaming ctx (batch
+    # campaign buckets), so streaming-only hooks skip it
+    stream_chunk: int = 0
+    stream_hold: int = 0
 
 
 @dataclass(frozen=True)
@@ -134,6 +140,9 @@ REGISTRY_ALIASES = {
     "ops.ffa._octave_fn": "ops.ffa.octave",
     "ops.singlepulse.make_single_pulse_search_fn": (
         "ops.singlepulse.single_pulse_search"
+    ),
+    "ops.streaming.make_stream_chunk_fn": (
+        "ops.streaming.stream_chunk_search"
     ),
     "ops.dedisperse._stage1_batched": (
         "ops.dedisperse.subband_stage1_batched"

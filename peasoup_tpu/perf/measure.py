@@ -1,0 +1,80 @@
+"""Shared measurement primitives for every performance number.
+
+The BENCH round protocol (bench.py), the per-program microbenchmarks
+(perf/microbench.py) and the streaming/latency accounting all used to
+carry their own median/timing helpers; drift between them made numbers
+silently incomparable. This module is the single measurement path:
+
+* :func:`median` — true median (mean of the middle pair for even
+  counts: a failed trace can shrink an odd sample set to an even one,
+  and the upper-middle element would then be a max mislabeled as a
+  median);
+* :func:`timed_samples` — the median-of-k ``block_until_ready``
+  discipline: k wall-clock samples of ``call()``, with an optional
+  ``prepare()`` run OUTSIDE each timed window (re-staging donated
+  operands, resetting caches);
+* :func:`device_busy_seconds` — device-anchored seconds of one run via
+  the shared profiler-trace parser (tools/scope_trace), 0.0 when
+  tracing fails so callers can fall back to wall clock.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..obs import get_logger
+
+log = get_logger("perf.measure")
+
+
+def median(xs) -> float:
+    """True median; 0.0 for an empty sample set."""
+    xs = sorted(xs)
+    if not xs:
+        return 0.0
+    n = len(xs)
+    mid = n // 2
+    return xs[mid] if n % 2 else 0.5 * (xs[mid - 1] + xs[mid])
+
+
+def timed_samples(call, reps: int, prepare=None) -> list[float]:
+    """``reps`` wall-clock samples of ``call()`` (seconds, sorted
+    ascending). ``prepare()`` runs before each sample outside the
+    timed window. ``call`` must block until its work is done (wrap
+    device work in ``jax.block_until_ready``)."""
+    samples = []
+    for _ in range(max(1, int(reps))):
+        if prepare is not None:
+            prepare()
+        t0 = time.perf_counter()
+        call()
+        samples.append(time.perf_counter() - t0)
+    samples.sort()
+    return samples
+
+
+def summarize(samples: list[float]) -> dict:
+    """The record fields every timing table shares."""
+    n = len(samples)
+    return {
+        "execute_median_s": round(median(samples), 9),
+        "execute_min_s": round(samples[0], 9) if samples else 0.0,
+        "execute_mean_s": round(sum(samples) / n, 9) if n else 0.0,
+        "execute_all_s": [round(s, 9) for s in samples],
+        "reps": n,
+    }
+
+
+def device_busy_seconds(run) -> float:
+    """Total device-busy seconds of one ``run()`` call via the shared
+    profiler-trace parser (tools/scope_trace). 0.0 when tracing fails
+    — callers fall back to wall clock."""
+    try:
+        from ..tools.scope_trace import scope_trace
+
+        with scope_trace() as res:
+            run()
+        return res.device_s
+    except Exception as exc:  # profiling is best-effort
+        log.warning("device-time trace failed: %r", exc)
+        return 0.0

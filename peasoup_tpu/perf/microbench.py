@@ -89,33 +89,26 @@ def bench_program(spec, reps: int = DEFAULT_REPS, ctx=None) -> dict:
 
         rng = np.random.default_rng(0)
         dev_args = [_materialise(a, rng) for a in args]
-        donated = bool(spec.donate)
         # one untimed execution absorbs first-dispatch overhead
         jax.block_until_ready(compiled(*dev_args))
-        samples = []
-        for _ in range(reps):
-            if donated:
-                # donated operands are consumed per call: re-stage them
-                # OUTSIDE the timed window
-                rng = np.random.default_rng(0)
-                dev_args = [_materialise(a, rng) for a in args]
-            t0 = time.perf_counter()
-            jax.block_until_ready(compiled(*dev_args))
-            samples.append(time.perf_counter() - t0)
-        samples.sort()
-        n = len(samples)
-        median = (
-            samples[n // 2]
-            if n % 2
-            else 0.5 * (samples[n // 2 - 1] + samples[n // 2])
+
+        def _restage():
+            # donated operands are consumed per call: re-stage them
+            # OUTSIDE the timed window
+            nonlocal dev_args
+            r = np.random.default_rng(0)
+            dev_args = [_materialise(a, r) for a in args]
+
+        # the shared measurement path (perf/measure.py): the same
+        # median-of-k block_until_ready discipline bench.py uses
+        from .measure import summarize, timed_samples
+
+        samples = timed_samples(
+            lambda: jax.block_until_ready(compiled(*dev_args)),
+            reps,
+            prepare=_restage if spec.donate else None,
         )
-        rec.update(
-            execute_median_s=round(median, 9),
-            execute_min_s=round(samples[0], 9),
-            execute_mean_s=round(sum(samples) / n, 9),
-            execute_all_s=[round(s, 9) for s in samples],
-            reps=n,
-        )
+        rec.update(summarize(samples))
     except Exception as exc:
         rec["error"] = f"{type(exc).__name__}: {exc!s:.300}"
     return rec

@@ -180,6 +180,45 @@ def cluster_events_fof(
     return [order[np.asarray(members)] for members in roots.values()]
 
 
+def candidates_from_clusters(
+    events: np.ndarray,  # _EVENT_DTYPE records
+    clusters: list[np.ndarray],  # index arrays from cluster_events_fof
+    widths: tuple[int, ...],
+    dm_list: np.ndarray,
+    tsamp: float,
+) -> list[SinglePulseCandidate]:
+    """Package friends-of-friends clusters as SinglePulseCandidates
+    (peak member + footprint extents) — shared by the batch finalize
+    and the streaming driver's incremental confirmation, so a trigger
+    emitted live is field-for-field the candidate a batch run of the
+    same data would report."""
+    w_arr = np.asarray(widths, dtype=np.int64)
+    out = []
+    for members in clusters:
+        ev = events[members]
+        peak = int(np.argmax(ev["snr"]))
+        widx = int(ev["width_idx"][peak])
+        out.append(
+            SinglePulseCandidate(
+                dm=float(dm_list[int(ev["dm_idx"][peak])]),
+                dm_idx=int(ev["dm_idx"][peak]),
+                snr=float(ev["snr"][peak]),
+                time_s=float(ev["sample"][peak]) * tsamp,
+                sample=int(ev["sample"][peak]),
+                width=int(w_arr[widx]),
+                width_idx=widx,
+                members=len(members),
+                dm_idx_lo=int(ev["dm_idx"].min()),
+                dm_idx_hi=int(ev["dm_idx"].max()),
+                sample_lo=int(ev["sample"].min()),
+                sample_hi=int(ev["sample"].max()),
+                width_lo=int(w_arr[ev["width_idx"]].min()),
+                width_hi=int(w_arr[ev["width_idx"]].max()),
+            )
+        )
+    return out
+
+
 def make_checkpoint_key(
     cfg: SinglePulseConfig, fil, global_ndm: int, widths: tuple[int, ...]
 ) -> str:
@@ -551,31 +590,11 @@ class SinglePulseSearch:
             dec=cfg.decimate,
         )
         cands = SinglePulseCandidateCollection()
-        w_arr = np.asarray(widths, dtype=np.int64)
-        for members in clusters:
-            ev = events[members]
-            peak = int(np.argmax(ev["snr"]))
-            widx = int(ev["width_idx"][peak])
-            cands.append(
-                [
-                    SinglePulseCandidate(
-                        dm=float(part.dm_list[int(ev["dm_idx"][peak])]),
-                        dm_idx=int(ev["dm_idx"][peak]),
-                        snr=float(ev["snr"][peak]),
-                        time_s=float(ev["sample"][peak]) * fil.tsamp,
-                        sample=int(ev["sample"][peak]),
-                        width=int(w_arr[widx]),
-                        width_idx=widx,
-                        members=len(members),
-                        dm_idx_lo=int(ev["dm_idx"].min()),
-                        dm_idx_hi=int(ev["dm_idx"].max()),
-                        sample_lo=int(ev["sample"].min()),
-                        sample_hi=int(ev["sample"].max()),
-                        width_lo=int(w_arr[ev["width_idx"]].min()),
-                        width_hi=int(w_arr[ev["width_idx"]].max()),
-                    )
-                ]
+        cands.append(
+            candidates_from_clusters(
+                events, clusters, widths, part.dm_list, fil.tsamp
             )
+        )
         out = sorted(cands, key=lambda c: -c.snr)[: cfg.limit]
         timers["clustering"] = time.perf_counter() - t0
         timers["total"] = time.perf_counter() - part.t_total_start

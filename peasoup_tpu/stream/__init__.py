@@ -1,0 +1,21 @@
+"""Streaming real-time search: bounded-latency chunked ingest with
+backpressure, drop accounting, and live triggers.
+
+See :mod:`peasoup_tpu.stream.driver` for the service loop,
+:mod:`peasoup_tpu.io.stream_source` for the block sources, and the
+README "Streaming mode" section for the architecture sketch.
+"""
+
+from .driver import StreamConfig, StreamingSearch, StreamResult
+from .queue import BoundedBlockQueue, DropStats
+from .triggers import TRIGGER_SCHEMA, TriggerSink
+
+__all__ = [
+    "TRIGGER_SCHEMA",
+    "BoundedBlockQueue",
+    "DropStats",
+    "StreamConfig",
+    "StreamResult",
+    "StreamingSearch",
+    "TriggerSink",
+]

@@ -1,0 +1,668 @@
+"""Streaming real-time single-pulse search driver.
+
+The batch pipeline is a job: read everything, search, write, exit. This
+driver is a SERVICE loop (the GSP/CRAFTS commensal shape,
+arXiv:2110.12749): a reader thread ingests fixed-size blocks from a
+:class:`~peasoup_tpu.io.stream_source.StreamSource` into a bounded
+queue with an explicit backpressure policy; the main loop assembles
+overlapping fixed-shape input windows, dedisperses each with the SAME
+compiled program every chunk, runs the stateful streaming boxcar sweep
+(ops/streaming.py) with the carried tail, incrementally confirms
+friends-of-friends clusters whose time horizon has passed, and emits
+them as triggers within a configurable latency budget.
+
+Invariants the design buys:
+
+* **fixed shapes everywhere** — input window ``(chunk + max_delay,
+  nchans)``, dedispersed chunk ``(ndm, chunk)``, search window
+  ``(ndm, hold + chunk)``; every per-chunk variation (validity span,
+  emit range) is a traced scalar, so after the first chunk ZERO XLA
+  programs compile (asserted via the telemetry compile counters, the
+  same contract campaign warm buckets carry);
+* **boundary exactness** — the carried ``hold`` tail (>= the widest
+  boxcar) plus deferred emission means every event is searched with
+  full context: replaying a recorded observation yields the batch
+  ``spsearch`` candidate set (S/N differs only by the chunk-local
+  normalisation moments);
+* **bounded lag, accounted loss** — the queue's ``drop_oldest`` mode
+  trades sensitivity for latency explicitly: dropped blocks are
+  zero-filled (keeping the stream's sample clock intact) and accounted
+  per block/sample in telemetry, the status heartbeat, and the final
+  manifest.
+
+Observability: the run's ``status.json`` heartbeat gains a
+``streaming`` section (input rate, queue depth, end-to-end chunk
+latency p50/p95 against the SLO, drop/gap tallies, chunks behind real
+time, steady-state recompile count); the same section lands in the
+telemetry manifest on drain, and the flight recorder captures it on
+abort like any other run state.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..io.masks import read_killfile
+from ..obs import get_logger
+from ..obs.telemetry import current as current_telemetry
+from ..ops.dedisperse import dedisperse_block, output_scale
+from ..ops.singlepulse import default_widths
+from ..ops.streaming import make_stream_chunk_fn, stream_geometry
+from ..pipeline.single_pulse import (
+    _EVENT_DTYPE,
+    candidates_from_clusters,
+    cluster_events_fof,
+)
+from ..plan.dm_plan import DMPlan
+from .queue import BoundedBlockQueue
+from .triggers import TriggerSink
+
+log = get_logger("stream.driver")
+
+STREAM_STATUS_VERSION = 1
+
+
+@dataclass
+class StreamConfig:
+    """Streaming search knobs (DM/width/threshold knobs mirror
+    SinglePulseConfig so a replayed stream is comparable to a batch
+    ``spsearch`` of the same recording)."""
+
+    outdir: str = "."
+    killfilename: str = ""
+    dm_start: float = 0.0
+    dm_end: float = 100.0
+    dm_tol: float = 1.10
+    dm_pulse_width: float = 64.0
+    min_snr: float = 6.0
+    n_widths: int = 12
+    max_width: int = 0
+    max_events: int = 256
+    decimate: int = 32
+    time_link: float = 1.0
+    dm_link: int = 2
+    limit: int = 1000  # rolling .singlepulse table size
+    # streaming geometry
+    chunk_samples: int = 16384  # dedispersed samples per chunk (L)
+    hold_samples: int = 0  # carried tail (H); 0 = auto from widths
+    # ingest / backpressure
+    queue_blocks: int = 8  # bounded queue capacity (source blocks)
+    policy: str = "block"  # or "drop_oldest"
+    latency_slo_s: float = 2.0  # per-chunk arrival->events budget
+    max_chunks: int = 0  # stop after N chunks (0 = stream end only)
+    # performance
+    warmup: bool = True  # AOT-compile the chunk programs before ingest
+    flush_every: int = 1  # rolling-table rewrite cadence (chunks)
+
+
+@dataclass
+class StreamResult:
+    """What a drained stream leaves behind (plus the on-disk trigger
+    stream the sink wrote while it ran)."""
+
+    candidates: list
+    dm_list: np.ndarray
+    widths: tuple[int, ...]
+    n_chunks: int = 0
+    n_triggers: int = 0
+    n_events: int = 0
+    n_overflowed: int = 0
+    total_out_samples: int = 0
+    drops: dict = field(default_factory=dict)
+    latency: dict = field(default_factory=dict)
+    timers: dict = field(default_factory=dict)
+    jit_programs_first_chunk: int = 0
+    jit_programs_steady: int = 0
+
+
+def _percentile(sorted_xs: list, frac: float) -> float | None:
+    if not sorted_xs:
+        return None
+    i = min(len(sorted_xs) - 1, int(frac * len(sorted_xs)))
+    return sorted_xs[i]
+
+
+class StreamingSearch:
+    """Consume a StreamSource chunk by chunk and emit live triggers."""
+
+    def __init__(self, config: StreamConfig):
+        self.config = config
+        self._lock = threading.Lock()
+        # aggregates read by the status-section provider (heartbeat
+        # thread) while the main loop writes them
+        self._latencies: list[float] = []
+        self._slo_misses = 0
+        self._gap_samples = 0
+        self._chunks_done = 0
+        self._n_events = 0
+        self._n_overflowed = 0
+        self._received_samples = 0
+        self._first_arrival: float | None = None
+        self._last_arrival: float | None = None
+        self._jit_first = 0
+        self._jit_steady = 0
+        self._spans: list[tuple[int, int, float]] = []  # (lo, hi, t_ready)
+        self._pending = np.zeros(0, dtype=_EVENT_DTYPE)
+        self._queue: BoundedBlockQueue | None = None
+        self._sink: TriggerSink | None = None
+        self._reader_error: BaseException | None = None
+
+    # --- planning -----------------------------------------------------
+    def plan_for(self, fmt) -> DMPlan:
+        cfg = self.config
+        killmask = None
+        if cfg.killfilename:
+            killmask = read_killfile(cfg.killfilename, fmt.nchans)
+        return DMPlan.create(
+            nsamps=cfg.chunk_samples,  # out_nsamps is unused here
+            nchans=fmt.nchans,
+            tsamp=fmt.tsamp,
+            fch1=fmt.fch1,
+            foff=fmt.foff,
+            dm_start=cfg.dm_start,
+            dm_end=cfg.dm_end,
+            pulse_width=cfg.dm_pulse_width,
+            tol=cfg.dm_tol,
+            killmask=killmask,
+        )
+
+    def widths_for(self) -> tuple[int, ...]:
+        """The stream's boxcar bank: octave-spaced, capped at a quarter
+        chunk (mirroring the batch quarter-trial cap) and by
+        cfg.max_width."""
+        cfg = self.config
+        cap = max(1, cfg.chunk_samples // 4)
+        if cfg.max_width:
+            cap = min(cap, cfg.max_width)
+        return default_widths(cfg.n_widths, max_width=cap)
+
+    def shape_ctx(self, fmt, plan: DMPlan, widths, hold: int):
+        """The production ShapeCtx of this stream's chunk programs, for
+        AOT warmup and the perf tooling."""
+        from ..ops.registry import ShapeCtx
+
+        cfg = self.config
+        return ShapeCtx(
+            nsamps=cfg.chunk_samples + plan.max_delay,
+            nchans=fmt.nchans,
+            nbits=fmt.nbits,
+            ndm=plan.ndm,
+            out_nsamps=cfg.chunk_samples,
+            dm_block=plan.ndm,
+            dedisp_block=plan.ndm,
+            widths=tuple(int(w) for w in widths),
+            min_snr=float(cfg.min_snr),
+            max_events=int(cfg.max_events),
+            decimate=int(cfg.decimate),
+            pallas_span=0,
+            stream_chunk=int(cfg.chunk_samples),
+            stream_hold=int(hold),
+        )
+
+    # --- reader thread ------------------------------------------------
+    def _read(self, source, q: BoundedBlockQueue, tel) -> None:
+        try:
+            for blk in source.blocks():
+                q.put(blk)
+        except Exception as exc:  # surface in the main loop
+            self._reader_error = exc
+            log.error("stream reader failed: %s", exc)
+            tel.event("stream_reader_error", error=f"{exc!s:.300}")
+        finally:
+            q.close()
+
+    # --- status section (heartbeat + manifest) ------------------------
+    def _status_section(self) -> dict:
+        cfg = self.config
+        q = self._queue
+        with self._lock:
+            lats = sorted(self._latencies)
+            doc = {
+                "version": STREAM_STATUS_VERSION,
+                "policy": cfg.policy,
+                "chunk_samples": cfg.chunk_samples,
+                "chunks_done": self._chunks_done,
+                "events": self._n_events,
+                "pending_events": len(self._pending),
+                "input_samples": self._received_samples,
+                "gap_samples": self._gap_samples,
+                "jit_programs_first_chunk": self._jit_first,
+                "jit_programs_steady": self._jit_steady,
+            }
+            first, last = self._first_arrival, self._last_arrival
+        if first is not None and last is not None and last > first:
+            doc["input_rate_sps"] = round(
+                self._received_samples / (last - first), 3
+            )
+        else:
+            doc["input_rate_sps"] = None
+        if q is not None:
+            doc["queue_depth_blocks"] = q.depth
+            doc["queue_capacity_blocks"] = q.capacity
+            doc["chunks_behind"] = round(
+                q.queued_samples / max(1, cfg.chunk_samples), 3
+            )
+            doc["drops"] = q.drops.to_doc()
+        if self._sink is not None:
+            doc["triggers"] = self._sink.n_emitted
+        doc["latency_s"] = {
+            "slo": cfg.latency_slo_s,
+            "p50": _percentile(lats, 0.50),
+            "p95": _percentile(lats, 0.95),
+            "max": lats[-1] if lats else None,
+            "misses": self._slo_misses,
+        }
+        return doc
+
+    # --- incremental confirmation --------------------------------------
+    def _confirm(
+        self, frontier: float, widths, dm_list, tsamp: float
+    ) -> list:
+        """Confirm (and remove from the pending set) every
+        friends-of-friends cluster no future event can still join: a
+        new event's sample is >= ``frontier``, and linking reaches at
+        most ``time_link * max(width) + decimate`` samples back."""
+        cfg = self.config
+        with self._lock:
+            pending = self._pending
+        if not len(pending):
+            return []
+        clusters = cluster_events_fof(
+            pending, widths, time_link=cfg.time_link,
+            dm_link=cfg.dm_link, dec=cfg.decimate,
+        )
+        horizon = frontier - (
+            cfg.time_link * float(max(widths)) + cfg.decimate
+        )
+        done = [
+            cl for cl in clusters
+            if pending[cl]["sample"].max() < horizon
+        ]
+        if not done:
+            return []
+        cands = candidates_from_clusters(
+            pending, done, widths, dm_list, tsamp
+        )
+        drop = np.concatenate(done)
+        keep = np.ones(len(pending), dtype=bool)
+        keep[drop] = False
+        with self._lock:
+            self._pending = pending[keep]
+        return sorted(cands, key=lambda c: c.sample)
+
+    def _latency_for_sample(self, sample: int, now: float) -> float | None:
+        """End-to-end latency of a trigger: emission time minus the
+        arrival of the newest block its chunk's search needed."""
+        with self._lock:
+            for lo, hi, t_ready in self._spans:
+                if lo <= sample < hi:
+                    return now - t_ready
+        return None
+
+    # --- the run ------------------------------------------------------
+    def run(self, source) -> StreamResult:
+        cfg = self.config
+        tel = current_telemetry()
+        timers: dict[str, float] = {
+            "dedispersion": 0.0, "searching": 0.0, "clustering": 0.0,
+        }
+        t_total = time.perf_counter()
+        fmt = source.format
+
+        # --- plan ------------------------------------------------------
+        tel.set_stage("plan")
+        t0 = time.perf_counter()
+        plan = self.plan_for(fmt)
+        widths = self.widths_for()
+        dec = cfg.decimate
+        chunk = cfg.chunk_samples
+        hold = stream_geometry(widths, chunk, dec, cfg.hold_samples)
+        md = plan.max_delay
+        w_in = chunk + md
+        w = hold + chunk
+        ndm = plan.ndm
+        scale = output_scale(fmt.nbits, int(plan.killmask.sum()))
+        timers["plan"] = time.perf_counter() - t0
+        tel.set_context(
+            stream_chunk_samples=chunk, stream_hold_samples=hold,
+            stream_policy=cfg.policy, stream_slo_s=cfg.latency_slo_s,
+        )
+        tel.gauge("stream.ndm", ndm)
+        tel.gauge("stream.slo_s", cfg.latency_slo_s)
+        tel.event(
+            "stream_plan", ndm=ndm, chunk=chunk, hold=hold,
+            max_delay=md, widths=[int(x) for x in widths],
+            block_samples=int(source.block_samples), policy=cfg.policy,
+        )
+        log.info(
+            "streaming plan: %d DM trials, chunk %d (+%d hold), "
+            "max delay %d, widths %s", ndm, chunk, hold, md,
+            [int(x) for x in widths],
+        )
+
+        # --- AOT warmup (persistent cache; overlaps nothing yet, but a
+        # warmed cache makes even the FIRST chunk compile-free) --------
+        if cfg.warmup:
+            tel.set_stage("warmup")
+            t0 = time.perf_counter()
+            from ..perf.warmup import warm_registry
+
+            rep = warm_registry(
+                ctx=self.shape_ctx(fmt, plan, widths, hold),
+                programs=[
+                    "ops.dedisperse.dedisperse_block",
+                    "ops.streaming.stream_chunk_search",
+                ],
+            )
+            timers["warmup"] = time.perf_counter() - t0
+            tel.event(
+                "stream_warmup", seconds=round(timers["warmup"], 3),
+                compiled=rep.compiled, cache_hits=rep.cache_hits,
+                errors=[p.name for p in rep.errors],
+            )
+
+        # --- devices-resident constants & programs ---------------------
+        delays_dev = jnp.asarray(plan.delay_samples())
+        kill_dev = jnp.asarray(plan.killmask.astype(np.float32))
+        chunk_fn = make_stream_chunk_fn(
+            widths, float(cfg.min_snr), cfg.max_events, dec, hold, chunk
+        )
+        tail = jnp.zeros((ndm, hold), jnp.uint8)
+
+        # --- ingest ----------------------------------------------------
+        sink = TriggerSink(cfg.outdir, limit=cfg.limit, run_id=tel.run_id)
+        self._sink = sink
+        q = BoundedBlockQueue(cfg.queue_blocks, cfg.policy)
+        self._queue = q
+        tel.set_status_section("streaming", self._status_section)
+        reader = threading.Thread(
+            target=self._read, args=(source, q, tel),
+            name="peasoup-stream-reader", daemon=True,
+        )
+        reader.start()
+        tel.set_stage("streaming")
+
+        nchans = fmt.nchans
+        buf = np.zeros((0, nchans), dtype=np.uint8)
+        expected = 0  # next absolute input sample the reader owes us
+        valid_in = None  # total input samples (known once final block seen)
+        ended = False
+        drop_reported = 0
+        k = 0
+        t_last_status = 0.0
+
+        while True:
+            # --- assemble the input window [k*chunk, k*chunk + w_in) --
+            t_ready = None
+            while buf.shape[0] < w_in and not ended:
+                blk = q.get(timeout=0.25)
+                if blk is None:
+                    if q.closed:
+                        ended = True
+                    continue
+                with self._lock:
+                    if self._first_arrival is None:
+                        self._first_arrival = blk.t_arrival_s
+                    self._last_arrival = blk.t_arrival_s
+                    self._received_samples += int(blk.nvalid)
+                t_ready = blk.t_arrival_s
+                if blk.start_sample > expected:
+                    gap = blk.start_sample - expected
+                    with self._lock:
+                        self._gap_samples += gap
+                    tel.event(
+                        "stream_gap_fill", samples=int(gap),
+                        at_sample=int(expected),
+                    )
+                    log.warning(
+                        "gap of %d samples at %d (dropped upstream); "
+                        "zero-filling", gap, expected,
+                    )
+                    buf = np.concatenate(
+                        [buf, np.zeros((gap, nchans), np.uint8)]
+                    )
+                    expected += gap
+                data = blk.data[: blk.nvalid]
+                if blk.start_sample < expected:  # overlap: trim stale rows
+                    data = data[expected - blk.start_sample :]
+                buf = np.concatenate([buf, data]) if len(data) else buf
+                expected = max(expected, blk.start_sample + blk.nvalid)
+                if blk.final:
+                    valid_in = blk.start_sample + blk.nvalid
+                drops = q.drops
+                if drops.blocks > drop_reported:
+                    tel.event(
+                        "stream_drop", blocks=int(drops.blocks),
+                        samples=int(drops.samples), policy=cfg.policy,
+                    )
+                    drop_reported = drops.blocks
+            if self._reader_error is not None:
+                raise RuntimeError(
+                    "stream reader failed"
+                ) from self._reader_error
+            if valid_in is None and ended:
+                valid_in = expected
+            final = ended and buf.shape[0] < w_in
+            total_out = None
+            if valid_in is not None:
+                total_out = max(0, valid_in - md)
+            origin = k * chunk - hold  # absolute sample of window[0]
+            valid_lo = hold if k == 0 else 0
+            nvalid = w
+            if final:
+                if total_out is None or total_out - origin <= valid_lo:
+                    break  # nothing valid left to emit
+                nvalid = min(w, total_out - origin)
+            if cfg.max_chunks and k + 1 >= cfg.max_chunks:
+                final = True
+            if t_ready is None:
+                t_ready = time.perf_counter()
+
+            # --- one chunk through the two compiled programs ----------
+            window_in = buf[:w_in]
+            if window_in.shape[0] < w_in:
+                window_in = np.concatenate(
+                    [
+                        window_in,
+                        np.zeros(
+                            (w_in - window_in.shape[0], nchans), np.uint8
+                        ),
+                    ]
+                )
+            t0 = time.perf_counter()
+            new = dedisperse_block(
+                jnp.asarray(window_in), delays_dev, kill_dev,
+                out_nsamps=chunk, quantize=True, scale=scale,
+            )
+            new.block_until_ready()
+            t1 = time.perf_counter()
+            timers["dedispersion"] += t1 - t0
+            emit_lo = valid_lo // dec
+            emit_hi = (w // dec) if final else (chunk // dec)
+            ss, sw, ssn, sc = chunk_fn(
+                tail, new, jnp.int32(valid_lo), jnp.int32(nvalid),
+                jnp.int32(emit_lo), jnp.int32(emit_hi),
+            )
+            ss = np.asarray(ss)
+            sw = np.asarray(sw)
+            ssn = np.asarray(ssn)
+            sc = np.asarray(sc)
+            timers["searching"] += time.perf_counter() - t1
+            tail = new[:, chunk - hold :]
+            buf = buf[chunk:]
+            t_done = time.perf_counter()
+
+            # --- event extraction (absolute samples) ------------------
+            recs = []
+            kmax = ss.shape[1]
+            for d in range(ndm):
+                c = int(sc[d])
+                if c > kmax:
+                    with self._lock:
+                        self._n_overflowed += 1
+                for i in range(min(c, kmax)):
+                    recs.append(
+                        (d, origin + int(ss[d, i]), int(sw[d, i]),
+                         float(ssn[d, i]))
+                    )
+            if recs:
+                with self._lock:
+                    self._pending = np.concatenate(
+                        [
+                            self._pending,
+                            np.asarray(recs, dtype=_EVENT_DTYPE),
+                        ]
+                    )
+            emit_hi_abs = origin + emit_hi * dec
+            with self._lock:
+                self._n_events += len(recs)
+                self._chunks_done = k + 1
+                self._spans.append((origin, emit_hi_abs, t_ready))
+                if len(self._spans) > 64:
+                    self._spans = self._spans[-64:]
+                lat = t_done - t_ready
+                self._latencies.append(lat)
+                if len(self._latencies) > 1024:
+                    self._latencies = self._latencies[-1024:]
+                if lat > cfg.latency_slo_s:
+                    self._slo_misses += 1
+                    miss = self._slo_misses
+                else:
+                    miss = 0
+            if miss:
+                tel.event(
+                    "stream_slo_miss", chunk=k,
+                    latency_s=round(lat, 4), slo_s=cfg.latency_slo_s,
+                    misses=miss,
+                )
+
+            # --- compile accounting (the zero-recompile contract) -----
+            from ..campaign.runner import jit_programs_compiled
+
+            compiled = jit_programs_compiled(tel)
+            if k == 0:
+                self._jit_first = compiled
+            else:
+                steady = compiled - self._jit_first
+                if steady > self._jit_steady:
+                    tel.event(
+                        "stream_steady_recompile", chunk=k,
+                        programs=steady - self._jit_steady,
+                    )
+                    log.warning(
+                        "chunk %d recompiled %d program(s) in steady "
+                        "state — a shape leaked", k,
+                        steady - self._jit_steady,
+                    )
+                self._jit_steady = steady
+
+            # --- confirm + emit triggers ------------------------------
+            t0 = time.perf_counter()
+            frontier = float("inf") if final else float(emit_hi_abs)
+            confirmed = self._confirm(
+                frontier, widths, plan.dm_list, fmt.tsamp
+            )
+            now = time.perf_counter()
+            for cand in confirmed:
+                rec = sink.emit(
+                    cand,
+                    latency_s=self._latency_for_sample(cand.sample, now),
+                )
+                tel.event(
+                    "stream_trigger", seq=rec["seq"],
+                    dm=rec["dm"], snr=rec["snr"],
+                    sample=rec["sample"], width=rec["width"],
+                    latency_s=rec["latency_s"],
+                )
+            if confirmed or (k % max(1, cfg.flush_every)) == 0:
+                sink.flush_table()
+            timers["clustering"] += time.perf_counter() - t0
+            tel.set_progress(k + 1, unit="chunks")
+            if t_done - t_last_status > 1.0:
+                t_last_status = t_done
+                st = self._status_section()
+                tel.gauge("stream.queue_depth", st.get(
+                    "queue_depth_blocks", 0
+                ))
+                tel.gauge("stream.triggers", sink.n_emitted)
+                tel.gauge(
+                    "stream.drop_samples",
+                    st["drops"]["samples"] + st["gap_samples"]
+                    if "drops" in st else st["gap_samples"],
+                )
+            k += 1
+            if final:
+                break
+
+        # --- drain ------------------------------------------------------
+        tel.set_stage("drain")
+        confirmed = self._confirm(
+            float("inf"), widths, plan.dm_list, fmt.tsamp
+        )
+        now = time.perf_counter()
+        for cand in confirmed:
+            rec = sink.emit(
+                cand, latency_s=self._latency_for_sample(cand.sample, now)
+            )
+            tel.event(
+                "stream_trigger", seq=rec["seq"], dm=rec["dm"],
+                snr=rec["snr"], sample=rec["sample"],
+                width=rec["width"], latency_s=rec["latency_s"],
+            )
+        sink.close()
+        source.close()
+        timers["total"] = time.perf_counter() - t_total
+
+        drops = q.drops
+        st = self._status_section()
+        total_out_final = int(total_out or 0)
+        tel.gauge("stream.chunks", self._chunks_done)
+        tel.gauge("stream.triggers", sink.n_emitted)
+        tel.gauge("stream.events", self._n_events)
+        tel.gauge("stream.drop_blocks", drops.blocks)
+        tel.gauge("stream.drop_samples", drops.samples)
+        tel.gauge("stream.gap_samples", self._gap_samples)
+        tel.gauge("stream.slo_misses", self._slo_misses)
+        tel.gauge("stream.jit_programs_steady", self._jit_steady)
+        if self._n_overflowed:
+            log.warning(
+                "%d chunk-trials overflowed the %d-event compaction",
+                self._n_overflowed, cfg.max_events,
+            )
+            tel.event(
+                "sp_event_overflow", trials=self._n_overflowed,
+                max_events=cfg.max_events,
+            )
+        tel.event(
+            "stream_drained", chunks=self._chunks_done,
+            triggers=sink.n_emitted, events=self._n_events,
+            drops=drops.to_doc(), gap_samples=self._gap_samples,
+            slo_misses=self._slo_misses,
+            jit_programs_steady=self._jit_steady,
+        )
+        log.info(
+            "stream drained: %d chunks, %d events, %d triggers, "
+            "%d dropped blocks, %d steady-state recompiles",
+            self._chunks_done, self._n_events, sink.n_emitted,
+            drops.blocks, self._jit_steady,
+        )
+        return StreamResult(
+            candidates=sink.candidates,
+            dm_list=plan.dm_list,
+            widths=widths,
+            n_chunks=self._chunks_done,
+            n_triggers=sink.n_emitted,
+            n_events=self._n_events,
+            n_overflowed=self._n_overflowed,
+            total_out_samples=total_out_final,
+            drops={**drops.to_doc(), "gap_samples": self._gap_samples},
+            latency=st["latency_s"],
+            timers=timers,
+            jit_programs_first_chunk=self._jit_first,
+            jit_programs_steady=self._jit_steady,
+        )

@@ -37,6 +37,60 @@ def _bar(frac: float, width: int = 24) -> str:
     return "#" * filled + "." * (width - filled)
 
 
+def _fmt_s(v) -> str:
+    return f"{v * 1e3:.0f}ms" if isinstance(v, (int, float)) else "-"
+
+
+def render_streaming(sec: dict) -> list[str]:
+    """Lines for a status snapshot's ``streaming`` section (written by
+    peasoup_tpu/stream/driver.py; schema-dispatched on the key like
+    the campaign rollup view)."""
+    lines = []
+    rate = sec.get("input_rate_sps")
+    bits = [
+        f"  stream: chunk {sec.get('chunks_done', 0)}  "
+        f"triggers={sec.get('triggers', 0)}  "
+        f"events={sec.get('events', 0)}"
+    ]
+    if rate:
+        bits.append(f"in {rate:,.0f} samp/s")
+    lines.append("  ".join(bits))
+    depth = sec.get("queue_depth_blocks")
+    if depth is not None:
+        lines.append(
+            f"  queue {depth}/{sec.get('queue_capacity_blocks', '?')} "
+            f"blocks ({sec.get('policy', '?')})  "
+            f"{sec.get('chunks_behind', 0):g} chunks behind real-time"
+        )
+    lat = sec.get("latency_s") or {}
+    slo = lat.get("slo")
+    misses = lat.get("misses", 0)
+    line = (
+        f"  latency p50 {_fmt_s(lat.get('p50'))}  "
+        f"p95 {_fmt_s(lat.get('p95'))}  "
+        f"max {_fmt_s(lat.get('max'))}"
+        + (f"  SLO {_fmt_s(slo)}" if slo is not None else "")
+    )
+    if misses:
+        line += f"  *** {misses} SLO MISS{'ES' if misses > 1 else ''} ***"
+    lines.append(line)
+    drops = sec.get("drops") or {}
+    dropped = drops.get("blocks", 0)
+    gaps = sec.get("gap_samples", 0)
+    if dropped or gaps:
+        lines.append(
+            f"  *** DROPPED {dropped} blocks "
+            f"({drops.get('samples', 0)} samples); "
+            f"{gaps} samples zero-filled ***"
+        )
+    steady = sec.get("jit_programs_steady", 0)
+    if steady:
+        lines.append(
+            f"  *** {steady} steady-state recompile(s): a shape leaked ***"
+        )
+    return lines
+
+
 def render_status(st: dict, stale_after: float = 0.0) -> str:
     """One compact text block for a status snapshot."""
     prog = st.get("progress") or {}
@@ -69,6 +123,8 @@ def render_status(st: dict, stale_after: float = 0.0) -> str:
     mem = (st.get("gauges") or {}).get("memory.peak_bytes")
     if mem:
         lines.append(f"  device memory high-water: {mem / 1e9:.2f} GB")
+    if isinstance(st.get("streaming"), dict):
+        lines.extend(render_streaming(st["streaming"]))
     if st.get("stalled"):
         lines.append(
             f"  *** STALLED: no progress for "

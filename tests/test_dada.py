@@ -1,8 +1,13 @@
-"""DadaHeader parity tests (reference: include/data_types/header.hpp:52-161)."""
+"""DadaHeader parity tests (reference: include/data_types/header.hpp:52-161)
+plus the write path (tofile/write_dada) the streaming replay source and
+the stream tests use to synthesise valid DADA segments."""
+
+import os
 
 import numpy as np
+import pytest
 
-from peasoup_tpu.io.dada import DADA_HDR_SIZE, DadaHeader
+from peasoup_tpu.io.dada import DADA_HDR_SIZE, DadaHeader, write_dada
 
 HDR = """HDR_VERSION 1.0
 HDR_SIZE 4096
@@ -58,3 +63,74 @@ def test_dada_missing_keys_are_defaults(tmp_path):
         f.write(b"HDR_VERSION 1.0\n".ljust(DADA_HDR_SIZE, b"\x00"))
     h = DadaHeader.fromfile(path)
     assert h.nchan == 0 and h.source_name == "" and h.nsamples == 0
+
+
+def test_dada_comment_lines_are_ignored(tmp_path):
+    path = tmp_path / "c.dada"
+    hdr = (
+        "# recorder dump v2\n"
+        "# NCHAN 9999  (commented out: must not shadow the live key)\n"
+        "HDR_VERSION 1.0\n"
+        "NCHAN 512\n"
+        "NBIT 8\n"
+        "  # indented comment with FREQ 1.0 inside\n"
+        "FREQ 1284.0\n"
+    )
+    with open(path, "wb") as f:
+        f.write(hdr.encode().ljust(DADA_HDR_SIZE, b"\x00"))
+    h = DadaHeader.fromfile(path)
+    assert h.nchan == 512
+    assert h.freq == 1284.0
+
+
+def test_dada_trailing_nuls_do_not_leak_into_values(tmp_path):
+    path = tmp_path / "n.dada"
+    # last key/value flush against the NUL padding (no trailing \n)
+    hdr = b"HDR_VERSION 1.0\nSOURCE J1234-56"
+    with open(path, "wb") as f:
+        f.write(hdr.ljust(DADA_HDR_SIZE, b"\x00"))
+        f.write(b"\x00" * 64)
+    h = DadaHeader.fromfile(path)
+    assert h.source_name == "J1234-56"
+
+
+def test_dada_tofile_roundtrip(tmp_path):
+    path = tmp_path / "rt.dada"
+    payload = np.arange(1024 * 2 * 10, dtype=np.uint8)
+    src = DadaHeader(
+        header_version=1.0, bw=400.0, freq=1382.0, nant=1, nchan=1024,
+        ndim=2, npol=1, nbit=8, tsamp=0.00064,
+        source_name="J0437-4715", ra="04:37:15.8", dec="-47:15:09.1",
+        telescope="MeerKAT", instrument="CBF", dada_filesize=8388608,
+        bytes_per_sec=1600000000, utc_start="2014-02-13-05:52:12",
+        ant_id=3, file_no=7,
+    )
+    src.tofile(path, payload)
+    assert os.path.getsize(path) == DADA_HDR_SIZE + payload.size
+    h = DadaHeader.fromfile(path)
+    for fname in (
+        "header_version", "bw", "freq", "nant", "nchan", "ndim",
+        "npol", "nbit", "tsamp", "source_name", "ra", "dec",
+        "telescope", "instrument", "dada_filesize", "bytes_per_sec",
+        "utc_start", "ant_id", "file_no",
+    ):
+        assert getattr(h, fname) == getattr(src, fname), fname
+    assert h.filesize == payload.size
+    # reference quirk preserved: nsamples = filesize/nchan/nant/npol/2
+    assert h.nsamples == 10
+
+
+def test_write_dada_helper(tmp_path):
+    path = tmp_path / "w.dada"
+    payload = np.zeros((100, 16), dtype=np.uint8)
+    h = write_dada(path, payload, nchan=16, nbit=8, freq=1284.0, bw=64.0)
+    assert h.nchan == 16
+    back = DadaHeader.fromfile(path)
+    assert back.nchan == 16 and back.freq == 1284.0 and back.bw == 64.0
+    assert back.filesize == payload.size
+
+
+def test_dada_tofile_rejects_oversized_header(tmp_path):
+    h = DadaHeader(source_name="x" * (DADA_HDR_SIZE + 1))
+    with pytest.raises(ValueError, match="exceeds"):
+        h.tofile(tmp_path / "big.dada")
