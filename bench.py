@@ -576,6 +576,30 @@ def main() -> int:
                     file=sys.stderr,
                 )
 
+    # dedispersion planner provenance (ISSUE 8): the auto-tuned plan
+    # for this observation's shape bucket on THIS device, tuned into a
+    # throwaway cache so the record carries real measured tuning time.
+    # Best-effort: a failure voids these fields, not the record.
+    plan_fields: dict = {}
+    try:
+        import tempfile
+
+        from peasoup_tpu.perf.tuning import resolve_plan_for_filterbank
+
+        t_tune = time.time()
+        with tempfile.TemporaryDirectory() as td:
+            dplan = resolve_plan_for_filterbank(
+                fil, "search", SearchConfig(**grid),
+                cache_path=os.path.join(td, "tuning_cache.json"),
+            )
+        plan_fields = {
+            "dedisp_plan": dplan.summary(),
+            "tuning_s": round(time.time() - t_tune, 3),
+        }
+        print(f"dedisp plan: {plan_fields}", file=sys.stderr)
+    except Exception as exc:
+        print(f"dedisp-plan tuning failed: {exc!r}", file=sys.stderr)
+
     # weather-proof primary (BASELINE.md "Official benchmark
     # definition, round 4"): the chip's brute-force rate by device-busy
     # time; min-wall fallback if the trace failed
@@ -633,6 +657,7 @@ def main() -> int:
                     if dedupe_device_s
                     else 0.0
                 ),
+                **plan_fields,
                 **big,
             }
         )

@@ -270,15 +270,23 @@ class JobQueue:
         )
 
     def claim_next(
-        self, worker_id: str, prefer_bucket: tuple | None = None
+        self,
+        worker_id: str,
+        prefer_bucket: tuple | None = None,
+        warm_buckets: "set[tuple] | frozenset[tuple] | None" = None,
     ) -> Claim | None:
         """Claim the next eligible job. Jobs sharing ``prefer_bucket``
-        (the worker's previous shape bucket) come first, then the
-        remainder grouped BY bucket — so a fleet of workers naturally
-        partitions into shape-coherent streaks and consecutive jobs hit
-        the compiled-program caches (see runner.py)."""
+        (the worker's previous shape bucket) come first, then jobs
+        whose bucket is in ``warm_buckets`` (buckets already
+        warmed/tuned — this worker's own plus any recorded in the
+        campaign's done records, see runner.py), then the remainder —
+        each tier grouped BY bucket — so a fleet of workers naturally
+        partitions into shape-coherent streaks, consecutive jobs hit
+        the compiled-program caches, and already-paid warmup/tuning
+        work is exploited before any new bucket is opened."""
         self.reap_stale()
         now = time.time()
+        warm = {tuple(b) for b in warm_buckets} if warm_buckets else set()
         eligible: list[tuple[tuple, str]] = []
         for jid in self.job_ids():
             if self.state(jid, now) != "pending":
@@ -287,9 +295,14 @@ class JobQueue:
             if job is None:
                 continue
             bucket = job.bucket or ()
+            if prefer_bucket and bucket == tuple(prefer_bucket):
+                tier = 0
+            elif bucket and tuple(bucket) in warm:
+                tier = 1
+            else:
+                tier = 2
             rank = (
-                0 if (prefer_bucket and bucket == tuple(prefer_bucket))
-                else 1,
+                tier,
                 tuple(str(x) for x in bucket),
                 jid,
             )

@@ -83,6 +83,24 @@ def build_status(root: str, queue: JobQueue | None = None) -> dict:
     n_candidates = sum(int(d.get("n_candidates", 0) or 0) for d in done)
     warmup_s = sum(float(d.get("warmup_s", 0) or 0) for d in done)
     warmed_jobs = sum(1 for d in done if d.get("warmup_s") is not None)
+    tuning_s = sum(float(d.get("tuning_s", 0) or 0) for d in done)
+    # per-bucket warmup/tuning tallies: the data warmup-aware claiming
+    # (runner._warm_bucket_hint) exploits, surfaced for operators
+    warm_buckets: dict[str, dict] = {}
+    for d in done:
+        b = d.get("bucket")
+        if not b:
+            continue
+        key = ",".join(str(x) for x in b)
+        rec = warm_buckets.setdefault(
+            key, {"done": 0, "warmup_s": 0.0, "plan": None}
+        )
+        rec["done"] += 1
+        rec["warmup_s"] = round(
+            rec["warmup_s"] + float(d.get("warmup_s", 0) or 0), 3
+        )
+        if d.get("dedisp_plan") is not None:
+            rec["plan"] = d["dedisp_plan"]
     quarantined = [
         {
             "job_id": q.get("job_id"),
@@ -108,6 +126,11 @@ def build_status(root: str, queue: JobQueue | None = None) -> dict:
         # across all workers' first-of-bucket jobs (perf/warmup.py)
         "warmup_total_s": round(warmup_s, 3),
         "warmup_jobs": warmed_jobs,
+        # dedispersion auto-tuning rollup (perf/tuning.py): measuring
+        # time paid (once per bucket per device) and the per-bucket
+        # warm/plan tallies warmup-aware claiming reads
+        "tuning_total_s": round(tuning_s, 3),
+        "warm_buckets": warm_buckets,
     }
 
 

@@ -85,6 +85,16 @@ class CampaignConfig:
     # driver-internal shapes are approximated). See perf/warmup.py.
     warmup: bool = True
     warmup_mode: str = "dryrun"  # "dryrun" | "aot"
+    # auto-tuned dedispersion plans (perf/tuning.py): each new bucket
+    # resolves exact-vs-subband + per-device shape knobs on the warmup
+    # thread (overlapping the first observation's read) and persists
+    # the winner in the campaign-shared tuning cache, so every other
+    # worker/job of the bucket loads the plan with zero re-measurement
+    tune: bool = False
+    tuning_cache: str = ""  # "" = <campaign root>/tuning_cache.json
+
+    def tuning_cache_path(self, root: str) -> str:
+        return self.tuning_cache or os.path.join(root, "tuning_cache.json")
 
     def to_doc(self) -> dict:
         return {
@@ -294,10 +304,30 @@ def jit_programs_compiled(tel: RunTelemetry) -> int:
     return max(0, compiled - hits)
 
 
+def tuned_overrides(
+    overrides: dict, plan_doc: dict, pipeline: str
+) -> dict:
+    """Merge a resolved dedispersion plan's shape knobs into the job's
+    pipeline overrides. Operator-set knobs always win (an explicit
+    ``subbands``/``dedisp_block`` in the campaign or job config is a
+    decision, not a default), and in-driver re-resolution is disabled
+    — the campaign already resolved the plan for this bucket."""
+    out = dict(overrides)
+    if pipeline == "search" and not overrides.get("subbands"):
+        if plan_doc.get("engine") == "subband":
+            out["subbands"] = int(plan_doc["subbands"])
+            out["subband_smear"] = float(plan_doc.get("subband_smear", 1.0))
+    if "dedisp_block" not in overrides and plan_doc.get("dedisp_block"):
+        out["dedisp_block"] = int(plan_doc["dedisp_block"])
+    out["tune"] = False
+    return out
+
+
 def run_observation(
     job: Job, overrides: dict, job_dir: str, tel: RunTelemetry,
     bucket_ladder: list[int] | None = None,
     warmer: "_BucketWarmer | None" = None,
+    tuning_cache: str | None = None,
 ) -> dict:
     """Execute one observation end-to-end inside this process and write
     its outputs (overview.xml + pipeline-specific candidate files)
@@ -342,6 +372,27 @@ def run_observation(
             "warmup.programs_compiled",
             int(warmup_stats["programs_compiled"]),
         )
+
+    plan_doc = None
+    if tuning_cache and job.bucket:
+        # resolve AFTER the warmer join: the warmer tuned a cold bucket
+        # on its thread and persisted the plan, so this is a pure cache
+        # hit (zero measurements) for it and for every later job
+        try:
+            from ..perf.tuning import resolve_plan_for_bucket
+
+            plan_doc = resolve_plan_for_bucket(
+                tuple(job.bucket), job.pipeline, overrides, tuning_cache
+            ).summary()
+        except Exception as exc:
+            log.warning(
+                "tuned-plan resolution failed for %s: %.200s",
+                job.job_id, exc,
+            )
+        if plan_doc is not None:
+            overrides = tuned_overrides(overrides, plan_doc, job.pipeline)
+            tel.event("dedisp_plan", **plan_doc)
+            tel.set_context(dedisp_plan=plan_doc)
 
     outdir = job_dir.rstrip("/")
     if job.pipeline == "spsearch":
@@ -411,31 +462,65 @@ def run_observation(
     if warmup_stats is not None:
         info["warmup_s"] = float(warmup_stats["seconds"])
         info["warmup"] = warmup_stats
+        if warmup_stats.get("tuning") is not None:
+            # the warmer thread did the actual measuring for this
+            # bucket; attribute the tuning wall to ITS job only (later
+            # jobs are cache hits and must not re-count it)
+            info["tuning_s"] = float(
+                warmup_stats["tuning"].get("tuning_s", 0.0)
+            )
+    if plan_doc is not None:
+        info["dedisp_plan"] = plan_doc
     return info
 
 
 class _BucketWarmer(threading.Thread):
-    """Background AOT warmup for one shape bucket, started when a
-    worker claims the first job of a bucket it has not warmed yet. It
-    overlaps the job's filterbank read: the driver joins (``result``)
-    after reading, before the pipeline dispatches. Runs on its own
-    thread context, so its compiles never count against the job's
-    telemetry JIT stats — by the time the pipeline runs, every program
-    is in the in-process jit caches (dryrun) or the persistent
-    compilation cache (aot)."""
+    """Background AOT warmup (and, with ``tuning_cache``, dedispersion
+    auto-tuning) for one shape bucket, started when a worker claims the
+    first job of a bucket it has not warmed yet. It overlaps the job's
+    filterbank read: the driver joins (``result``) after reading,
+    before the pipeline dispatches. Tuning runs FIRST, so the warmup
+    compiles the tuned shapes and the plan is already persisted in the
+    campaign's tuning cache when the job (and every other worker)
+    resolves it — pure cache hits from then on. Runs on its own thread
+    context, so its compiles never count against the job's telemetry
+    JIT stats — by the time the pipeline runs, every program is in the
+    in-process jit caches (dryrun) or the persistent compilation cache
+    (aot)."""
 
     def __init__(
         self, bucket: tuple, pipeline: str, overrides: dict,
-        scratch_dir: str, mode: str,
+        scratch_dir: str, mode: str, tuning_cache: str | None = None,
     ) -> None:
         super().__init__(name="campaign-warmup", daemon=True)
         self._args = (bucket, pipeline, overrides, scratch_dir, mode)
+        self._tuning_cache = tuning_cache
         self._stats: dict | None = None
 
     def run(self) -> None:
         from ..perf.warmup import warm_bucket
 
-        self._stats = warm_bucket(*self._args)
+        bucket, pipeline, overrides, scratch_dir, mode = self._args
+        tuning = None
+        if self._tuning_cache:
+            try:
+                from ..perf.tuning import resolve_plan_for_bucket
+
+                plan = resolve_plan_for_bucket(
+                    bucket, pipeline, overrides, self._tuning_cache
+                )
+                tuning = plan.summary()
+                overrides = tuned_overrides(
+                    overrides, tuning, pipeline
+                )
+            except Exception as exc:
+                log.warning(
+                    "bucket tuning failed for %s: %.200s", bucket, exc
+                )
+        self._stats = warm_bucket(
+            bucket, pipeline, overrides, scratch_dir, mode
+        )
+        self._stats["tuning"] = tuning
 
     def result(self, timeout: float | None = None) -> dict:
         self.join(timeout=timeout)
@@ -445,6 +530,7 @@ class _BucketWarmer(threading.Thread):
                 "bucket": list(bucket), "mode": mode, "seconds": 0.0,
                 "programs_compiled": 0, "cache_hits": 0,
                 "error": "warmup thread produced no result",
+                "tuning": None,
             }
         return self._stats
 
@@ -492,6 +578,10 @@ class CampaignRunner:
         self.worker_id = worker_id or JobQueue.default_worker_id()
         self._last_bucket: tuple | None = None
         self._warmed_buckets: set[tuple] = set()
+        self._tuning_cache = (
+            self.campaign.tuning_cache_path(self.root)
+            if self.campaign.tune else None
+        )
         # the persistent XLA cache backs the in-process caches across
         # worker restarts (utils/cache.py)
         from ..utils.cache import enable_compilation_cache
@@ -533,6 +623,7 @@ class CampaignRunner:
                 {**self.campaign.config, **job.config},
                 os.path.join(self.root, "warmup", job.job_id),
                 self.campaign.warmup_mode,
+                tuning_cache=self._tuning_cache,
             )
             warmer.start()
             self._warmed_buckets.add(tuple(job.bucket))
@@ -554,6 +645,7 @@ class CampaignRunner:
                         job, overrides, job_dir, tel,
                         bucket_ladder=self.campaign.bucket_nsamps,
                         warmer=warmer,
+                        tuning_cache=self._tuning_cache,
                     )
                     compiled = jit_programs_compiled(tel)
                     info["jit_programs_compiled"] = compiled
@@ -612,6 +704,27 @@ class CampaignRunner:
         )
         return "done"
 
+    # --- warmup-aware claiming ----------------------------------------
+    def _warm_bucket_hint(self) -> set[tuple]:
+        """Buckets whose warmup/tuning has already been paid for: this
+        worker's own warmed set unioned with every bucket a done
+        record carries warmup tallies for (the same data the rollup's
+        warm-bucket summary aggregates) — so a worker joining a
+        running campaign prefers already-warm buckets over opening a
+        cold one, maximising bucket streaks."""
+        warm = set(self._warmed_buckets)
+        try:
+            for doc in self.queue.done_records():
+                b = doc.get("bucket")
+                if b and (
+                    doc.get("warmup_s") is not None
+                    or doc.get("dedisp_plan") is not None
+                ):
+                    warm.add(tuple(b))
+        except Exception:  # a torn done record must not stall claiming
+            log.debug("warm-bucket hint scan failed", exc_info=True)
+        return warm
+
     # --- the loop -----------------------------------------------------
     def run(
         self,
@@ -629,7 +742,8 @@ class CampaignRunner:
             if max_jobs is not None and processed >= max_jobs:
                 break
             claim = self.queue.claim_next(
-                self.worker_id, prefer_bucket=self._last_bucket
+                self.worker_id, prefer_bucket=self._last_bucket,
+                warm_buckets=self._warm_bucket_hint(),
             )
             if claim is None:
                 write_status(self.root, self.queue)

@@ -91,6 +91,17 @@ def build_parser() -> argparse.ArgumentParser:
                      help="AOT-compile each new bucket's programs on a "
                      "background thread before its first job touches "
                      "data (default on; --no-warmup disables)")
+    run.add_argument("--tune", action=argparse.BooleanOptionalAction,
+                     default=False,
+                     help="auto-tuned dedispersion plans: each new "
+                     "bucket resolves exact-vs-subband + per-device "
+                     "shape knobs on the warmup thread and persists "
+                     "the winner in the campaign tuning cache "
+                     "(warm buckets re-measure nothing)")
+    run.add_argument("--tuning-cache", default="",
+                     help="tuning_cache.json path (default: "
+                     "<workdir>/tuning_cache.json, shared by all "
+                     "workers)")
     run.add_argument("--warmup-mode", default="dryrun",
                      choices=["dryrun", "aot"],
                      help="dryrun = run the pipeline once over a "
@@ -170,6 +181,8 @@ def _cmd_run(args) -> int:
             bucket_nsamps=ladder,
             warmup=args.warmup,
             warmup_mode=args.warmup_mode,
+            tune=args.tune,
+            tuning_cache=args.tuning_cache,
         ),
     )
     queue = JobQueue(

@@ -75,6 +75,18 @@ def build_parser() -> argparse.ArgumentParser:
         "when --subbands is set (0 = exact)",
     )
     p.add_argument(
+        "--tune", action=argparse.BooleanOptionalAction, default=False,
+        help="auto-select exact-vs-subband dedispersion and load "
+        "per-device tuned shape knobs from the tuning cache "
+        "(plan/dedisp_plan.py + perf/tuning.py); an explicit "
+        "--subbands overrides the planner",
+    )
+    p.add_argument(
+        "--tuning-cache", default="",
+        help="tuning_cache.json path (default: the per-user cache, "
+        "or PEASOUP_TUNING_CACHE)",
+    )
+    p.add_argument(
         "--checkpoint", default="",
         help="Checkpoint file for resumable searches (TPU extension; "
         "the reference has no checkpointing)",
@@ -171,6 +183,8 @@ def main(argv: list[str] | None = None) -> int:
         dedupe_accel=not args.no_accel_dedupe,
         subbands=args.subbands,
         subband_smear=args.subband_smear,
+        tune=args.tune,
+        tuning_cache=args.tuning_cache,
     )
     # multi-host aware (JAX_COORDINATOR_ADDRESS & co.): each process
     # searches its DM slice; single-process this is PeasoupSearch.run

@@ -97,6 +97,17 @@ def build_parser() -> argparse.ArgumentParser:
         "--dm_block", type=int, default=0,
         help="DM trials per device call (0 = auto from the HBM budget)",
     )
+    p.add_argument(
+        "--tune", action=argparse.BooleanOptionalAction, default=False,
+        help="load per-device tuned dedispersion shape knobs from the "
+        "tuning cache (perf/tuning.py), measuring once per new shape "
+        "bucket",
+    )
+    p.add_argument(
+        "--tuning-cache", default="",
+        help="tuning_cache.json path (default: the per-user cache, "
+        "or PEASOUP_TUNING_CACHE)",
+    )
     p.add_argument("-v", "--verbose", action="store_true")
     p.add_argument("-p", "--progress_bar", action="store_true")
     add_version_arg(p)
@@ -148,6 +159,8 @@ def main(argv: list[str] | None = None) -> int:
         dm_block=args.dm_block,
         hbm_bytes=args.hbm_bytes,
         checkpoint_file=args.checkpoint,
+        tune=args.tune,
+        tuning_cache=args.tuning_cache,
     )
     os.makedirs(outdir.rstrip("/"), exist_ok=True)
     with tel.activate(), live_observability(

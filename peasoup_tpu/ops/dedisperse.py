@@ -642,6 +642,28 @@ register_program(
     ),
     param=_param_unpack,
 )
+def _param_subband_stage1(ctx):
+    # the tuned-plan subband path (plan/dedisp_plan.py selects, the
+    # tuning cache persists): compile stage 1 at the bucket's grouped
+    # filterbank geometry. Declines non-subband ctxs.
+    if ctx.subbands <= 0:
+        return None
+    c = ctx.nchans
+    w = -(-c // max(1, min(ctx.subbands, c)))
+    nsub = -(-c // w)
+    nb1 = -(-ctx.out_nsamps // 128) + 2
+    tpad = (-(-ctx.nsamps // 128) + 3) * 128
+    return (
+        _subband_stage1,
+        (
+            sds((nsub, w, tpad), "uint8"),
+            sds((nsub, w), "float32"),
+            sds((nsub, w), "int32"),
+        ),
+        {"nb1": nb1},
+    )
+
+
 register_program(
     "ops.dedisperse.subband_stage1",
     lambda: (
@@ -653,6 +675,7 @@ register_program(
         ),
         {"nb1": 2},
     ),
+    param=_param_subband_stage1,
 )
 register_program(
     "ops.dedisperse.subband_stage1_batched",

@@ -279,10 +279,28 @@ def harmonic_sums(
     return out
 
 
-# --- audit registry ---
+# --- audit registry (the ShapeCtx hook rebuilds the conv chain at a
+# periodicity bucket's production tile and fold count) ---
 from .registry import register_program, sds  # noqa: E402
+
+
+def _param_harmonic_sums(ctx):
+    if ctx.fft_size <= 0 or ctx.accel_pad <= 0:
+        return None
+    return (
+        harmonic_sums,
+        (
+            sds(
+                (ctx.dm_block, ctx.accel_pad, ctx.fft_size // 2 + 1),
+                "float32",
+            ),
+        ),
+        {"nharms": min(5, max(1, ctx.nharms))},
+    )
+
 
 register_program(
     "ops.harmonics.harmonic_sums",
     lambda: (harmonic_sums, (sds((512,), "float32"),), {"nharms": 4}),
+    param=_param_harmonic_sums,
 )

@@ -297,8 +297,74 @@ def cluster_peaks(
     return np.asarray(peak_idx, dtype=np.int64), np.asarray(peak_snr, dtype=np.float64)
 
 
-# --- audit registry ---
+# --- audit registry (ShapeCtx hooks rebuild the peaks machinery at a
+# periodicity bucket's production tile: one (dm_block, accel_pad,
+# size_spec) level for the walk, the (dm_block, nlev, accel_pad,
+# max_peaks) slot arrays for the compaction/packing — the shapes the
+# wave loop in pipeline/search.py actually dispatches) ---
 from .registry import register_program, sds  # noqa: E402
+
+
+def _param_find_peaks(ctx):
+    if ctx.fft_size <= 0 or ctx.accel_pad <= 0:
+        return None
+    return (
+        find_peaks_device,
+        (
+            sds((ctx.dm_block, ctx.accel_pad, ctx.fft_size // 2 + 1),
+                "float32"),
+            sds((), "float32"),
+            sds((), "int32"),
+            sds((), "int32"),
+        ),
+        {"max_peaks": ctx.max_peaks, "block": 64},
+    )
+
+
+def _param_cluster_peaks(ctx):
+    if ctx.fft_size <= 0 or ctx.accel_pad <= 0:
+        return None
+    return (
+        cluster_peaks_device,
+        (
+            sds((ctx.dm_block, ctx.accel_pad, ctx.max_peaks), "int32"),
+            sds((ctx.dm_block, ctx.accel_pad, ctx.max_peaks), "float32"),
+            sds((), "int32"),
+        ),
+        {"min_gap": 30},
+    )
+
+
+def _param_compact_peaks(ctx):
+    if ctx.fft_size <= 0 or ctx.accel_pad <= 0:
+        return None
+    cells = (ctx.dm_block, ctx.nharms + 1, ctx.accel_pad)
+    return (
+        compact_peaks_device,
+        (
+            sds((*cells, ctx.max_peaks), "int32"),
+            sds((*cells, ctx.max_peaks), "float32"),
+            sds(cells, "int32"),
+        ),
+        {"total_pad": 4096},
+    )
+
+
+def _param_pack_chunk(ctx):
+    if ctx.fft_size <= 0 or ctx.accel_pad <= 0:
+        return None
+    cells = (ctx.dm_block, ctx.nharms + 1, ctx.accel_pad)
+    return (
+        pack_chunk_results,
+        (
+            sds((*cells, ctx.max_peaks), "int32"),
+            sds((*cells, ctx.max_peaks), "float32"),
+            sds(cells, "int32"),
+            sds(cells, "int32"),
+        ),
+        {"total_pad": 4096},
+    )
+
 
 register_program(
     "ops.peaks.find_peaks_device",
@@ -312,6 +378,7 @@ register_program(
         ),
         {"max_peaks": 64, "block": 64},
     ),
+    param=_param_find_peaks,
 )
 register_program(
     "ops.peaks.cluster_peaks_device",
@@ -320,6 +387,7 @@ register_program(
         (sds((2, 64), "int32"), sds((2, 64), "float32"), sds((), "int32")),
         {"min_gap": 30},
     ),
+    param=_param_cluster_peaks,
 )
 register_program(
     "ops.peaks.compact_peaks_device",
@@ -328,6 +396,7 @@ register_program(
         (sds((2, 64), "int32"), sds((2, 64), "float32"), sds((2,), "int32")),
         {"total_pad": 128},
     ),
+    param=_param_compact_peaks,
 )
 register_program(
     "ops.peaks.pack_chunk_results",
@@ -341,4 +410,5 @@ register_program(
         ),
         {"total_pad": 128},
     ),
+    param=_param_pack_chunk,
 )

@@ -78,7 +78,8 @@ class ShapeCtx:
     ndm: int  # DM trials in the plan
     out_nsamps: int  # dedispersed trial length
     dm_block: int  # DM trials per device wave (driver formula)
-    dedisp_block: int  # dedispersion DM-block size
+    dedisp_block: int  # dedispersion DM-block size (tuned plans flow
+    # in here via perf/tuning.py so warmup compiles the tuned tile)
     widths: tuple[int, ...] = ()  # single-pulse boxcar bank
     min_snr: float = 6.0
     max_events: int = 256
@@ -89,6 +90,20 @@ class ShapeCtx:
     # campaign buckets), so streaming-only hooks skip it
     stream_chunk: int = 0
     stream_hold: int = 0
+    # subband dedispersion (the auto-selected/tuned plan,
+    # plan/dedisp_plan.py): 0 = the direct engine
+    subbands: int = 0
+    subband_smear: float = 1.0
+    # periodicity-chain geometry (pipeline "search" buckets, derived
+    # via plan/accel_plan.py + plan/fft_plan.py in
+    # perf.warmup.shape_ctx_for_bucket): 0 fft_size = not a
+    # periodicity ctx, so the spectrum/resample/harmonics/peaks hooks
+    # decline it
+    fft_size: int = 0
+    nharms: int = 4
+    accel_pad: int = 0  # padded accel-trial columns per DM row
+    max_peaks: int = 128
+    select_smax: int = 0  # gather-free resample span (0 = gather path)
 
 
 @dataclass(frozen=True)

@@ -215,12 +215,55 @@ def resample_accel_quadratic(x: jnp.ndarray, af: jnp.ndarray) -> jnp.ndarray:
     return jnp.take(x, src)
 
 
-# --- audit registry ---
+# --- audit registry (ShapeCtx hooks rebuild the resample programs at
+# a periodicity bucket's (dm_block, accel_pad, fft_size) production
+# tile, derived from the accel plan in perf.warmup.shape_ctx_for_
+# bucket; non-periodicity ctxs decline) ---
 from .registry import register_program, sds  # noqa: E402
+
+
+def _param_resample_accel(ctx):
+    if ctx.fft_size <= 0 or ctx.accel_pad <= 0:
+        return None
+    return (
+        resample_accel,
+        (sds((ctx.fft_size,), "float32"), sds((ctx.accel_pad,), "float32")),
+        {},
+    )
+
+
+def _param_select(fn):
+    def hook(ctx, fn=fn):
+        # the gather-free select only dispatches when the span probe
+        # admits it (pipeline/search.py); mirror that gate here
+        if ctx.fft_size <= 0 or ctx.accel_pad <= 0 or ctx.select_smax <= 0:
+            return None
+        return (
+            fn,
+            (
+                sds((ctx.dm_block, ctx.fft_size), "float32"),
+                sds((ctx.dm_block, ctx.accel_pad), "float32"),
+            ),
+            {"smax": ctx.select_smax},
+        )
+    return hook
+
+
+def _param_select_planes(ctx):
+    base = _param_select(resample_select_packed_planes)(ctx)
+    if base is None or ctx.fft_size & (ctx.fft_size - 1):
+        return None
+    from .pallas.dftspec import plane_factors
+
+    n1, n2 = plane_factors(ctx.fft_size // 2)
+    fn, args, kwargs = base
+    return fn, args, {**kwargs, "n1": n1, "n2": n2}
+
 
 register_program(
     "ops.resample.resample_accel",
     lambda: (resample_accel, (sds((256,), "float32"), sds((4,), "float32")), {}),
+    param=_param_resample_accel,
 )
 register_program(
     "ops.resample.resample_accel_quadratic",
@@ -237,6 +280,7 @@ register_program(
         (sds((4, 256), "float32"), sds((4, 3), "float32")),
         {"smax": 4},
     ),
+    param=_param_select(resample_select),
 )
 register_program(
     "ops.resample.resample_select_packed",
@@ -245,6 +289,7 @@ register_program(
         (sds((4, 256), "float32"), sds((4, 3), "float32")),
         {"smax": 4},
     ),
+    param=_param_select(resample_select_packed),
 )
 register_program(
     "ops.resample.resample_select_packed_planes",
@@ -253,4 +298,5 @@ register_program(
         (sds((4, 256), "float32"), sds((4, 3), "float32")),
         {"smax": 4, "n1": 8, "n2": 16},
     ),
+    param=_param_select_planes,
 )

@@ -63,16 +63,71 @@ def normalise(x: jnp.ndarray, mean: jnp.ndarray, std: jnp.ndarray) -> jnp.ndarra
 
 
 # --- audit registry: these building blocks are pure jnp; the contract
-# engine stages each one standalone over a tiny shape set ---
+# engine stages each one standalone over a tiny shape set. The ShapeCtx
+# hooks rebuild them at a periodicity bucket's production batch — the
+# (dm_block, accel_pad, size_spec) tile the accel-search chain actually
+# traces (derived from the accel plan in perf.warmup.shape_ctx_for_
+# bucket) — so warmup/contracts/microbench see production shapes, not
+# the tiny representatives ---
 from .registry import register_program, sds  # noqa: E402
+
+
+def _spec_tile(ctx):
+    """The periodicity chain's (dm_block, accel_pad, size_spec) tile,
+    or None for non-periodicity ctxs (spsearch/stream buckets)."""
+    if ctx.fft_size <= 0 or ctx.accel_pad <= 0:
+        return None
+    return (ctx.dm_block, ctx.accel_pad, ctx.fft_size // 2 + 1)
+
+
+def _param_form_power(ctx):
+    t = _spec_tile(ctx)
+    return None if t is None else (form_power, (sds(t, "complex64"),), {})
+
+
+def _param_form_interpolated(ctx):
+    t = _spec_tile(ctx)
+    if t is None:
+        return None
+    return (form_interpolated, (sds(t, "complex64"),), {})
+
+
+def _param_form_interpolated_parts(ctx):
+    t = _spec_tile(ctx)
+    if t is None:
+        return None
+    return (
+        form_interpolated_parts,
+        (sds(t, "float32"), sds(t, "float32")),
+        {},
+    )
+
+
+def _param_spectrum_stats(ctx):
+    t = _spec_tile(ctx)
+    return None if t is None else (spectrum_stats, (sds(t, "float32"),), {})
+
+
+def _param_normalise(ctx):
+    t = _spec_tile(ctx)
+    if t is None:
+        return None
+    return (
+        normalise,
+        (sds(t, "float32"), sds(t[:2], "float32"), sds(t[:2], "float32")),
+        {},
+    )
+
 
 register_program(
     "ops.spectrum.form_power",
     lambda: (form_power, (sds((128,), "complex64"),), {}),
+    param=_param_form_power,
 )
 register_program(
     "ops.spectrum.form_interpolated",
     lambda: (form_interpolated, (sds((128,), "complex64"),), {}),
+    param=_param_form_interpolated,
 )
 register_program(
     "ops.spectrum.form_interpolated_parts",
@@ -81,10 +136,12 @@ register_program(
         (sds((128,), "float32"), sds((128,), "float32")),
         {},
     ),
+    param=_param_form_interpolated_parts,
 )
 register_program(
     "ops.spectrum.spectrum_stats",
     lambda: (spectrum_stats, (sds((4, 128), "float32"),), {}),
+    param=_param_spectrum_stats,
 )
 register_program(
     "ops.spectrum.normalise",
@@ -93,4 +150,5 @@ register_program(
         (sds((4, 128), "float32"), sds((4,), "float32"), sds((4,), "float32")),
         {},
     ),
+    param=_param_normalise,
 )

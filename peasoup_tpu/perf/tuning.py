@@ -1,0 +1,402 @@
+"""Per-device empirical tuning of dedispersion shape knobs, and the
+schema-validated tuning cache that makes it pay-once.
+
+The planner (:mod:`peasoup_tpu.plan.dedisp_plan`) decides exact vs
+subband analytically; which *shape knobs* run fastest — the
+``dedisp_block`` DM-tile height, the subband count around the
+analytic winner — is a device property ("Real-Time Dedispersion ...
+using Auto Tuning", arXiv:1601.01165: empirical per-device tuning
+beats any analytic model). This module times a small candidate grid
+with the shared measurement path (:mod:`peasoup_tpu.perf.measure`,
+median-of-k ``block_until_ready``) over a scaled probe of the
+bucket's real geometry, and persists winners in a schema-validated
+``tuning_cache.json`` keyed by (device fingerprint, pipeline + shape
+bucket). Campaign workers and the pipeline drivers resolve plans
+through :func:`resolve_plan_for_bucket`: a warm bucket loads its plan
+with ZERO measurement calls (pinned by the :func:`measurement_count`
+counter in tests), a corrupt cache re-tunes with a warning instead of
+crashing, and concurrent writers last-win on an atomic replace (both
+derive the same deterministic plan, so the race is benign).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+
+import numpy as np
+
+from ..obs import get_logger
+from ..plan.dedisp_plan import DedispPlan
+
+log = get_logger("perf.tuning")
+
+TUNING_SCHEMA = "peasoup_tpu.tuning_cache"
+TUNING_VERSION = 1
+
+# every timed candidate bumps this counter; tests pin the warm-bucket
+# contract ("second resolve of a tuned bucket performs ZERO
+# measurements") against it
+_TUNER_INVOCATIONS = 0
+
+DEFAULT_REPS = 3
+# probe budget: the tuner times a scaled slice of the bucket (the
+# knobs' relative ranking is what matters, not absolute seconds), so
+# a candidate grid stays seconds even at survey channel counts
+PROBE_SAMPLE_BUDGET = 1 << 22
+PROBE_MAX_TRIALS = 64
+BLOCK_CANDIDATES = (8, 16, 32, 64)
+
+
+def measurement_count() -> int:
+    """Total timed tuner measurements this process has performed."""
+    return _TUNER_INVOCATIONS
+
+
+def device_fingerprint() -> str:
+    """The cache's device identity: backend + device kind + local
+    chip count (a tuned block size is a per-chip property; the count
+    guards against a pod slice masquerading as a single chip)."""
+    import jax
+
+    devs = jax.local_devices()
+    kind = str(devs[0].device_kind) if devs else "none"
+    return f"{jax.default_backend()}:{kind}:n{len(devs)}"
+
+
+def bucket_key(bucket, pipeline: str) -> str:
+    return pipeline + "|" + "|".join(str(x) for x in bucket)
+
+
+def default_cache_path() -> str:
+    env = os.environ.get("PEASOUP_TUNING_CACHE")
+    if env:
+        return env
+    return os.path.join(
+        os.path.expanduser("~"), ".cache", "peasoup_tpu",
+        "tuning_cache.json",
+    )
+
+
+# --------------------------------------------------------------------------
+# the cache document
+# --------------------------------------------------------------------------
+
+def _empty_cache() -> dict:
+    return {
+        "schema": TUNING_SCHEMA,
+        "version": TUNING_VERSION,
+        "devices": {},
+    }
+
+
+def validate_cache(doc: dict) -> None:
+    """Validate a tuning-cache document against the checked-in schema
+    (obs/schema.py's dependency-free validator); raises SchemaError."""
+    from ..obs.schema import validate
+
+    path = os.path.join(
+        os.path.dirname(__file__), "tuning_cache.schema.json"
+    )
+    with open(path) as f:
+        schema = json.load(f)
+    validate(doc, schema)
+
+
+def load_cache(path: str) -> dict:
+    """Load the tuning cache; a missing file yields an empty cache, a
+    corrupt or schema-violating one yields an empty cache WITH A
+    WARNING (the contract: re-tune, never crash a worker on a torn
+    shared file)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        if doc.get("schema") != TUNING_SCHEMA:
+            raise ValueError(f"schema={doc.get('schema')!r}")
+        validate_cache(doc)
+        return doc
+    except FileNotFoundError:
+        return _empty_cache()
+    except Exception as exc:
+        log.warning(
+            "tuning cache %s unreadable (%s: %.200s); re-tuning from "
+            "scratch", path, type(exc).__name__, exc,
+        )
+        return _empty_cache()
+
+
+def save_cache(path: str, doc: dict) -> None:
+    """Schema-validate and atomically replace the cache file."""
+    validate_cache(doc)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def cache_lookup(doc: dict, fingerprint: str, key: str) -> dict | None:
+    return (doc.get("devices", {}).get(fingerprint) or {}).get(key)
+
+
+def cache_store(doc: dict, fingerprint: str, key: str, plan_doc: dict):
+    doc.setdefault("devices", {}).setdefault(fingerprint, {})[key] = plan_doc
+
+
+# --------------------------------------------------------------------------
+# the tuner
+# --------------------------------------------------------------------------
+
+def _probe_geometry(dm_plan, nchans: int):
+    """Scaled probe slice of the bucket: enough samples/trials to rank
+    candidates, small enough that a full candidate grid costs seconds.
+    Uses the LOWEST-DM trials (smallest delays) so the probe input
+    length stays close to the probe output length."""
+    out = int(
+        min(
+            dm_plan.out_nsamps,
+            max(2048, PROBE_SAMPLE_BUDGET // max(1, nchans)),
+        )
+    )
+    # whole 128-blocks keep the blocked-row slicing representative
+    out = max(256, (out // 128) * 128)
+    ndm = int(min(dm_plan.ndm, PROBE_MAX_TRIALS))
+    return out, ndm
+
+
+def _measure(call, reps: int) -> float:
+    """One tuner measurement: median-of-k block_until_ready via the
+    shared measurement path. Counts toward measurement_count()."""
+    global _TUNER_INVOCATIONS
+    from .measure import median, timed_samples
+
+    _TUNER_INVOCATIONS += 1
+    return median(timed_samples(call, reps))
+
+
+def tune_plan(
+    plan: DedispPlan,
+    dm_plan,
+    *,
+    nbits: int,
+    reps: int = DEFAULT_REPS,
+    block_candidates: tuple[int, ...] = BLOCK_CANDIDATES,
+) -> DedispPlan:
+    """Empirically refine ``plan``'s shape knobs on THIS device by
+    timing a candidate grid over a scaled probe of the bucket's real
+    delay table. Measures ``dedisp_block`` for the exact engine and
+    the subband count around the analytic winner for the subband
+    engine. Never raises: a failed measurement keeps the analytic
+    knobs (source stays "analytic") — tuning is an optimisation, not
+    a correctness dependency."""
+    import jax
+
+    from ..ops.dedisperse import (
+        dedisperse_block,
+        dedisperse_subband,
+        output_scale,
+    )
+
+    t0 = time.perf_counter()
+    nchans = len(dm_plan.delays)
+    probe_out, probe_ndm = _probe_geometry(dm_plan, nchans)
+    if probe_ndm < 1:
+        return plan
+    delays = dm_plan.delay_samples()[:probe_ndm]
+    t_in = probe_out + int(delays.max()) + 1
+    rng = np.random.default_rng(0)
+    hi = (1 << min(int(nbits), 8)) - 1
+    fil_probe = rng.integers(
+        0, hi + 1, size=(t_in, nchans), dtype=np.uint8
+    )
+    kill = np.ones(nchans, dtype=np.float32)
+    scale = output_scale(int(nbits), nchans)
+    trials: list[dict] = []
+    try:
+        fil_dev = jax.numpy.asarray(fil_probe)
+        kill_dev = jax.numpy.asarray(kill)
+        if plan.engine == "subband":
+            cands = sorted(
+                {
+                    max(2, min(nchans // 2, s))
+                    for s in (
+                        plan.subbands // 2, plan.subbands, plan.subbands * 2
+                    )
+                }
+            )
+            best = None
+            for nsub in cands:
+                def run(nsub=nsub):
+                    jax.block_until_ready(
+                        dedisperse_subband(
+                            fil_dev, delays, kill, probe_out,
+                            nsub=nsub, max_smear=plan.subband_smear,
+                            scale=scale,
+                        )
+                    )
+                run()  # untimed compile/warm pass
+                med = _measure(run, reps)
+                trials.append(
+                    {"params": {"subbands": int(nsub)},
+                     "median_s": round(med, 6)}
+                )
+                if best is None or med < best[1]:
+                    best = (nsub, med)
+            if best is not None:
+                plan.subbands = int(best[0])
+                plan.source = "tuned"
+        # dedisp_block ranks by per-trial throughput of the direct
+        # block program (the exact engine's unit of work; the subband
+        # path also dispatches it for its registry/bench twin)
+        best_b = None
+        for b in sorted({min(b, probe_ndm) for b in block_candidates}):
+            d_b = jax.numpy.asarray(delays[:b])
+
+            def run(d_b=d_b, b=b):
+                jax.block_until_ready(
+                    dedisperse_block(
+                        fil_dev, d_b, kill_dev,
+                        out_nsamps=probe_out, scale=scale,
+                    )
+                )
+            run()  # untimed compile/warm pass
+            med = _measure(run, reps)
+            per_trial = med / b
+            trials.append(
+                {"params": {"dedisp_block": int(b)},
+                 "median_s": round(med, 6)}
+            )
+            if best_b is None or per_trial < best_b[1]:
+                best_b = (b, per_trial)
+        if best_b is not None:
+            plan.dedisp_block = int(best_b[0])
+            plan.source = "tuned"
+    except Exception as exc:
+        log.warning(
+            "dedispersion tuner failed (%s: %.200s); keeping analytic "
+            "knobs", type(exc).__name__, exc,
+        )
+    plan.trials = trials
+    plan.tuning_s = round(time.perf_counter() - t0, 3)
+    return plan
+
+
+# --------------------------------------------------------------------------
+# plan resolution: bucket -> cached-or-freshly-tuned DedispPlan
+# --------------------------------------------------------------------------
+
+def _dm_plan_for_bucket(bucket, overrides: dict):
+    from ..plan.dm_plan import DMPlan
+
+    nchans, _nbits, nsamps, tsamp, fch1, foff = bucket
+    return DMPlan.create(
+        nsamps=int(nsamps),
+        nchans=int(nchans),
+        tsamp=float(tsamp),
+        fch1=float(fch1),
+        foff=float(foff),
+        dm_start=float(overrides.get("dm_start", 0.0)),
+        dm_end=float(overrides.get("dm_end", 100.0)),
+        pulse_width=float(overrides.get("dm_pulse_width", 64.0)),
+        tol=float(overrides.get("dm_tol", 1.10)),
+    )
+
+
+def resolve_plan_for_bucket(
+    bucket,
+    pipeline: str,
+    overrides: dict,
+    cache_path: str | None = None,
+    *,
+    tune: bool = True,
+    reps: int = DEFAULT_REPS,
+    force: bool = False,
+) -> DedispPlan:
+    """The measure -> decide -> cache -> reuse loop for one shape
+    bucket. Warm (fingerprint, bucket) entries return the cached plan
+    with zero measurement calls; cold ones select analytically
+    (plan/dedisp_plan.py), optionally tune on this device, and persist
+    the winner. Telemetry gets a ``tuning`` event either way so the
+    manifest records plan provenance."""
+    from ..obs.telemetry import current as current_telemetry
+
+    cache_path = cache_path or default_cache_path()
+    fp = device_fingerprint()
+    key = bucket_key(bucket, pipeline)
+    doc = load_cache(cache_path)
+    tel = current_telemetry()
+    if not force:
+        hit = cache_lookup(doc, fp, key)
+        if hit is not None:
+            plan = DedispPlan.from_doc(hit)
+            plan.source = "cache"
+            tel.event(
+                "tuning_cache_hit", bucket=list(bucket),
+                pipeline=pipeline, **plan.summary(),
+            )
+            return plan
+    nchans, nbits = int(bucket[0]), int(bucket[1])
+    dm_plan = _dm_plan_for_bucket(bucket, overrides)
+    if pipeline == "search" and not overrides.get("subbands"):
+        plan = DedispPlan.select(
+            dm_plan,
+            nbits=nbits,
+            tsamp=float(bucket[3]),
+            fch1=float(bucket[4]),
+            foff=float(bucket[5]),
+            max_smear=float(overrides.get("subband_smear", 1.0)),
+            max_snr_loss=float(overrides.get("subband_snr_loss", 0.1)),
+            pulse_width_us=float(overrides.get("dm_pulse_width", 64.0)),
+        )
+    else:
+        # spsearch/stream have no subband path (and an explicit
+        # --subbands is an operator decision the planner respects):
+        # only the block knobs tune
+        plan = DedispPlan(
+            engine="exact",
+            cost_exact=float(dm_plan.ndm)
+            * nchans
+            * max(1, dm_plan.out_nsamps),
+        )
+    if tune:
+        plan = tune_plan(plan, dm_plan, nbits=nbits, reps=reps)
+    cache_store(doc, fp, key, plan.to_doc())
+    try:
+        save_cache(cache_path, doc)
+    except Exception as exc:
+        log.warning(
+            "could not persist tuning cache %s: %.200s", cache_path, exc
+        )
+    tel.event(
+        "tuning", bucket=list(bucket), pipeline=pipeline,
+        cache_path=cache_path, **plan.summary(),
+    )
+    return plan
+
+
+def resolve_plan_for_filterbank(
+    fil, pipeline: str, cfg, cache_path: str | None = None,
+) -> DedispPlan:
+    """Driver-side entry: derive the observation's shape bucket (the
+    campaign bucketing convention, so a CLI run and a campaign worker
+    share cache entries) and resolve its plan."""
+    import dataclasses
+
+    from ..campaign.runner import bucket_for_header
+
+    bucket = bucket_for_header(fil.header)
+    overrides = dataclasses.asdict(cfg)
+    return resolve_plan_for_bucket(
+        bucket, pipeline, overrides, cache_path or None
+    )

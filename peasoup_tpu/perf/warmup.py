@@ -222,15 +222,26 @@ def warm_registry(
 def shape_ctx_for_bucket(bucket, pipeline: str, overrides: dict):
     """Derive the production ShapeCtx a campaign bucket implies, using
     the drivers' own plan machinery (DMPlan, the width bank, the auto
-    dm_block formula) so hook-built programs match what the pipeline
-    will trace."""
+    dm_block formula — and for the periodicity pipeline the accel plan
+    + fft plan, so the spectrum/resample/harmonics/peaks hooks compile
+    at the wave loop's real (dm_block, accel_pad, fft_size) tile)
+    so hook-built programs match what the pipeline will trace. Tuned
+    dedispersion knobs (``subbands``/``subband_smear``/``dedisp_block``
+    from the tuning cache, perf/tuning.py) flow in through
+    ``overrides`` and land in the ctx, so warmup compiles the tuned
+    shapes."""
     from ..ops.registry import ShapeCtx
     from ..ops.singlepulse import plan_pad
     from ..pipeline.single_pulse import SinglePulseConfig, SinglePulseSearch
     from ..plan.dm_plan import DMPlan
 
     nchans, nbits, nsamps, tsamp, fch1, foff = bucket
-    cfg = _filtered_config(SinglePulseConfig, overrides)
+    base_cls = SinglePulseConfig
+    if pipeline == "search":
+        from ..pipeline.search import SearchConfig
+
+        base_cls = SearchConfig
+    cfg = _filtered_config(base_cls, overrides)
     plan = DMPlan.create(
         nsamps=int(nsamps), nchans=int(nchans), tsamp=float(tsamp),
         fch1=float(fch1), foff=float(foff), dm_start=cfg.dm_start,
@@ -239,6 +250,11 @@ def shape_ctx_for_bucket(bucket, pipeline: str, overrides: dict):
     widths: tuple[int, ...] = ()
     dm_block = 1
     pallas_span = 0
+    fft_size = 0
+    nharms = 4
+    accel_pad = 0
+    max_peaks = 128
+    select_smax = 0
     if pipeline == "spsearch":
         search = SinglePulseSearch(cfg)
         widths = search.widths_for(plan.out_nsamps)
@@ -258,6 +274,42 @@ def shape_ctx_for_bucket(bucket, pipeline: str, overrides: dict):
                     pallas_span = span
             except Exception:
                 pallas_span = 0
+    elif pipeline == "search":
+        import numpy as np
+
+        from ..ops.resample import accel_factor, select_span
+        from ..pipeline.search import PeasoupSearch, _accel_pad
+        from ..plan.accel_plan import AccelerationPlan
+        from ..plan.fft_plan import choose_fft_size
+
+        fft_size = choose_fft_size(int(nsamps), cfg.size)
+        nharms = int(cfg.nharmonics)
+        max_peaks = int(cfg.max_peaks)
+        acc_plan = AccelerationPlan(
+            acc_lo=cfg.acc_start, acc_hi=cfg.acc_end, tol=cfg.acc_tol,
+            pulse_width=cfg.acc_pulse_width, nsamps=fft_size,
+            tsamp=float(tsamp),
+            cfreq=float(fch1) + (int(nchans) / 2.0 - 0.5) * float(foff),
+            bw=float(foff),
+        )
+        # the widest accel list sits at DM 0 (alt_a grows with DM);
+        # its padded column count is the wave loop's tile width
+        accs = acc_plan.generate_accel_list(float(cfg.dm_start))
+        accel_pad = _accel_pad(len(accs), cfg.accel_bucket)
+        af_max = (
+            float(np.abs(accel_factor(accs, float(tsamp))).max())
+            if len(accs) else 0.0
+        )
+        select_smax = select_span(af_max, fft_size)
+        # the driver's auto per-chip block formula (pipeline/search.py
+        # build_chunks) without the one-shot escalation
+        searcher = PeasoupSearch(cfg)
+        size_spec_b = (fft_size // 2 + 1) * 4
+        if cfg.dm_block > 0:
+            dm_block = cfg.dm_block
+        else:
+            cells = max(8, int(searcher.MEM_BUDGET / (size_spec_b * 16)))
+            dm_block = max(1, min(128, cells // max(1, accel_pad)))
     return ShapeCtx(
         nsamps=int(nsamps),
         nchans=int(nchans),
@@ -268,9 +320,16 @@ def shape_ctx_for_bucket(bucket, pipeline: str, overrides: dict):
         dedisp_block=int(getattr(cfg, "dedisp_block", 16)),
         widths=tuple(int(w) for w in widths),
         min_snr=float(cfg.min_snr),
-        max_events=int(cfg.max_events),
-        decimate=int(cfg.decimate),
+        max_events=int(getattr(cfg, "max_events", 256)),
+        decimate=int(getattr(cfg, "decimate", 32)),
         pallas_span=int(pallas_span),
+        subbands=int(getattr(cfg, "subbands", 0)),
+        subband_smear=float(getattr(cfg, "subband_smear", 1.0)),
+        fft_size=int(fft_size),
+        nharms=int(nharms),
+        accel_pad=int(accel_pad),
+        max_peaks=int(max_peaks),
+        select_smax=int(select_smax),
     )
 
 

@@ -89,6 +89,14 @@ class SearchConfig:
     # counts; 0 = direct channel scan, the golden-exact default)
     subband_smear: float = 1.0  # max extra smear (samples) a trial may
     # suffer from sharing its group's nominal DM (0 = exact)
+    subband_snr_loss: float = 0.1  # parity gate for the auto planner
+    # (plan/dedisp_plan.py): max fractional matched-filter S/N loss a
+    # subband plan may predict before exact is forced
+    tune: bool = False  # auto-select exact-vs-subband + per-device
+    # tuned shape knobs via the tuning cache (perf/tuning.py); an
+    # explicit --subbands overrides the planner
+    tuning_cache: str = ""  # tuning_cache.json path ("" = the
+    # per-user default, PEASOUP_TUNING_CACHE overrides)
     accel_bucket: int = 16  # accel batch padded to a multiple of this
     dedupe_accel: bool = True  # collapse accel trials whose entire
     # rounded resample-shift maps provably coincide (identity or not)
@@ -532,6 +540,40 @@ class PeasoupSearch:
                 t_total_start=t_total,
             )
             return part if not finalize else self.finalize(fil, part)
+        # --- auto-tuned dedispersion plan ------------------------------
+        # the measure -> decide -> cache -> reuse loop (ISSUE 8): an
+        # explicit --subbands is an operator decision the planner
+        # respects; otherwise resolve exact-vs-subband + tuned shape
+        # knobs from the per-device tuning cache (warm buckets load
+        # with zero measurement calls). Failures degrade to the
+        # config's manual knobs — planning is an optimisation, never a
+        # correctness dependency.
+        subbands = cfg.subbands
+        subband_smear = cfg.subband_smear
+        dedisp_block = cfg.dedisp_block
+        if cfg.tune and cfg.subbands == 0:
+            try:
+                from ..perf.tuning import resolve_plan_for_filterbank
+
+                dplan = resolve_plan_for_filterbank(
+                    fil, "search", cfg, cache_path=cfg.tuning_cache or None
+                )
+            except Exception as exc:
+                log.warning("dedispersion planning failed: %.200s", exc)
+                dplan = None
+            if dplan is not None:
+                if dplan.engine == "subband":
+                    subbands = dplan.subbands
+                    subband_smear = dplan.subband_smear
+                dedisp_block = dplan.dedisp_block or dedisp_block
+                tel.event("dedisp_plan", **dplan.summary())
+                tel.set_context(dedisp_plan=dplan.summary())
+                log.info(
+                    "dedispersion plan: %s (subbands=%d, dedisp_block=%d, "
+                    "gain %.2fx, predicted S/N loss %.3f, %s)",
+                    dplan.engine, dplan.subbands, dplan.dedisp_block,
+                    dplan.gain, dplan.predicted_loss, dplan.source,
+                )
         t0 = time.perf_counter()
         tel.set_stage("dedispersion")
         # --- device selection: shard DM trials over local chips --------
@@ -555,7 +597,7 @@ class PeasoupSearch:
         trials_bytes = dm_plan.ndm * dm_plan.out_nsamps
         shardable = (
             mesh is not None
-            and cfg.subbands == 0
+            and subbands == 0
             and 4 * fil.nsamps * fil.nchans < 3_000_000_000
         )
         n_shard = len(devices) if shardable else 1
@@ -620,9 +662,9 @@ class PeasoupSearch:
                     dm_plan.out_nsamps,
                     mesh,
                     scale=scale,
-                    block=cfg.dedisp_block,
+                    block=dedisp_block,
                 )
-            elif cfg.subbands > 0:
+            elif subbands > 0:
                 # the subband engine stages the filterbank on DEVICE
                 # regardless of trial spill (to_host only routes the
                 # OUTPUTS), so always take the packed-upload + on-device
@@ -632,8 +674,8 @@ class PeasoupSearch:
                     dm_plan.delay_samples(),
                     dm_plan.killmask,
                     dm_plan.out_nsamps,
-                    nsub=cfg.subbands,
-                    max_smear=cfg.subband_smear,
+                    nsub=subbands,
+                    max_smear=subband_smear,
                     scale=scale,
                     to_host=spill,
                 )
@@ -645,12 +687,26 @@ class PeasoupSearch:
                     dm_plan.killmask,
                     dm_plan.out_nsamps,
                     scale=scale,
-                    block=cfg.dedisp_block,
+                    block=dedisp_block,
                 )
-            if not spill:
-                # sync so the phase timer means what it says — await
-                # completion only, no D2H round trip
-                jax.block_until_ready(trials)
+            if not spill and not skip_dedisp:
+                # ASYNC dispatch: the trials stay in flight while the
+                # host builds the wave plan and dispatches the first
+                # search chunks, so dedispersion of the tail overlaps
+                # the search of the head (XLA orders the per-trial
+                # dependencies). The dedispersion timer therefore
+                # records DISPATCH wall only; completion is absorbed
+                # into search_device. PEASOUP_SYNC_DEDISP=1 restores
+                # the old barrier (and the timer's old meaning) —
+                # results are bitwise identical either way, pinned by
+                # tests/test_dedisp_plan.py.
+                if os.environ.get("PEASOUP_SYNC_DEDISP"):
+                    jax.block_until_ready(trials)
+                else:
+                    tel.event(
+                        "dedisp_async_dispatch",
+                        dispatch_s=round(time.perf_counter() - t0, 4),
+                    )
         timers["dedispersion"] = time.perf_counter() - t0
         tel.capture_device_memory("dedispersion")
 

@@ -83,6 +83,10 @@ class SinglePulseConfig:
     checkpoint_file: str = ""
     use_pallas: bool = True  # Pallas boxcar kernel on TPU backends
     shard_devices: int = 0  # 0 = auto; N forces an N-chip 'dm' mesh
+    tune: bool = False  # per-device tuned dedispersion shape knobs via
+    # the tuning cache (perf/tuning.py; the single-pulse driver has no
+    # subband path, so only the block knobs tune)
+    tuning_cache: str = ""  # tuning_cache.json path ("" = default)
 
 
 @dataclass
@@ -375,6 +379,24 @@ class SinglePulseSearch:
             )
             return part if not finalize else self.finalize(fil, part)
 
+        # --- auto-tuned dedispersion shape knobs -----------------------
+        dedisp_block = cfg.dedisp_block
+        if cfg.tune:
+            try:
+                from ..perf.tuning import resolve_plan_for_filterbank
+
+                dplan = resolve_plan_for_filterbank(
+                    fil, "spsearch", cfg,
+                    cache_path=cfg.tuning_cache or None,
+                )
+            except Exception as exc:
+                log.warning("dedispersion tuning failed: %.200s", exc)
+                dplan = None
+            if dplan is not None:
+                dedisp_block = dplan.dedisp_block or dedisp_block
+                tel.event("dedisp_plan", **dplan.summary())
+                tel.set_context(dedisp_plan=dplan.summary())
+
         # --- dedispersion (reusing the periodicity engines) ------------
         t0 = time.perf_counter()
         tel.set_stage("dedispersion")
@@ -422,7 +444,7 @@ class SinglePulseSearch:
                             dm_plan.out_nsamps,
                             mesh,
                             scale=scale,
-                            block=cfg.dedisp_block,
+                            block=dedisp_block,
                         )
                         jax.block_until_ready(trials)
                     except Exception as exc:
@@ -447,10 +469,23 @@ class SinglePulseSearch:
                         dm_plan.killmask,
                         dm_plan.out_nsamps,
                         scale=scale,
-                        block=cfg.dedisp_block,
+                        block=dedisp_block,
                     )
                 if not spill:
-                    jax.block_until_ready(trials)
+                    # async dispatch (mirrors pipeline/search.py): the
+                    # first boxcar waves overlap the dedispersion tail;
+                    # PEASOUP_SYNC_DEDISP=1 restores the barrier. The
+                    # sharded path above keeps its own sync — it gates
+                    # the shard_map-availability fallback.
+                    import os as _os
+
+                    if _os.environ.get("PEASOUP_SYNC_DEDISP"):
+                        jax.block_until_ready(trials)
+                    else:
+                        tel.event(
+                            "dedisp_async_dispatch",
+                            dispatch_s=round(time.perf_counter() - t0, 4),
+                        )
         timers["dedispersion"] = time.perf_counter() - t0
         tel.capture_device_memory("dedispersion")
 

@@ -1,0 +1,301 @@
+"""Dedispersion strategy planning: exact vs two-stage subband.
+
+The pipeline ships two dedispersion engines (ops/dedisperse.py): the
+direct channel scan (golden-exact) and the two-stage subband engine
+from "Accelerating incoherent dedispersion" (arXiv:1201.5380). Which
+one wins — and at which shape knobs — depends on the observation
+geometry and the device; the reference picks statically. This module
+is the DECISION layer: a device-free analytic cost model over the
+bucket's real delay table plus a parity-tolerance gate whose inputs
+(max extra smear in samples, max fractional S/N loss) are explicit
+plan parameters, not folklore. "Real-Time Dedispersion ... using Auto
+Tuning" (arXiv:1601.01165) shows the remaining shape knobs are best
+set empirically per device — that measurement layer and its
+per-device cache live in :mod:`peasoup_tpu.perf.tuning`; this module
+stays pure numpy so planning is testable and auditable on any backend.
+
+Cost model (arithmetic, in channel-sum MACs over the trial set):
+
+* exact:    ``ndm * nchans * out_nsamps``
+* subband:  ``n_groups * nchans * out_nsamps``  (stage 1, once per
+  nominal DM) ``+ ndm * nsub * out_nsamps``     (stage 2, per trial)
+
+with ``n_groups`` computed from the bucket's actual delay table by the
+same greedy smear-bounded grouping the engine executes
+(:func:`subband_group_spans` is a vectorised twin of
+``ops.dedisperse.subband_groups`` — identical spans, plus each group's
+realised worst-case smear for the S/N gate). The classic ~sqrt(C) win
+appears exactly when groups hold several trials.
+
+Parity gate: substituting a group nominal's intra-band delay shape
+displaces each channel's read by at most the group's realised smear
+``s`` samples (the grouping bound). A boxcar matched filter recovering
+a pulse of effective width ``w`` samples smeared over ``w + s`` loses
+S/N by the factor ``sqrt(w / (w + s))``; the plan predicts the loss
+per group at that group's lowest-DM trial (narrowest effective width
+— the worst case, since width grows with DM through the intra-channel
+smear term) and selects subband only when the worst predicted loss
+stays within ``max_snr_loss``. ``max_smear = 0`` keeps the engines
+bitwise equal and the gate trivially passes.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import asdict, dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from .dm_plan import DMPlan
+
+PLAN_VERSION = 1
+
+# structural floor for the two-stage split: below ~64 channels the
+# stage-2 pass over nsub pseudo-channels plus the extra dispatches eat
+# the arithmetic win (the ~sqrt(C) argument needs C >> nsub >> 1), so
+# the planner never proposes subbands there — "exact must win at small
+# nchans" is a plan invariant, not a tuning outcome
+MIN_SUBBAND_NCHANS = 64
+MIN_SUBBANDS = 8
+
+
+def effective_subbands(nchans: int, nsub: int) -> int:
+    """The engine's effective band count for a requested ``nsub``
+    (ops.dedisperse.dedisperse_subband normalises the same way)."""
+    w = -(-nchans // max(1, min(nsub, nchans)))
+    return -(-nchans // w)
+
+
+def intra_band_shapes(delay_table: np.ndarray, nsub: int) -> np.ndarray:
+    """Per-trial intra-band delay shapes d1[d, c] = delay[d, c] -
+    min(delay[d, band(c)]) under the engine's band grouping and
+    min-reference convention (ops.dedisperse.dedisperse_subband)."""
+    delay_table = np.asarray(delay_table)
+    _, C = delay_table.shape
+    nsub = effective_subbands(C, nsub)
+    w = -(-C // nsub)
+    band_of = np.minimum(np.arange(C) // w, nsub - 1)
+    refdel = np.stack(
+        [delay_table[:, b : b + w].min(axis=1) for b in range(0, C, w)],
+        axis=1,
+    )
+    return delay_table - refdel[:, band_of]
+
+
+def subband_group_spans(
+    delay_table: np.ndarray, nsub: int, max_smear: float
+) -> list[tuple[int, int, int]]:
+    """Greedy smear-bounded DM-trial grouping: the vectorised twin of
+    ``ops.dedisperse.subband_groups`` (identical [lo, hi) spans — a
+    test pins the equivalence) returning ``(lo, hi, err)`` with each
+    group's realised worst-case intra-band smear in samples."""
+    d1 = intra_band_shapes(delay_table, nsub)
+    D = d1.shape[0]
+    spans: list[tuple[int, int, int]] = []
+    lo = 0
+    step = 128
+    while lo < D:
+        hi = lo + 1
+        err = 0
+        while hi < D:
+            j = min(D, hi + step)
+            errs = np.abs(d1[hi:j] - d1[lo]).max(axis=1)
+            bad = np.nonzero(errs > max_smear)[0]
+            if bad.size:
+                if bad[0] > 0:
+                    err = max(err, int(errs[: bad[0]].max()))
+                hi += int(bad[0])
+                break
+            if errs.size:
+                err = max(err, int(errs.max()))
+            hi = j
+        spans.append((lo, hi, err))
+        lo = hi
+    return spans
+
+
+def effective_delay_table(
+    delay_table: np.ndarray, nsub: int, max_smear: float
+) -> np.ndarray:
+    """The integer delay table the subband engine EFFECTIVELY applies:
+    each trial reads channel c at ``refdel[d, band(c)] + d1[lo, c]``
+    with ``lo`` its group's nominal. Direct dedispersion with this
+    table is bitwise what the two-stage engine computes (channel sums
+    of <= 8-bit samples are exact in f32, so the differing summation
+    order cannot change the result) — the parity property tests pin
+    that equality, and ``|effective - true| <= max_smear`` everywhere
+    is the smear bound made concrete."""
+    delay_table = np.asarray(delay_table)
+    _, C = delay_table.shape
+    nsub_eff = effective_subbands(C, nsub)
+    w = -(-C // nsub_eff)
+    band_of = np.minimum(np.arange(C) // w, nsub_eff - 1)
+    refdel = np.stack(
+        [delay_table[:, b : b + w].min(axis=1) for b in range(0, C, w)],
+        axis=1,
+    )
+    d1 = delay_table - refdel[:, band_of]
+    eff = np.empty_like(delay_table)
+    for lo, hi, _ in subband_group_spans(delay_table, nsub_eff, max_smear):
+        eff[lo:hi] = refdel[lo:hi][:, band_of] + d1[lo][None, :]
+    return eff
+
+
+def effective_width_samples(
+    dm: float, tsamp: float, pulse_width_us: float,
+    fch1: float, foff: float, nchans: int,
+) -> float:
+    """Effective pulse width in SAMPLES at one DM trial: the same
+    smearing terms the DM-trial recurrence uses (plan/dm_plan.py) —
+    sampling time, intrinsic width, and the per-channel dispersion
+    smear 8.3 * |df_MHz| / f_GHz^3 * DM microseconds."""
+    dt_us = float(tsamp) * 1e6
+    f_centre_ghz = (float(fch1) + (nchans // 2 - 0.5) * float(foff)) * 1e-3
+    a = 8.3 * abs(float(foff)) / max(1e-9, abs(f_centre_ghz)) ** 3
+    w_us = math.sqrt(
+        dt_us * dt_us
+        + float(pulse_width_us) ** 2
+        + (a * float(dm)) ** 2
+    )
+    return max(1.0, w_us / dt_us)
+
+
+def predicted_snr_loss(width_samps: float, smear_samps: float) -> float:
+    """Fractional matched-filter S/N loss from smearing a pulse of
+    effective width ``w`` samples over ``s`` extra samples:
+    1 - sqrt(w / (w + s))."""
+    w = max(1e-9, float(width_samps))
+    return 1.0 - math.sqrt(w / (w + max(0.0, float(smear_samps))))
+
+
+def candidate_subbands(nchans: int) -> list[int]:
+    """The nsub candidate grid: powers of two around sqrt(nchans),
+    clipped to the structural window [MIN_SUBBANDS, nchans // 4].
+    Empty below MIN_SUBBAND_NCHANS — exact wins there by plan
+    invariant."""
+    if nchans < MIN_SUBBAND_NCHANS:
+        return []
+    s0 = 1 << round(math.log2(math.sqrt(nchans)))
+    cands = sorted(
+        {
+            min(max(s, MIN_SUBBANDS), nchans // 4)
+            for s in (s0 // 2, s0, s0 * 2)
+        }
+    )
+    return [s for s in cands if MIN_SUBBANDS <= s <= nchans // 4]
+
+
+@dataclass
+class DedispPlan:
+    """One bucket's dedispersion strategy: the engine choice plus the
+    shape knobs the drivers consume. ``source`` records provenance:
+    ``analytic`` (cost model only), ``tuned`` (per-device measurements
+    refined the knobs, perf/tuning.py), ``cache`` (loaded from the
+    tuning cache with zero re-measurement)."""
+
+    engine: str = "exact"  # "exact" | "subband"
+    subbands: int = 0
+    subband_smear: float = 0.0
+    dedisp_block: int = 16
+    dm_block: int = 0  # 0 = driver auto-sizing
+    cost_exact: float = 0.0
+    cost_subband: float = 0.0
+    gain: float = 1.0  # cost_exact / cost_subband at the chosen nsub
+    predicted_loss: float = 0.0  # worst-group fractional S/N loss
+    max_group_smear: int = 0  # realised worst smear (samples)
+    n_groups: int = 0
+    source: str = "analytic"
+    tuning_s: float = 0.0
+    trials: list = field(default_factory=list)  # tuner measurements
+    version: int = PLAN_VERSION
+
+    def to_doc(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "DedispPlan":
+        names = set(cls.__dataclass_fields__)
+        return cls(**{k: v for k, v in doc.items() if k in names})
+
+    def summary(self) -> dict:
+        """The compact provenance record for telemetry manifests and
+        the BENCH json (full tuner trials stay in the cache file)."""
+        return {
+            "engine": self.engine,
+            "subbands": self.subbands,
+            "subband_smear": self.subband_smear,
+            "dedisp_block": self.dedisp_block,
+            "dm_block": self.dm_block,
+            "gain": round(self.gain, 3),
+            "predicted_loss": round(self.predicted_loss, 4),
+            "n_groups": self.n_groups,
+            "source": self.source,
+            "tuning_s": round(self.tuning_s, 3),
+        }
+
+    @classmethod
+    def select(
+        cls,
+        dm_plan: DMPlan,
+        *,
+        nbits: int,
+        tsamp: float,
+        fch1: float,
+        foff: float,
+        max_smear: float = 1.0,
+        max_snr_loss: float = 0.1,
+        min_gain: float = 1.2,
+        pulse_width_us: float = 64.0,
+        candidates: Optional[list[int]] = None,
+    ) -> "DedispPlan":
+        """Pick exact vs subband for one plan. Subband is selected
+        exactly when (a) the cost model predicts at least a
+        ``min_gain`` arithmetic win at the best candidate nsub over
+        the bucket's real delay table, AND (b) the parity gate passes:
+        the worst per-group predicted S/N loss under the ``max_smear``
+        budget stays within ``max_snr_loss``. Everything else — small
+        bands, loose geometries, tight loss budgets — keeps the
+        golden-exact direct scan."""
+        D = dm_plan.ndm
+        C = len(dm_plan.delays)
+        T = max(1, dm_plan.out_nsamps)
+        cost_exact = float(D) * C * T
+        plan = cls(engine="exact", cost_exact=cost_exact)
+        if D < 2:
+            return plan
+        cands = candidates if candidates is not None else candidate_subbands(C)
+        cands = [s for s in cands if 2 <= s <= C]
+        if not cands:
+            return plan
+        delay_table = dm_plan.delay_samples()
+        best: Optional[tuple[float, int, list[tuple[int, int, int]]]] = None
+        for nsub in cands:
+            nsub_eff = effective_subbands(C, nsub)
+            spans = subband_group_spans(delay_table, nsub_eff, max_smear)
+            cost = float(len(spans)) * C * T + float(D) * nsub_eff * T
+            if best is None or cost < best[0]:
+                best = (cost, nsub_eff, spans)
+        assert best is not None
+        cost_sub, nsub_best, spans = best
+        plan.cost_subband = cost_sub
+        plan.gain = cost_exact / max(1.0, cost_sub)
+        plan.n_groups = len(spans)
+        plan.max_group_smear = max((err for _, _, err in spans), default=0)
+        # parity gate: worst loss over groups, each at its lowest-DM
+        # (narrowest-width) member
+        loss = 0.0
+        for lo, _, err in spans:
+            if err <= 0:
+                continue
+            w = effective_width_samples(
+                float(dm_plan.dm_list[lo]), tsamp, pulse_width_us,
+                fch1, foff, C,
+            )
+            loss = max(loss, predicted_snr_loss(w, err))
+        plan.predicted_loss = loss
+        if plan.gain >= min_gain and loss <= max_snr_loss:
+            plan.engine = "subband"
+            plan.subbands = nsub_best
+            plan.subband_smear = float(max_smear)
+        return plan

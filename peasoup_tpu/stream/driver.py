@@ -40,10 +40,12 @@ abort like any other run state.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from dataclasses import dataclass, field
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -479,7 +481,16 @@ class StreamingSearch:
                 jnp.asarray(window_in), delays_dev, kill_dev,
                 out_nsamps=chunk, quantize=True, scale=scale,
             )
-            new.block_until_ready()
+            # NO barrier between the dedisperse and sweep dispatches:
+            # both enqueue back to back and XLA overlaps this chunk's
+            # dedispersion with whatever is still in flight (the
+            # previous chunk's sweep) — the dedisperse->sweep hop used
+            # to serialise here per chunk. The dedispersion timer now
+            # records dispatch wall only; device completion lands in
+            # "searching" at the np.asarray sync below.
+            # PEASOUP_SYNC_DEDISP=1 restores the old barrier.
+            if os.environ.get("PEASOUP_SYNC_DEDISP"):
+                jax.block_until_ready(new)
             t1 = time.perf_counter()
             timers["dedispersion"] += t1 - t0
             emit_lo = valid_lo // dec

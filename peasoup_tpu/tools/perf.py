@@ -13,6 +13,10 @@ Subcommands:
   set intact, registry completeness, warm pass 100% cache hits with
   zero recompiles), timing ratchets on real backends. ``--write-
   baseline`` re-pins the baseline from the perf.json.
+* ``tune`` — resolve (and, on a cold cache, measure) the auto-tuned
+  dedispersion plan for one shape bucket into ``tuning_cache.json``
+  (plan/dedisp_plan.py + perf/tuning.py) — the offline form of what
+  campaign workers and ``--tune`` pipelines do automatically.
 
 Exit codes (scripts/check.sh relies on these, mirroring peasoup-audit):
 
@@ -92,6 +96,38 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument(
         "--write-baseline", action="store_true",
         help="re-pin --baseline from the perf.json and exit 0",
+    )
+
+    t = sub.add_parser(
+        "tune",
+        help="auto-tune the dedispersion plan for one shape bucket "
+        "into the tuning cache",
+    )
+    t.add_argument(
+        "--bucket", required=True,
+        help="shape bucket as nchans,nbits,nsamps,tsamp,fch1,foff "
+        "(the campaign bucket key fields)",
+    )
+    t.add_argument(
+        "--pipeline", default="search", choices=("search", "spsearch"),
+    )
+    t.add_argument(
+        "--config", default="{}",
+        help="pipeline config overrides as inline JSON "
+        "(dm_end, subband_smear, subband_snr_loss, ...)",
+    )
+    t.add_argument(
+        "--cache", default=None,
+        help="tuning_cache.json path (default: the per-user cache)",
+    )
+    t.add_argument(
+        "--reps", type=int, default=3,
+        help="timed samples per tuner candidate (median; default 3)",
+    )
+    t.add_argument(
+        "--force", action="store_true",
+        help="re-measure even when the cache already holds a plan "
+        "for this (device, bucket)",
     )
     return p
 
@@ -256,6 +292,44 @@ def _cmd_check(args) -> int:
     return 0
 
 
+def _cmd_tune(args) -> int:
+    import json
+
+    from peasoup_tpu.perf.tuning import (
+        device_fingerprint,
+        measurement_count,
+        resolve_plan_for_bucket,
+    )
+
+    parts = [s.strip() for s in args.bucket.split(",")]
+    if len(parts) != 6:
+        print(
+            "peasoup-perf tune: --bucket wants "
+            "nchans,nbits,nsamps,tsamp,fch1,foff", file=sys.stderr,
+        )
+        return 2
+    bucket = (
+        int(parts[0]), int(parts[1]), int(parts[2]),
+        float(parts[3]), float(parts[4]), float(parts[5]),
+    )
+    overrides = json.loads(args.config)
+    n0 = measurement_count()
+    plan = resolve_plan_for_bucket(
+        bucket, args.pipeline, overrides, args.cache,
+        reps=args.reps, force=args.force,
+    )
+    measured = measurement_count() - n0
+    for k, v in plan.summary().items():
+        print(f"  {k}: {v}")
+    print(
+        f"peasoup-perf tune: {plan.engine} plan for {args.pipeline} "
+        f"bucket {args.bucket} on {device_fingerprint()} "
+        f"({measured} measurements"
+        + (", served from cache)" if plan.source == "cache" else ")")
+    )
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     try:
@@ -263,6 +337,7 @@ def main(argv=None) -> int:
             "warmup": _cmd_warmup,
             "bench": _cmd_bench,
             "check": _cmd_check,
+            "tune": _cmd_tune,
         }[args.cmd](args)
     except Exception:
         traceback.print_exc()

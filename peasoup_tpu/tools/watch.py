@@ -175,6 +175,28 @@ def render_campaign_status(st: dict, stale_after: float = 0.0) -> str:
         )
     if st.get("candidates_total"):
         lines.append(f"  candidates so far: {st['candidates_total']}")
+    if st.get("warmup_total_s") or st.get("tuning_total_s"):
+        lines.append(
+            f"  warmup {st.get('warmup_total_s', 0.0):.1f}s over "
+            f"{st.get('warmup_jobs', 0)} jobs"
+            + (
+                f"  tuning {st['tuning_total_s']:.1f}s"
+                if st.get("tuning_total_s") else ""
+            )
+        )
+    for key, rec in sorted((st.get("warm_buckets") or {}).items()):
+        plan = rec.get("plan") or {}
+        if plan:
+            lines.append(
+                f"  bucket {key}: {rec.get('done', 0)} done, plan "
+                f"{plan.get('engine', '?')}"
+                + (
+                    f"(nsub={plan.get('subbands')})"
+                    if plan.get("engine") == "subband" else ""
+                )
+                + f" block={plan.get('dedisp_block', '?')} "
+                f"[{plan.get('source', '?')}]"
+            )
     for rj in st.get("running_jobs") or []:
         prog = rj.get("progress") or {}
         frac = prog.get("frac")

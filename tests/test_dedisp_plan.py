@@ -1,0 +1,515 @@
+"""Dedispersion planner + tuner tests: exact-vs-subband selection
+(cost model + parity gate), the grouping twin's equivalence with the
+engine's own grouping, subband-vs-exact parity as a property across
+smear budgets and nbits, the per-device tuning cache (determinism,
+zero re-measurement on warm buckets, corrupt-cache tolerance, schema
+round trip), warmup-aware job claiming, the periodicity ShapeCtx
+hooks, and the async dedisperse->search overlap."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from peasoup_tpu.obs.schema import SchemaError
+from peasoup_tpu.ops.dedisperse import (
+    dedisperse_block,
+    dedisperse_subband,
+    output_scale,
+    subband_groups,
+)
+from peasoup_tpu.perf import tuning
+from peasoup_tpu.plan.dedisp_plan import (
+    DedispPlan,
+    candidate_subbands,
+    effective_delay_table,
+    effective_subbands,
+    predicted_snr_loss,
+    subband_group_spans,
+)
+from peasoup_tpu.plan.dm_plan import DMPlan
+
+# a finely sampled wide-band survey geometry: one sample of smear is a
+# small fraction of the intrinsic width (gate passes) and the dense
+# trial grid groups several trials per nominal (cost model wins)
+SURVEY = dict(
+    nsamps=1 << 18, nchans=1024, tsamp=1e-5, fch1=1500.0, foff=-0.29,
+    dm_start=0.0, dm_end=300.0,
+)
+SMALL = dict(
+    nsamps=1 << 12, nchans=8, tsamp=0.000256, fch1=1400.0, foff=-16.0,
+    dm_start=0.0, dm_end=20.0,
+)
+
+
+def _plan(geo) -> DMPlan:
+    return DMPlan.create(**geo)
+
+
+def _select(geo, **kw) -> DedispPlan:
+    return DedispPlan.select(
+        _plan(geo), nbits=kw.pop("nbits", 2), tsamp=geo["tsamp"],
+        fch1=geo["fch1"], foff=geo["foff"], **kw,
+    )
+
+
+# --------------------------------------------------------------------------
+# selection: cost model + parity gate
+# --------------------------------------------------------------------------
+
+class TestSelect:
+    def test_survey_channels_pick_subband(self):
+        """At survey channel counts with a fine time resolution the
+        cost model predicts a win AND the parity gate passes ->
+        subband, with the knobs the engine consumes."""
+        p = _select(SURVEY)
+        assert p.engine == "subband"
+        assert p.subbands >= 8
+        assert p.gain >= 1.2
+        assert p.predicted_loss <= 0.1
+        assert p.subband_smear == 1.0
+        assert p.n_groups < _plan(SURVEY).ndm  # grouping really grouped
+
+    def test_small_band_must_pick_exact(self):
+        """Below the structural channel floor the planner never
+        proposes subbands — exact wins at small nchans by invariant."""
+        p = _select(SMALL, nbits=8)
+        assert p.engine == "exact"
+        assert p.subbands == 0
+        assert candidate_subbands(SMALL["nchans"]) == []
+
+    def test_parity_gate_blocks_despite_cost_win(self):
+        """A zero S/N-loss budget forces exact even where the cost
+        model predicts a win — the gate is a plan input, not
+        folklore."""
+        p = _select(SURVEY, max_snr_loss=0.0)
+        assert p.gain >= 1.2  # the cost win is real...
+        assert p.predicted_loss > 0.0
+        assert p.engine == "exact"  # ...but the gate vetoes it
+
+    def test_zero_smear_budget_blocks_the_win(self):
+        """max_smear=0 gives singleton groups: bitwise-exact subband,
+        but no arithmetic win -> exact."""
+        p = _select(SURVEY, max_smear=0.0)
+        assert p.engine == "exact"
+        assert p.predicted_loss == 0.0
+
+    def test_loss_model_monotone(self):
+        assert predicted_snr_loss(8.0, 0.0) == 0.0
+        assert (
+            predicted_snr_loss(8.0, 1.0)
+            < predicted_snr_loss(8.0, 4.0)
+            < predicted_snr_loss(1.0, 4.0)
+        )
+
+    def test_plan_doc_round_trip(self):
+        p = _select(SURVEY)
+        doc = p.to_doc()
+        assert DedispPlan.from_doc(doc) == p
+        # summary is the compact manifest/BENCH record
+        s = p.summary()
+        assert s["engine"] == "subband" and s["source"] == "analytic"
+
+
+class TestGrouping:
+    def test_spans_match_engine_grouping(self):
+        """The planner's vectorised grouping is span-for-span the
+        engine's subband_groups — the cost model counts exactly the
+        stage-1 passes the engine will run."""
+        dt = _plan(SURVEY).delay_samples()[:300]
+        for nsub in (8, 16, 32):
+            for smear in (0.0, 1.0, 3.0):
+                spans = subband_group_spans(dt, nsub, smear)
+                assert [
+                    (lo, hi) for lo, hi, _ in spans
+                ] == subband_groups(dt, effective_subbands(1024, nsub), smear)
+                # realised errs respect the budget
+                assert all(err <= smear for _, _, err in spans)
+
+
+# --------------------------------------------------------------------------
+# subband-vs-exact parity as a property (smear budgets x nbits)
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("nbits", [1, 2, 4, 8])
+@pytest.mark.parametrize("max_smear", [0.0, 1.0, 4.0])
+def test_subband_parity_property(nbits, max_smear):
+    """The subband engine's output is EXACTLY the direct sum under the
+    effective (smear-perturbed) delay table: bitwise equal for integer
+    inputs (channel sums are exact in f32), with the perturbation
+    bounded by the smear budget everywhere — and bitwise equal to the
+    true exact sum when the budget is zero."""
+    geo = dict(
+        nsamps=4096, nchans=16, tsamp=0.000256, fch1=1400.0, foff=-16.0,
+        dm_start=0.0, dm_end=30.0,
+    )
+    plan = _plan(geo)
+    delays = plan.delay_samples()
+    rng = np.random.default_rng(nbits)
+    hi = (1 << nbits) - 1
+    data = rng.integers(
+        0, hi + 1, size=(geo["nsamps"], geo["nchans"]), dtype=np.uint8
+    )
+    kill = np.ones(geo["nchans"], dtype=np.float32)
+    scale = output_scale(nbits, geo["nchans"])
+    nsub = 4
+
+    sub = np.asarray(
+        dedisperse_subband(
+            data, delays, kill, plan.out_nsamps, nsub=nsub,
+            max_smear=max_smear, scale=scale,
+        )
+    )
+    eff = effective_delay_table(delays, nsub, max_smear)
+    assert np.abs(eff - delays).max() <= max_smear
+    eff_direct = np.asarray(
+        dedisperse_block(
+            data, eff, kill, out_nsamps=plan.out_nsamps, scale=scale
+        )
+    )
+    assert np.array_equal(sub, eff_direct)
+    if max_smear == 0.0:
+        exact = np.asarray(
+            dedisperse_block(
+                data, delays, kill, out_nsamps=plan.out_nsamps,
+                scale=scale,
+            )
+        )
+        assert np.array_equal(sub, exact)
+
+
+# --------------------------------------------------------------------------
+# tuning cache: determinism, warm = zero measurements, corruption
+# --------------------------------------------------------------------------
+
+BUCKET = (8, 8, 4096, 0.000256, 1400.0, -16.0)
+OVR = {"dm_end": 20.0}
+
+
+class TestTuningCache:
+    def test_cold_tunes_warm_loads_with_zero_measurements(self, tmp_path):
+        path = str(tmp_path / "tuning_cache.json")
+        p1 = tuning.resolve_plan_for_bucket(BUCKET, "spsearch", OVR, path)
+        assert p1.source == "tuned"
+        assert p1.tuning_s > 0
+        assert p1.trials  # the candidate grid was measured
+        n = tuning.measurement_count()
+        assert n > 0
+        p2 = tuning.resolve_plan_for_bucket(BUCKET, "spsearch", OVR, path)
+        # the acceptance contract: warm bucket -> ZERO measurement
+        # calls, identical plan
+        assert tuning.measurement_count() == n
+        assert p2.source == "cache"
+        assert p2.dedisp_block == p1.dedisp_block
+        assert p2.engine == p1.engine
+        assert p2.subbands == p1.subbands
+
+    def test_corrupt_cache_retunes_with_warning(self, tmp_path, caplog):
+        path = str(tmp_path / "tuning_cache.json")
+        tuning.resolve_plan_for_bucket(BUCKET, "spsearch", OVR, path)
+        with open(path, "w") as f:
+            f.write("{definitely not json")
+        with caplog.at_level("WARNING", logger="peasoup_tpu"):
+            p = tuning.resolve_plan_for_bucket(
+                BUCKET, "spsearch", OVR, path
+            )
+        assert p.source in ("tuned", "analytic")  # re-tuned, no crash
+        assert any("re-tuning" in r.message for r in caplog.records)
+        # and the rewritten cache is valid again
+        tuning.validate_cache(tuning.load_cache(path))
+
+    def test_schema_validates_and_rejects(self, tmp_path):
+        path = str(tmp_path / "tuning_cache.json")
+        tuning.resolve_plan_for_bucket(BUCKET, "spsearch", OVR, path)
+        doc = tuning.load_cache(path)
+        tuning.validate_cache(doc)
+        dev = next(iter(doc["devices"]))
+        key = next(iter(doc["devices"][dev]))
+        bad = json.loads(json.dumps(doc))
+        bad["devices"][dev][key]["engine"] = "warp-drive"
+        with pytest.raises(SchemaError):
+            tuning.validate_cache(bad)
+        bad2 = json.loads(json.dumps(doc))
+        bad2["devices"][dev][key]["bogus_knob"] = 1
+        with pytest.raises(SchemaError):
+            tuning.validate_cache(bad2)
+
+    def test_search_bucket_records_selection_fields(self, tmp_path):
+        """A periodicity bucket goes through DedispPlan.select: the
+        cached doc carries the cost/gate provenance."""
+        path = str(tmp_path / "tc.json")
+        p = tuning.resolve_plan_for_bucket(BUCKET, "search", OVR, path)
+        assert p.cost_exact > 0
+        assert p.engine == "exact"  # 8 channels: structural floor
+        doc = tuning.load_cache(path)
+        dev = tuning.device_fingerprint()
+        key = tuning.bucket_key(BUCKET, "search")
+        assert doc["devices"][dev][key]["engine"] == "exact"
+
+    def test_perf_tune_cli(self, tmp_path, capsys):
+        from peasoup_tpu.tools.perf import main as perf_main
+
+        cache = str(tmp_path / "tc.json")
+        rc = perf_main(
+            ["tune", "--bucket", "8,8,4096,0.000256,1400.0,-16.0",
+             "--pipeline", "spsearch", "--config", '{"dm_end": 20}',
+             "--cache", cache, "--reps", "1"]
+        )
+        assert rc == 0
+        assert os.path.exists(cache)
+        out = capsys.readouterr().out
+        assert "engine" in out
+        rc = perf_main(
+            ["tune", "--bucket", "8,8,4096,0.000256,1400.0,-16.0",
+             "--pipeline", "spsearch", "--config", '{"dm_end": 20}',
+             "--cache", cache]
+        )
+        assert rc == 0
+        assert "served from cache" in capsys.readouterr().out
+
+
+# --------------------------------------------------------------------------
+# warmup-aware claiming
+# --------------------------------------------------------------------------
+
+def test_warm_bucket_claiming_beats_fifo(tmp_path):
+    """A worker holding warm buckets claims every warm-bucket job
+    before opening a cold bucket: the processed order is one long
+    streak per bucket instead of FIFO's alternation."""
+    from peasoup_tpu.campaign.queue import Job, JobQueue
+
+    q = JobQueue(str(tmp_path))
+    ba, bb = ("A", 8, 4096), ("B", 8, 8192)
+    for i, b in enumerate([ba, bb, ba, bb, ba, bb]):
+        q.add_job(Job(job_id=f"j{i}", input=f"/x/{i}.fil", bucket=b))
+
+    def drain(**kw):
+        order = []
+        while True:
+            claim = q.claim_next("w", **kw)
+            if claim is None:
+                break
+            order.append(claim.job.bucket)
+            q.complete(claim)
+        return order
+
+    order = drain(warm_buckets={bb})
+    assert order == [bb, bb, bb, ba, ba, ba]
+
+    # control: FIFO (by job id) would alternate — max streak 1; the
+    # bucket-grouped default already beats it, warm ranking puts the
+    # warmed bucket FIRST
+    def max_streak(seq):
+        best = cur = 1
+        for x, y in zip(seq, seq[1:]):
+            cur = cur + 1 if x == y else 1
+            best = max(best, cur)
+        return best
+
+    fifo = [ba, bb, ba, bb, ba, bb]
+    assert max_streak(order) == 3 > max_streak(fifo) == 1
+
+
+# --------------------------------------------------------------------------
+# periodicity ShapeCtx hooks
+# --------------------------------------------------------------------------
+
+def test_periodicity_shape_ctx_hooks():
+    """The search-pipeline ctx derives the wave loop's production tile
+    from the accel plan; the spectrum/resample/harmonics/peaks hooks
+    build at it, and decline non-periodicity ctxs."""
+    from peasoup_tpu.ops.registry import registered_programs
+    from peasoup_tpu.perf.warmup import shape_ctx_for_bucket
+
+    by = {s.name: s for s in registered_programs()}
+    ctx = shape_ctx_for_bucket(
+        BUCKET, "search", {"dm_end": 20.0, "acc_start": -5.0,
+                           "acc_end": 5.0},
+    )
+    assert ctx.fft_size == 2048  # prev_power_of_two(4096)
+    assert ctx.accel_pad >= 4
+    nbins = ctx.fft_size // 2 + 1
+    fn, args, kwargs = by["ops.spectrum.form_power"].build_for(ctx)
+    assert args[0].shape == (ctx.dm_block, ctx.accel_pad, nbins)
+    fn, args, kwargs = by["ops.harmonics.harmonic_sums"].build_for(ctx)
+    assert kwargs["nharms"] == ctx.nharms
+    fn, args, kwargs = by["ops.peaks.find_peaks_device"].build_for(ctx)
+    assert kwargs["max_peaks"] == ctx.max_peaks
+    fn, args, kwargs = by["ops.peaks.pack_chunk_results"].build_for(ctx)
+    assert args[0].shape == (
+        ctx.dm_block, ctx.nharms + 1, ctx.accel_pad, ctx.max_peaks
+    )
+    if ctx.select_smax > 0:
+        fn, args, kwargs = by["ops.resample.resample_select"].build_for(ctx)
+        assert kwargs["smax"] == ctx.select_smax
+
+    sp_ctx = shape_ctx_for_bucket(BUCKET, "spsearch", {"dm_end": 20.0})
+    assert sp_ctx.fft_size == 0
+    for name in (
+        "ops.spectrum.form_power", "ops.harmonics.harmonic_sums",
+        "ops.peaks.find_peaks_device", "ops.resample.resample_select",
+    ):
+        assert by[name].build_for(sp_ctx) is None
+
+
+def test_subband_ctx_builds_stage1():
+    from peasoup_tpu.ops.registry import registered_programs
+    from peasoup_tpu.perf.warmup import shape_ctx_for_bucket
+
+    by = {s.name: s for s in registered_programs()}
+    ctx = shape_ctx_for_bucket(
+        (512, 2, 1 << 14, 1e-5, 1500.0, -0.29), "search",
+        {"dm_end": 50.0, "subbands": 16},
+    )
+    assert ctx.subbands == 16
+    fn, args, kwargs = by["ops.dedisperse.subband_stage1"].build_for(ctx)
+    assert args[0].shape[0] == 16  # nsub bands
+    assert args[0].shape[1] == 32  # 512 / 16 channels per band
+
+
+# --------------------------------------------------------------------------
+# async dedisperse -> search overlap
+# --------------------------------------------------------------------------
+
+def _smoke_fil(tmp_path, seed=1):
+    from peasoup_tpu.io.sigproc import (
+        Filterbank,
+        SigprocHeader,
+        write_filterbank,
+    )
+
+    nsamps, nchans, tsamp, fch1, foff = 1 << 12, 8, 0.000256, 1400.0, -16.0
+    plan = DMPlan.create(
+        nsamps=nsamps, nchans=nchans, tsamp=tsamp, fch1=fch1, foff=foff,
+        dm_start=0.0, dm_end=20.0,
+    )
+    delays = plan.delay_samples()[plan.ndm // 2]
+    rng = np.random.default_rng(seed)
+    data = rng.normal(32.0, 4.0, size=(nsamps, nchans))
+    # a periodic dispersed pulse train (the periodicity search needs a
+    # train, not one transient)
+    for s0 in range(100, nsamps - 200, 128):
+        for c in range(nchans):
+            data[s0 + delays[c] : s0 + 4 + delays[c], c] += 14.0
+    hdr = SigprocHeader(
+        source_name="PLANSMOKE", tsamp=tsamp, tstart=55000.0, fch1=fch1,
+        foff=foff, nchans=nchans, nbits=8, nifs=1, data_type=1,
+    )
+    os.makedirs(str(tmp_path), exist_ok=True)
+    path = str(tmp_path / "smoke.fil")
+    write_filterbank(
+        path,
+        Filterbank(
+            header=hdr,
+            data=np.clip(np.rint(data), 0, 255).astype(np.uint8),
+        ),
+    )
+    from peasoup_tpu.io.sigproc import read_filterbank
+
+    return read_filterbank(path), path
+
+
+def test_async_dedisperse_overlap(tmp_path, monkeypatch):
+    """The dedisperse->search hop no longer serialises: the run emits
+    the async-dispatch event, and the candidate set is bitwise the
+    forced-sync run's (PEASOUP_SYNC_DEDISP=1) — deferral changes
+    scheduling, never results."""
+    from peasoup_tpu.obs.telemetry import RunTelemetry
+    from peasoup_tpu.pipeline.search import PeasoupSearch, SearchConfig
+
+    fil, _ = _smoke_fil(tmp_path)
+    cfg = SearchConfig(dm_end=20.0, min_snr=6.0)
+
+    def run(sync: bool):
+        if sync:
+            monkeypatch.setenv("PEASOUP_SYNC_DEDISP", "1")
+        else:
+            monkeypatch.delenv("PEASOUP_SYNC_DEDISP", raising=False)
+        tel = RunTelemetry()
+        with tel.activate():
+            res = PeasoupSearch(SearchConfig(**vars(cfg))).run(fil)
+        kinds = [e["kind"] for e in tel.events]
+        return res, kinds
+
+    res_async, kinds_async = run(sync=False)
+    res_sync, kinds_sync = run(sync=True)
+    assert "dedisp_async_dispatch" in kinds_async
+    assert "dedisp_async_dispatch" not in kinds_sync
+    key = lambda c: (c.dm, c.acc, c.freq, c.snr, c.nh)  # noqa: E731
+    assert [key(c) for c in res_async.candidates] == [
+        key(c) for c in res_sync.candidates
+    ]
+    assert res_async.candidates  # the injected pulsar was found
+
+
+def test_campaign_tune_end_to_end(tmp_path):
+    """A tuned campaign: the first job of a bucket tunes on the warmer
+    thread and persists the plan in the campaign-shared cache; every
+    done record carries the chosen-plan provenance; after the run the
+    bucket is warm (zero further measurements)."""
+    from peasoup_tpu.campaign.runner import (
+        CampaignConfig,
+        CampaignRunner,
+        enqueue_entries,
+        save_campaign_config,
+    )
+    from peasoup_tpu.campaign.queue import JobQueue
+
+    root = str(tmp_path / "camp")
+    obs = []
+    for i in range(2):
+        _, path = _smoke_fil(tmp_path / f"o{i}", seed=i)
+        obs.append({"input": path})
+    campaign = save_campaign_config(
+        root,
+        CampaignConfig(
+            pipeline="spsearch",
+            config={"dm_end": 20.0, "min_snr": 7.0, "n_widths": 6},
+            tune=True,
+            warmup=True,
+            warmup_mode="aot",
+        ),
+    )
+    queue = JobQueue(root)
+    enqueue_entries(queue, obs, campaign.pipeline)
+    tally = CampaignRunner(root, worker_id="w0").run()
+    assert tally["done"] == 2
+    cache = os.path.join(root, "tuning_cache.json")
+    assert os.path.exists(cache)
+    done = queue.done_records()
+    assert len(done) == 2
+    for d in done:
+        assert d["dedisp_plan"]["engine"] == "exact"
+    # exactly one job paid the tuning wall (the warmer's)
+    assert sum("tuning_s" in d for d in done) == 1
+    # the bucket is warm: resolving again measures nothing
+    n = tuning.measurement_count()
+    tuning.resolve_plan_for_bucket(
+        tuple(done[0]["bucket"]), "spsearch", campaign.config, cache
+    )
+    assert tuning.measurement_count() == n
+
+
+def test_tuned_search_end_to_end(tmp_path, monkeypatch):
+    """--tune end to end on the search driver: the manifest context
+    carries the chosen-plan provenance and a second run of the same
+    bucket resolves with zero measurement calls."""
+    from peasoup_tpu.obs.telemetry import RunTelemetry
+    from peasoup_tpu.pipeline.search import PeasoupSearch, SearchConfig
+
+    fil, _ = _smoke_fil(tmp_path)
+    cache = str(tmp_path / "tuning_cache.json")
+    cfg = SearchConfig(dm_end=20.0, min_snr=6.0, tune=True,
+                       tuning_cache=cache)
+    tel = RunTelemetry()
+    with tel.activate():
+        res = PeasoupSearch(cfg).run(fil)
+    assert res.candidates
+    assert tel.context.get("dedisp_plan", {}).get("engine") == "exact"
+    n = tuning.measurement_count()
+    tel2 = RunTelemetry()
+    with tel2.activate():
+        PeasoupSearch(SearchConfig(**vars(cfg))).run(fil)
+    assert tuning.measurement_count() == n  # warm bucket, zero tuning
+    assert tel2.context.get("dedisp_plan", {}).get("source") == "cache"
