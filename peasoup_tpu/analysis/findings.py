@@ -95,7 +95,7 @@ class Baseline:
     fingerprints: dict[str, int] = field(default_factory=dict)
 
     @classmethod
-    def load(cls, path: str) -> "Baseline":
+    def _load_strict(cls, path: str) -> "Baseline":
         with open(path) as f:
             doc = json.load(f)
         if doc.get("schema") != BASELINE_SCHEMA:
@@ -109,6 +109,27 @@ class Baseline:
         ):
             raise ValueError(f"{path}: fingerprints must map fp -> count > 0")
         return cls(fingerprints=dict(fps))
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        """Unified corrupt-artifact semantics (resilience policy):
+        warn + structured event on damage, but no quarantine rename
+        (checked-in file) and no silent empty default — an unreadable
+        baseline must fail the audit gate as an internal error, not
+        ratchet every existing finding in as new."""
+        from ..resilience import load_or_recover
+
+        out = load_or_recover(
+            path, cls._load_strict, default=None, kind="audit baseline",
+            action="failing the audit gate", quarantine=False,
+        )
+        if out is None:
+            raise ValueError(
+                f"{path}: not a readable {BASELINE_SCHEMA} baseline "
+                "(missing or corrupt; re-pin with peasoup-audit "
+                "--write-baseline)"
+            )
+        return out
 
     @classmethod
     def from_findings(cls, findings) -> "Baseline":

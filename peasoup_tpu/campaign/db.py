@@ -28,6 +28,7 @@ import sqlite3
 import time
 
 from ..obs import get_logger
+from ..resilience import DB_RETRY, faults
 
 log = get_logger("campaign.db")
 
@@ -71,17 +72,24 @@ CREATE INDEX IF NOT EXISTS idx_cand_dm ON candidates (dm);
 class CandidateDB:
     """The campaign's sqlite candidate store."""
 
-    def __init__(self, path: str) -> None:
+    def __init__(self, path: str, busy_timeout_ms: int = 30000) -> None:
         self.path = path
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
-        self._conn = sqlite3.connect(path, timeout=30.0)
+        self._conn = sqlite3.connect(
+            path, timeout=max(0.001, busy_timeout_ms / 1000.0)
+        )
         self._conn.row_factory = sqlite3.Row
         try:
             self._conn.execute("PRAGMA journal_mode=WAL")
         except sqlite3.OperationalError:
             pass  # WAL unsupported on some shared filesystems
-        self._conn.execute("PRAGMA busy_timeout=30000")
+        # first line of defence against concurrent writers; the
+        # resilience DB_RETRY wrapped around every transaction is the
+        # second (sqlite can still surface `database is locked` when a
+        # writer starves the handle past this timeout). Tests shrink it
+        # to force real two-process contention through the retry path.
+        self._conn.execute(f"PRAGMA busy_timeout={int(busy_timeout_ms)}")
         self._conn.executescript(_SCHEMA)
         self._conn.commit()
 
@@ -127,29 +135,41 @@ class CandidateDB:
             )
             counts["single_pulse"] += 1
         ingested_unix = time.time()
-        with self._conn:  # one transaction: delete + reinsert
-            self._conn.execute(
-                "DELETE FROM candidates WHERE job_id = ?", (job_id,)
-            )
-            self._conn.execute(
-                "INSERT OR REPLACE INTO observations VALUES (?,?,?,?,?,?,?,?)",
-                (
-                    job_id,
-                    input_path or hdr.get("rawdatafile", ""),
-                    hdr.get("source_name", ""),
-                    float(hdr.get("tstart", 0) or 0),
-                    float(hdr.get("tsamp", 0) or 0),
-                    int(float(hdr.get("nchans", 0) or 0)),
-                    int(float(hdr.get("nsamples", 0) or 0)),
-                    ingested_unix,
-                ),
-            )
-            self._conn.executemany(
-                "INSERT INTO candidates (job_id, kind, dm, snr, period, "
-                "opt_period, acc, nh, folded_snr, time_s, sample, width, "
-                "members) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
-                rows,
-            )
+
+        def _ingest_txn():
+            faults.fire("db.ingest", context=job_id)
+            with self._conn:  # one transaction: delete + reinsert
+                self._conn.execute(
+                    "DELETE FROM candidates WHERE job_id = ?", (job_id,)
+                )
+                self._conn.execute(
+                    "INSERT OR REPLACE INTO observations VALUES "
+                    "(?,?,?,?,?,?,?,?)",
+                    (
+                        job_id,
+                        input_path or hdr.get("rawdatafile", ""),
+                        hdr.get("source_name", ""),
+                        float(hdr.get("tstart", 0) or 0),
+                        float(hdr.get("tsamp", 0) or 0),
+                        int(float(hdr.get("nchans", 0) or 0)),
+                        int(float(hdr.get("nsamples", 0) or 0)),
+                        ingested_unix,
+                    ),
+                )
+                self._conn.executemany(
+                    "INSERT INTO candidates (job_id, kind, dm, snr, "
+                    "period, opt_period, acc, nh, folded_snr, time_s, "
+                    "sample, width, members) VALUES "
+                    "(?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                    rows,
+                )
+
+        # WAL + busy_timeout serialise most contention, but two racing
+        # ingesters can still surface `database is locked` (e.g. a
+        # checkpoint starving the write lock past the timeout); the
+        # transaction is idempotent, so the shared bounded-backoff
+        # policy retries it whole
+        DB_RETRY.call(_ingest_txn, site="db.ingest", context=job_id)
         log.info(
             "ingested %s: %d periodicity + %d single-pulse candidates",
             job_id, counts["periodicity"], counts["single_pulse"],
@@ -157,6 +177,14 @@ class CandidateDB:
         return counts
 
     # --- queries ------------------------------------------------------
+    def _query(self, q: str, args=()) -> list[dict]:
+        """Read path under the same busy/locked retry as ingest (a
+        reader can see SQLITE_BUSY during a WAL checkpoint)."""
+        return DB_RETRY.call(
+            lambda: [dict(r) for r in self._conn.execute(q, args)],
+            site="db.query",
+        )
+
     def top_candidates(
         self, kind: str | None = None, limit: int = 20
     ) -> list[dict]:
@@ -167,25 +195,20 @@ class CandidateDB:
             args.append(kind)
         q += " ORDER BY c.snr DESC LIMIT ?"
         args.append(int(limit))
-        return [dict(r) for r in self._conn.execute(q, args)]
+        return self._query(q, args)
 
     def counts(self) -> dict:
-        obs = self._conn.execute(
-            "SELECT COUNT(*) AS n FROM observations"
-        ).fetchone()["n"]
+        obs = self._query("SELECT COUNT(*) AS n FROM observations")
         by_kind = {
             r["kind"]: r["n"]
-            for r in self._conn.execute(
+            for r in self._query(
                 "SELECT kind, COUNT(*) AS n FROM candidates GROUP BY kind"
             )
         }
-        return {"observations": obs, "candidates": by_kind}
+        return {"observations": obs[0]["n"], "candidates": by_kind}
 
     def candidates_for(self, job_id: str) -> list[dict]:
-        return [
-            dict(r)
-            for r in self._conn.execute(
-                "SELECT * FROM candidates WHERE job_id = ? ORDER BY snr DESC",
-                (job_id,),
-            )
-        ]
+        return self._query(
+            "SELECT * FROM candidates WHERE job_id = ? ORDER BY snr DESC",
+            (job_id,),
+        )

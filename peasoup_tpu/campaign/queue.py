@@ -40,6 +40,7 @@ import uuid
 from dataclasses import dataclass, field
 
 from ..obs import get_logger
+from ..resilience import IO_RETRY, faults, is_transient
 
 log = get_logger("campaign.queue")
 
@@ -247,9 +248,43 @@ class JobQueue:
         if job is None or job.next_eligible_unix > now:
             return None
         path = self._p(_CLAIMS, job_id)
+
+        def _create_claim():
+            faults.fire("queue.claim", context=job_id)
+            return os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+
         try:
-            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            # transient I/O (flaky mount, injected queue.claim fault)
+            # retries under the shared policy; losing the O_EXCL race
+            # (FileExistsError) is a protocol outcome, not an error
+            fd = IO_RETRY.call(
+                _create_claim, site="queue.claim", context=job_id
+            )
         except FileExistsError:
+            return None
+        except OSError as exc:
+            if is_transient(exc):
+                # retry budget spent: walk away; the job stays pending
+                # and any worker (including us, next poll) claims it
+                log.warning(
+                    "claim of %s abandoned after transient I/O "
+                    "failures: %.200s", job_id, exc,
+                )
+                return None
+            raise
+        if os.path.exists(self._p(_DONE, job_id)) or os.path.exists(
+            self._p(_QUARANTINE, job_id)
+        ):
+            # lost the completion race: between our eligibility check
+            # and the O_EXCL create, the previous owner finished and
+            # released — without this re-check a second worker would
+            # re-run a terminal job (exactly-once violation seen as a
+            # duplicate under load in the two-worker race test)
+            os.close(fd)
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
             return None
         expires = now + self.lease_s
         with os.fdopen(fd, "w") as f:
@@ -404,6 +439,10 @@ class JobQueue:
         tombstone: if the lease is no longer expired the rename
         caught a freshly renewed claim, and it is put back."""
         now = time.time() if now is None else now
+        # chaos seam: a scheduled clock.skew fault shifts THIS
+        # reaper's view of lease expiry (drills premature reaping —
+        # the renew-race putback below must absorb it)
+        now += faults.clock_skew_s()
         reaped = []
         cdir = os.path.join(self.qdir, _CLAIMS)
         for name in sorted(os.listdir(cdir)):

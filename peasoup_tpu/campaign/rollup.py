@@ -101,6 +101,18 @@ def build_status(root: str, queue: JobQueue | None = None) -> dict:
         )
         if d.get("dedisp_plan") is not None:
             rec["plan"] = d["dedisp_plan"]
+    # resilience rollup: sum the per-job deltas the runner stores in
+    # done records (retries/degradations/faults survived on the way to
+    # "done") — campaign-wide recovery accounting without re-reading
+    # every job's telemetry manifest
+    resilience: dict[str, dict] = {}
+    for d in done:
+        for table, kv in (d.get("resilience") or {}).items():
+            if not isinstance(kv, dict):
+                continue
+            tgt = resilience.setdefault(table, {})
+            for k, v in kv.items():
+                tgt[k] = tgt.get(k, 0) + int(v)
     quarantined = [
         {
             "job_id": q.get("job_id"),
@@ -131,6 +143,8 @@ def build_status(root: str, queue: JobQueue | None = None) -> dict:
         # warm/plan tallies warmup-aware claiming reads
         "tuning_total_s": round(tuning_s, 3),
         "warm_buckets": warm_buckets,
+        # what completed jobs survived (resilience/stats.py deltas)
+        "resilience": resilience,
     }
 
 

@@ -486,18 +486,34 @@ class _BucketWarmer(threading.Thread):
     context, so its compiles never count against the job's telemetry
     JIT stats — by the time the pipeline runs, every program is in the
     in-process jit caches (dryrun) or the persistent compilation cache
-    (aot)."""
+    (aot).
+
+    The body runs under the resilience crash guard: an escaping
+    exception emits a structured ``thread_crashed`` event on the job's
+    telemetry (instead of dying invisibly, as it used to), flips the
+    ``resilience`` status section to degraded, and the job proceeds
+    unwarmed — warmup is an optimisation, never a dependency."""
 
     def __init__(
         self, bucket: tuple, pipeline: str, overrides: dict,
         scratch_dir: str, mode: str, tuning_cache: str | None = None,
+        telemetry=None,
     ) -> None:
         super().__init__(name="campaign-warmup", daemon=True)
         self._args = (bucket, pipeline, overrides, scratch_dir, mode)
         self._tuning_cache = tuning_cache
+        self._telemetry = telemetry
         self._stats: dict | None = None
+        self._error: Exception | None = None
 
     def run(self) -> None:
+        from ..resilience import guard_thread
+
+        self._error = guard_thread(
+            "campaign-warmup", self._warm, telemetry=self._telemetry
+        )
+
+    def _warm(self) -> None:
         from ..perf.warmup import warm_bucket
 
         bucket, pipeline, overrides, scratch_dir, mode = self._args
@@ -529,7 +545,11 @@ class _BucketWarmer(threading.Thread):
             return {
                 "bucket": list(bucket), "mode": mode, "seconds": 0.0,
                 "programs_compiled": 0, "cache_hits": 0,
-                "error": "warmup thread produced no result",
+                "error": (
+                    f"warmup thread crashed: {self._error!s:.200}"
+                    if self._error is not None
+                    else "warmup thread produced no result"
+                ),
                 "tuning": None,
             }
         return self._stats
@@ -537,16 +557,29 @@ class _BucketWarmer(threading.Thread):
 
 class _LeaseRenewer(threading.Thread):
     """Daemon renewing the worker's claim at a third of the lease, so
-    only a dead (or wedged-past-lease) worker ever loses a job."""
+    only a dead (or wedged-past-lease) worker ever loses a job. The
+    loop body already tolerates per-renewal failures; the crash guard
+    covers everything else (a bug here silently forfeiting leases is
+    exactly the invisible-thread-death failure mode)."""
 
-    def __init__(self, queue: JobQueue, claim: Claim) -> None:
+    def __init__(
+        self, queue: JobQueue, claim: Claim, telemetry=None
+    ) -> None:
         super().__init__(name="campaign-lease", daemon=True)
         self._queue = queue
         self._claim = claim
+        self._telemetry = telemetry
         # NB: not "_stop" — Thread uses that name internally
         self._halt = threading.Event()
 
     def run(self) -> None:
+        from ..resilience import guard_thread
+
+        guard_thread(
+            "campaign-lease", self._renew_loop, telemetry=self._telemetry
+        )
+
+    def _renew_loop(self) -> None:
         period = max(0.05, self._queue.lease_s / 3.0)
         while not self._halt.wait(period):
             try:
@@ -607,7 +640,10 @@ class CampaignRunner:
             attempt=job.attempts + 1,
             bucket=list(job.bucket) if job.bucket else None,
         )
-        renewer = _LeaseRenewer(self.queue, claim)
+        from ..resilience import STATS as _RES_STATS
+
+        res_base = _RES_STATS.snapshot()
+        renewer = _LeaseRenewer(self.queue, claim, telemetry=tel)
         renewer.start()
         warmer = None
         if (
@@ -624,6 +660,7 @@ class CampaignRunner:
                 os.path.join(self.root, "warmup", job.job_id),
                 self.campaign.warmup_mode,
                 tuning_cache=self._tuning_cache,
+                telemetry=tel,
             )
             warmer.start()
             self._warmed_buckets.add(tuple(job.bucket))
@@ -641,6 +678,14 @@ class CampaignRunner:
         try:
             with tel.activate():
                 try:
+                    # chaos seam: a scheduled worker.kill raises
+                    # WorkerKilled (BaseException) here — it skips the
+                    # except below exactly like a real SIGKILL skips
+                    # the failure path, the claim is never released,
+                    # and the lease reaper is the only recovery
+                    from ..resilience import faults
+
+                    faults.fire("worker.kill", context=job.job_id)
                     info = run_observation(
                         job, overrides, job_dir, tel,
                         bucket_ladder=self.campaign.bucket_nsamps,
@@ -673,6 +718,12 @@ class CampaignRunner:
                         info["ingested"] = db.ingest_job(
                             job.job_id, job_dir, job.input
                         )
+                    # per-job resilience accounting: what THIS job
+                    # survived (retries, degradations, injected
+                    # faults), for the done record + campaign rollup
+                    res_delta = _RES_STATS.delta_since(res_base)
+                    if res_delta:
+                        info["resilience"] = res_delta
                     tel.set_stage("done")
                     tel.write(manifest_path)
                 except Exception as exc:
@@ -695,6 +746,12 @@ class CampaignRunner:
             heartbeat.stop()
             recorder.close()
             renewer.stop()
+        # second chaos seam: dying AFTER the work but BEFORE the done
+        # record is the worst case for exactly-once — the reaped job
+        # re-runs in full and must complete idempotently
+        from ..resilience import faults as _faults
+
+        _faults.fire("worker.kill", context=f"{job.job_id}:pre-complete")
         self.queue.complete(claim, worker_id=self.worker_id, **info)
         if job.bucket:
             self._last_bucket = job.bucket

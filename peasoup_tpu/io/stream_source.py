@@ -40,6 +40,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..obs import get_logger
+from ..resilience import IO_RETRY, faults, is_transient
 from .dada import DADA_HDR_SIZE, DadaHeader
 from .sigproc import read_sigproc_header, unpack_bits
 
@@ -143,6 +144,14 @@ class ReplaySource(StreamSource):
         t0 = time.perf_counter()
         data = self.fil.data  # unpacks sub-byte payloads once
         for blk in _blocks_from_array(data, self.block_samples):
+            # fault seam: a replayed recording is RAM-resident, so a
+            # "flaky read" here costs nothing to redo — the retry
+            # policy absorbs the injection and the stream continues
+            # (the chaos soak's transient-read drill for streaming)
+            IO_RETRY.call(
+                faults.fire, "fil.read", f"replay:seq{blk.seq}",
+                site="fil.read", context=f"replay:seq{blk.seq}",
+            )
             if self.rate > 0:
                 release = t0 + (
                     (blk.seq + 1) * self.block_samples * self.fil.tsamp
@@ -221,16 +230,44 @@ class FileTailSource(StreamSource):
         last_growth = time.perf_counter()
         pending = b""
         while True:
-            size = os.path.getsize(self.path)
-            avail = size - offset
-            if avail > 0:
-                take = min(avail, 4 * blk_bytes)
-                with open(self.path, "rb") as f:
-                    f.seek(offset)
-                    pending += f.read(take)
-                offset += take
-                last_growth = time.perf_counter()
-            ended = self._ended() and offset >= os.path.getsize(self.path)
+            try:
+                faults.fire(
+                    "fil.read", context=f"tail:{self.path}@{offset}"
+                )
+                size = os.path.getsize(self.path)
+                avail = size - offset
+                if avail > 0:
+                    take = min(avail, 4 * blk_bytes)
+                    with open(self.path, "rb") as f:
+                        f.seek(offset)
+                        pending += f.read(take)
+                    offset += take
+                    last_growth = time.perf_counter()
+            except OSError as exc:
+                # a tailed file can vanish briefly (recorder rotating /
+                # re-linking) or throw EIO on a flaky mount; both are
+                # transient AT THIS SEAM — keep polling, bounded by the
+                # idle timeout (last_growth stops advancing). Anything
+                # else is a real error.
+                if not (
+                    is_transient(exc) or isinstance(exc, FileNotFoundError)
+                ):
+                    raise
+                log.warning(
+                    "transient tail-read failure on %s (%s: %.200s); "
+                    "retrying", self.path, type(exc).__name__, exc,
+                )
+                time.sleep(self.poll_s)
+            if self._ended():
+                # re-stat: the final append may have landed between our
+                # read and the completion marker (stat failure defers
+                # the decision to the next poll)
+                try:
+                    ended = offset >= os.path.getsize(self.path)
+                except OSError:
+                    ended = False
+            else:
+                ended = False
             idle = (
                 time.perf_counter() - last_growth > self.idle_timeout_s
             )
@@ -339,9 +376,25 @@ class DadaStreamSource(StreamSource):
         while True:
             segs = [s for s in self._segments() if s not in consumed]
             for seg in segs:
-                with open(seg, "rb") as f:
-                    f.seek(DADA_HDR_SIZE)
-                    pending += f.read()
+                try:
+                    faults.fire("fil.read", context=f"dada:{seg}")
+                    with open(seg, "rb") as f:
+                        f.seek(DADA_HDR_SIZE)
+                        pending += f.read()
+                except OSError as exc:
+                    # a segment mid-rename or a flaky mount: leave it
+                    # unconsumed and re-poll (idle timeout bounds this)
+                    if not (
+                        is_transient(exc)
+                        or isinstance(exc, FileNotFoundError)
+                    ):
+                        raise
+                    log.warning(
+                        "transient segment read failure on %s "
+                        "(%s: %.200s); retrying", seg,
+                        type(exc).__name__, exc,
+                    )
+                    break
                 consumed.add(seg)
                 last_growth = time.perf_counter()
             ended = self._ended() and not [

@@ -129,6 +129,19 @@ class Heartbeat:
 
     # --- the beat -----------------------------------------------------
     def _run(self) -> None:
+        # crash guard (resilience policy): _beat swallows per-snapshot
+        # failures already, but if the loop itself ever dies the run
+        # must not lose its liveness signal invisibly — the guard
+        # emits a structured thread_crashed event and flips the
+        # resilience status section to degraded. Lazy import: obs is
+        # below resilience in the import graph.
+        from ..resilience import guard_thread
+
+        guard_thread(
+            "peasoup-heartbeat", self._beat_loop, telemetry=self._tel
+        )
+
+    def _beat_loop(self) -> None:
         while not self._stop_evt.wait(self.interval):
             self._beat()
 

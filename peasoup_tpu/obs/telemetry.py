@@ -155,6 +155,13 @@ class RunTelemetry:
         self.status_sections: dict = {}
         if enabled:
             _install_jit_listener()
+            # every run carries the process's resilience accounting
+            # (retries, degradations, injected faults, thread crashes)
+            # as a status section in status.json and the manifest.
+            # stats.py is dependency-free, so no import cycle.
+            from ..resilience.stats import STATS
+
+            self.status_sections["resilience"] = STATS.snapshot
 
     # --- recording ----------------------------------------------------
     def set_context(self, **fields) -> None:

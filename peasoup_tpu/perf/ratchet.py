@@ -83,7 +83,7 @@ def baseline_from_perf(
     }
 
 
-def load_baseline(path: str) -> dict:
+def _load_baseline_strict(path: str) -> dict:
     import json
 
     with open(path) as f:
@@ -95,6 +95,26 @@ def load_baseline(path: str) -> dict:
         )
     if not isinstance(doc.get("programs"), dict):
         raise ValueError(f"{path}: baseline lacks a programs map")
+    return doc
+
+
+def load_baseline(path: str) -> dict:
+    """Baseline load under the unified corrupt-artifact policy: warn +
+    structured event, but NO quarantine rename (the baseline is a
+    checked-in file — renaming it would dirty the git tree) and NO
+    silent empty default (an unreadable baseline must fail the perf
+    gate loudly, or every regression would ratchet in as "new")."""
+    from ..resilience import load_or_recover
+
+    doc = load_or_recover(
+        path, _load_baseline_strict, default=None, kind="perf baseline",
+        action="failing the perf gate", quarantine=False,
+    )
+    if doc is None:
+        raise ValueError(
+            f"{path}: missing or unreadable perf baseline (re-pin with "
+            "peasoup-perf check --write-baseline)"
+        )
     return doc
 
 

@@ -105,26 +105,31 @@ def validate_cache(doc: dict) -> None:
     validate(doc, schema)
 
 
+def _load_cache_strict(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("schema") != TUNING_SCHEMA:
+        raise ValueError(f"schema={doc.get('schema')!r}")
+    validate_cache(doc)
+    return doc
+
+
 def load_cache(path: str) -> dict:
     """Load the tuning cache; a missing file yields an empty cache, a
     corrupt or schema-violating one yields an empty cache WITH A
-    WARNING (the contract: re-tune, never crash a worker on a torn
-    shared file)."""
-    try:
-        with open(path) as f:
-            doc = json.load(f)
-        if doc.get("schema") != TUNING_SCHEMA:
-            raise ValueError(f"schema={doc.get('schema')!r}")
-        validate_cache(doc)
-        return doc
-    except FileNotFoundError:
-        return _empty_cache()
-    except Exception as exc:
-        log.warning(
-            "tuning cache %s unreadable (%s: %.200s); re-tuning from "
-            "scratch", path, type(exc).__name__, exc,
+    WARNING and the damaged file quarantined to ``*.corrupt`` (the
+    contract: re-tune, never crash a worker on a torn shared file) —
+    the unified resilience.load_or_recover semantics."""
+    from ..resilience import faults, load_or_recover
+
+    faults.maybe_corrupt_file(path, context=f"tuning_cache:{path}")
+    return (
+        load_or_recover(
+            path, _load_cache_strict, default=None, kind="tuning cache",
+            action="re-tuning from scratch", logger=log,
         )
-        return _empty_cache()
+        or _empty_cache()
+    )
 
 
 def save_cache(path: str, doc: dict) -> None:

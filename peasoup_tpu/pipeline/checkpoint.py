@@ -25,6 +25,7 @@ import tempfile
 import numpy as np
 
 from ..obs import get_logger
+from ..resilience import IO_RETRY, faults, load_or_recover
 
 log = get_logger("pipeline.checkpoint")
 
@@ -76,46 +77,58 @@ class SearchCheckpoint:
         return repr(fields)
 
     def _store_files(self) -> list[str]:
-        """The base file plus every per-slice sibling, existing ones."""
+        """The base file plus every per-slice sibling, existing ones —
+        excluding quarantined ``*.corrupt`` siblings."""
         paths = []
         if os.path.exists(self.base_path):
             paths.append(self.base_path)
-        paths.extend(sorted(glob.glob(glob.escape(self.base_path) + ".dm*")))
+        paths.extend(
+            p
+            for p in sorted(
+                glob.glob(glob.escape(self.base_path) + ".dm*")
+            )
+            if not p.endswith(".corrupt")
+        )
         return paths
+
+    def _load_store(self, path: str) -> dict[int, tuple]:
+        """One store file's slice-filtered entries; raises on damage."""
+        out: dict[int, tuple] = {}
+        with np.load(path, allow_pickle=False) as z:
+            if str(z["config_key"]) != self.config_key:
+                return out
+            for d in z["dm_idxs"]:
+                g = int(d)
+                if g < self.lo or (self.hi is not None and g >= self.hi):
+                    continue
+                out[g - self.lo] = (
+                    z[f"idxs_{g}"], z[f"snrs_{g}"], z[f"counts_{g}"]
+                )
+        return out
 
     def load(self) -> dict[int, tuple[np.ndarray, np.ndarray, np.ndarray]]:
         """Union of all store files, filtered to this process's slice,
-        returned with LOCAL keys; {} if absent or config changed."""
+        returned with LOCAL keys; {} if absent or config changed.
+
+        A truncated/corrupt store (worker SIGKILLed mid-write, torn
+        copy, bad disk) must never fail the run — resume loses nothing
+        but the restart time, and campaign retries (campaign/runner.py)
+        depend on a damaged checkpoint degrading to "start over", not
+        crashing the job again. The unified policy
+        (resilience.load_or_recover) warns and quarantines the damaged
+        file to ``*.corrupt`` so the torn bytes survive for forensics
+        and the next save starts clean."""
         if not self.base_path:
             return {}
         out: dict[int, tuple] = {}
         for path in self._store_files():
-            try:
-                with np.load(path, allow_pickle=False) as z:
-                    if str(z["config_key"]) != self.config_key:
-                        continue
-                    for d in z["dm_idxs"]:
-                        g = int(d)
-                        if g < self.lo or (self.hi is not None and g >= self.hi):
-                            continue
-                        out[g - self.lo] = (
-                            z[f"idxs_{g}"], z[f"snrs_{g}"], z[f"counts_{g}"]
-                        )
-            except Exception as exc:
-                # A truncated/corrupt store (worker SIGKILLed mid-write,
-                # torn copy, bad disk) must never fail the run — resume
-                # loses nothing but the restart time, and campaign
-                # retries (campaign/runner.py) depend on a damaged
-                # checkpoint degrading to "start over", not crashing
-                # the job again. np.load raises well outside
-                # OSError/ValueError here (zipfile.BadZipFile,
-                # EOFError, pickle errors), so catch everything.
-                log.warning(
-                    "discarding unreadable checkpoint %s "
-                    "(%s: %.200s); restarting those trials",
-                    path, type(exc).__name__, exc,
-                )
-                continue
+            faults.maybe_corrupt_file(path, context=f"checkpoint:{path}")
+            part = load_or_recover(
+                path, self._load_store, default=None, kind="checkpoint",
+                action="restarting those trials", logger=log,
+            )
+            if part:
+                out.update(part)
         return out
 
     def save(
@@ -139,12 +152,24 @@ class SearchCheckpoint:
             arrays[f"counts_{g}"] = counts
         dirname = os.path.dirname(os.path.abspath(self.write_path)) or "."
         os.makedirs(dirname, exist_ok=True)
-        fd, tmp = tempfile.mkstemp(dir=dirname, suffix=".ckpt.tmp")
-        try:
-            with os.fdopen(fd, "wb") as f:
-                np.savez(f, **arrays)
-            os.replace(tmp, self.write_path)
-        except BaseException:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
-            raise
+
+        def _write_once():
+            faults.fire(
+                "checkpoint.write", context=self.write_path
+            )
+            fd, tmp = tempfile.mkstemp(dir=dirname, suffix=".ckpt.tmp")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    np.savez(f, **arrays)
+                os.replace(tmp, self.write_path)
+            except BaseException:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+                raise
+
+        # a checkpoint write hitting a transient error (EIO, ENOSPC
+        # burp, injected checkpoint.write fault) retries; persistent
+        # failure raises — the campaign attempt budget owns it
+        IO_RETRY.call(
+            _write_once, site="checkpoint.write", context=self.write_path
+        )

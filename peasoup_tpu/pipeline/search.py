@@ -183,25 +183,14 @@ def _level_windows(
 
 
 def _is_oom(exc: Exception) -> bool:
-    """Device out-of-memory signature (XLA compile- or run-time).
+    """Device out-of-memory signature — now the shared taxonomy's
+    :func:`peasoup_tpu.resilience.errors.is_resource_exhausted`
+    (kept as a module function: the single-pulse driver and tests
+    import it from here, and its contract is pinned against the real
+    JAX OOM exception in tests/test_aux.py)."""
+    from ..resilience import is_resource_exhausted
 
-    jaxlib exposes no status-code attribute on its runtime error, so
-    the typed contract available is: a JaxRuntimeError whose ABSL
-    status message LEADS with the canonical code RESOURCE_EXHAUSTED
-    (absl::Status string formatting — stabler than substring-anywhere).
-    Host allocation failure (MemoryError) joins it; the substring
-    heuristics remain only as a fallback for wrapped/re-raised text.
-    """
-    if isinstance(exc, MemoryError):
-        return True
-    msg = str(exc)
-    if isinstance(exc, jax.errors.JaxRuntimeError) and msg.lstrip().startswith(
-        "RESOURCE_EXHAUSTED"
-    ):
-        return True
-    return "RESOURCE_EXHAUSTED" in msg or (
-        "memory" in msg.lower() and "hbm" in msg.lower()
-    )
+    return is_resource_exhausted(exc)
 
 
 def _densify_ragged(
@@ -1043,6 +1032,13 @@ class PeasoupSearch:
         progress = ProgressBar() if cfg.progress_bar else None
         if progress:
             progress.start()
+        from ..resilience import DegradationLadder, faults
+
+        # the memory degradation ladder: halving dm_block is one rung,
+        # stepped repeatedly; falling off the bottom (blocks already at
+        # the device count) is explicit exhaustion, and the error
+        # propagates to the campaign attempt budget
+        ladder = DegradationLadder("search.memory", ("dm_block_shrink",))
         shrink = 1
         while True:
             chunks = build_chunks(shrink)
@@ -1053,6 +1049,7 @@ class PeasoupSearch:
                 max_dm_block=max((d for _, d in chunks), default=0),
             )
             try:
+                faults.fire("device.oom", context=f"search:shrink{shrink}")
                 self._run_waves(
                     waves, len(chunks), per_dm_results, ckpt,
                     progress, build_search, dispatch_lists,
@@ -1066,7 +1063,12 @@ class PeasoupSearch:
                 # estimate; halve the block and retry (finished trials
                 # are in per_dm_results and are not re-searched)
                 max_blk = max(d for _, d in chunks)
-                if not _is_oom(exc) or max_blk <= len(devices):
+                if not _is_oom(exc):
+                    raise
+                if max_blk <= len(devices):
+                    ladder.exhausted(
+                        dm_block=max_blk, error=f"{exc!s:.200}"
+                    )
                     raise
                 shrink *= 2
                 new_blk = max(d for _, d in build_chunks(shrink))
@@ -1078,6 +1080,10 @@ class PeasoupSearch:
                     "oom_shrink_retry", dm_block_old=max_blk,
                     dm_block_new=new_blk, shrink=shrink,
                     error=f"{exc!s:.200}",
+                )
+                ladder.step(
+                    "dm_block_shrink", dm_block_old=max_blk,
+                    dm_block_new=new_blk, error=f"{exc!s:.200}",
                 )
         if progress:
             progress.stop()
@@ -1269,6 +1275,17 @@ class PeasoupSearch:
                         )
                         current_telemetry().event(
                             "pallas_resample_disabled",
+                            pallas_block=self._cur_pallas_block,
+                            error=f"{exc!r:.200}",
+                        )
+                        # ladder bookkeeping: Pallas kernel -> jnp twin
+                        # is an ordered, observable degradation too
+                        from ..resilience import DegradationLadder
+
+                        DegradationLadder(
+                            "search.pallas", ("jnp_twin",)
+                        ).step(
+                            "jnp_twin",
                             pallas_block=self._cur_pallas_block,
                             error=f"{exc!r:.200}",
                         )

@@ -532,6 +532,9 @@ class SinglePulseSearch:
         if n_dev > 1:
             dm_block = max(n_dev, -(-dm_block // n_dev) * n_dev)
 
+        from ..resilience import DegradationLadder, faults
+
+        ladder = DegradationLadder("spsearch.memory", ("dm_block_shrink",))
         shrink = 1
         while True:
             blk = max(
@@ -548,13 +551,19 @@ class SinglePulseSearch:
                 shrink=shrink, pallas_span=pallas_span,
             )
             try:
+                faults.fire(
+                    "device.oom", context=f"spsearch:shrink{shrink}"
+                )
                 self._run_waves(
                     chunks, blk, trials, per_dm, ckpt, widths,
                     sharding=sharding, spill=spill,
                 )
                 break
             except Exception as exc:
-                if not _is_oom(exc) or blk <= max(1, n_dev):
+                if not _is_oom(exc):
+                    raise
+                if blk <= max(1, n_dev):
+                    ladder.exhausted(dm_block=blk, error=f"{exc!s:.200}")
                     raise
                 shrink *= 2
                 log.warning(
@@ -565,6 +574,11 @@ class SinglePulseSearch:
                 tel.event(
                     "sp_oom_shrink_retry", dm_block_old=blk,
                     shrink=shrink, error=f"{exc!s:.200}",
+                )
+                ladder.step(
+                    "dm_block_shrink", dm_block_old=blk,
+                    dm_block_new=max(1, dm_block // shrink),
+                    error=f"{exc!s:.200}",
                 )
         timers["searching"] = time.perf_counter() - t0
         tel.capture_device_memory("search")

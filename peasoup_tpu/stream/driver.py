@@ -208,13 +208,23 @@ class StreamingSearch:
 
     # --- reader thread ------------------------------------------------
     def _read(self, source, q: BoundedBlockQueue, tel) -> None:
-        try:
+        from ..resilience import guard_thread
+
+        def _pump() -> None:
             for blk in source.blocks():
                 q.put(blk)
-        except Exception as exc:  # surface in the main loop
-            self._reader_error = exc
-            log.error("stream reader failed: %s", exc)
-            tel.event("stream_reader_error", error=f"{exc!s:.300}")
+
+        try:
+            # the crash guard emits the structured thread_crashed
+            # event (and flips the resilience section to degraded);
+            # the error still surfaces in the main loop — a stream
+            # cannot continue without its source
+            exc = guard_thread(
+                "peasoup-stream-reader", _pump, telemetry=tel
+            )
+            if exc is not None:
+                self._reader_error = exc
+                tel.event("stream_reader_error", error=f"{exc!s:.300}")
         finally:
             q.close()
 
@@ -382,8 +392,16 @@ class StreamingSearch:
         q = BoundedBlockQueue(cfg.queue_blocks, cfg.policy)
         self._queue = q
         tel.set_status_section("streaming", self._status_section)
+        # the reader runs under a copy of this thread's context so the
+        # run's ambient telemetry (and with it fault-injection /
+        # retry event attribution from the resilience layer) crosses
+        # the thread boundary; the reader does no device work, so no
+        # JIT stats can leak in from it
+        import contextvars
+
+        _reader_ctx = contextvars.copy_context()
         reader = threading.Thread(
-            target=self._read, args=(source, q, tel),
+            target=lambda: _reader_ctx.run(self._read, source, q, tel),
             name="peasoup-stream-reader", daemon=True,
         )
         reader.start()

@@ -1,0 +1,620 @@
+"""``peasoup-chaos`` — the chaos soak: real workloads under seeded
+fault schedules, judged by end-to-end invariants.
+
+The unit tests prove each recovery path in isolation; this tool proves
+they *compose*. It runs a synthetic multi-observation campaign (and a
+replay stream) twice — once fault-free for ground truth, once under a
+deterministic fault schedule (resilience/faults.py) — and asserts the
+invariants that define "survived":
+
+* **exactly-once** — every enqueued job ends done XOR quarantined;
+  nothing is lost, nothing double-completes.
+* **bitwise-equal results** — for transient-only schedules (flaky
+  reads, sqlite contention, worker kills — faults that must not change
+  *what* is computed), every job's candidate file is byte-identical to
+  the fault-free run, and every replayed stream trigger matches.
+* **clean tree** — no leaked claim files, reap tombstones or ``*.tmp``
+  atomic-write residue anywhere under the campaign root.
+* **valid telemetry** — every done job's manifest validates against
+  the checked-in schema; the campaign rollup loads and carries the
+  resilience section.
+* **bounded + attributed recovery** — retry counts stay within
+  policy x injections, and every fault site that fired has a nonzero
+  tally on the recovery path that answers it (retries for flaky
+  reads/ingest, lease reaping for worker kills, quarantined artifacts
+  for corrupted caches).
+
+Runs in seconds on CPU (tiny observations), which is what lets
+scripts/check.sh gate every commit on a chaos soak::
+
+    peasoup-chaos --mode both -o /tmp/chaos \\
+        --faults 'fil.read:p=0.25:n=4,db.ingest:at=1,worker.kill:at=obs0' \\
+        --seed 7
+
+Exit codes: 0 survived (all invariants hold), 1 invariant violated,
+2 internal error. A ``chaos_report.json`` with the schedule, the
+injection log and the per-invariant outcomes lands in the workdir.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+from ..obs import get_logger
+
+log = get_logger("tools.chaos")
+
+REPORT_SCHEMA = "peasoup_tpu.chaos_report"
+REPORT_VERSION = 1
+
+DEFAULT_CAMPAIGN_FAULTS = (
+    "fil.read:p=0.25:n=4,db.ingest:at=1,worker.kill:at=obs0"
+)
+# at=replay pins the injections to the reader thread's replay loop
+# (the cross-thread attribution drill), not the initial batch read
+DEFAULT_STREAM_FAULTS = "fil.read:at=replay:n=2"
+
+# sites whose injections must never change results — the schedules this
+# tool accepts for the bitwise-equality invariant
+TRANSIENT_SITES = frozenset(
+    {"fil.read", "queue.claim", "db.ingest", "checkpoint.write",
+     "worker.kill", "device.oom", "cache.corrupt", "clock.skew"}
+)
+
+# fault site -> stats tables where its recovery must leave a mark
+RECOVERY_TABLES = {
+    "fil.read": ("retries", "recoveries", "giveups"),
+    "queue.claim": ("retries", "recoveries", "giveups"),
+    "db.ingest": ("retries", "recoveries", "giveups"),
+    "checkpoint.write": ("retries", "recoveries", "giveups"),
+    "device.oom": ("degradations",),
+    "cache.corrupt": ("corrupt_artifacts",),
+    # worker.kill recovery is the queue reaper: checked against job
+    # attempt counts, not a stats table
+    "worker.kill": (),
+    "clock.skew": (),
+}
+
+
+# --------------------------------------------------------------------------
+# synthetic observations (the check.sh smoke-gate recipe, parameterised)
+# --------------------------------------------------------------------------
+
+def make_observations(
+    data_dir: str,
+    n_obs: int = 3,
+    nsamps: int = 1 << 12,
+    nchans: int = 8,
+) -> list[str]:
+    """Write ``n_obs`` small synthetic filterbanks, each with one
+    strong dispersed pulse (distinct noise per observation, same
+    shape bucket so the campaign exercises warm reuse)."""
+    from ..io.sigproc import (
+        Filterbank,
+        SigprocHeader,
+        write_filterbank,
+    )
+    from ..plan.dm_plan import DMPlan
+
+    os.makedirs(data_dir, exist_ok=True)
+    tsamp, fch1, foff = 0.000256, 1400.0, -16.0
+    plan = DMPlan.create(
+        nsamps=nsamps, nchans=nchans, tsamp=tsamp, fch1=fch1, foff=foff,
+        dm_start=0.0, dm_end=20.0, pulse_width=64.0, tol=1.10,
+    )
+    delays = plan.delay_samples()[plan.ndm // 2]
+    paths = []
+    for i in range(n_obs):
+        rng = np.random.default_rng(100 + i)
+        data = rng.normal(32.0, 4.0, size=(nsamps, nchans))
+        s0 = 1200 + 400 * i
+        for c in range(nchans):
+            data[s0 + delays[c] : s0 + 4 + delays[c], c] += 15.0
+        hdr = SigprocHeader(
+            source_name=f"CHAOS{i}", tsamp=tsamp, tstart=55000.0 + i,
+            fch1=fch1, foff=foff, nchans=nchans, nbits=8, nifs=1,
+            data_type=1,
+        )
+        path = os.path.join(data_dir, f"obs{i}.fil")
+        write_filterbank(
+            path,
+            Filterbank(
+                header=hdr,
+                data=np.clip(np.rint(data), 0, 255).astype(np.uint8),
+            ),
+        )
+        paths.append(path)
+    return paths
+
+
+# --------------------------------------------------------------------------
+# campaign soak
+# --------------------------------------------------------------------------
+
+def _run_campaign(
+    root: str,
+    inputs: list[str],
+    config: dict,
+    lease_s: float,
+    max_attempts: int,
+) -> dict:
+    """Drain one campaign in-process, surviving injected worker kills
+    the way a fleet does: each kill abandons the claim (never released
+    — WorkerKilled models SIGKILL), waits out the lease, and a
+    replacement worker joins and reaps."""
+    from ..campaign.queue import Job, JobQueue, job_id_for
+    from ..campaign.runner import (
+        CampaignConfig,
+        CampaignRunner,
+        bucket_for_input,
+        save_campaign_config,
+    )
+    from ..campaign.rollup import write_status
+    from ..resilience import WorkerKilled
+
+    os.makedirs(root, exist_ok=True)
+    cfg = CampaignConfig(
+        pipeline="spsearch",
+        config=config,
+        lease_s=lease_s,
+        max_attempts=max_attempts,
+        backoff_base_s=0.05,
+        heartbeat_interval=0.2,
+        warmup=False,  # soak speed: compile once via the jit caches
+        tune=False,
+    )
+    save_campaign_config(root, cfg)
+    queue = JobQueue(
+        root, lease_s=lease_s, max_attempts=max_attempts,
+        backoff_base_s=0.05,
+    )
+    for p in inputs:
+        queue.add_job(
+            Job(
+                job_id=job_id_for(p), input=p, pipeline="spsearch",
+                bucket=bucket_for_input(p),
+            )
+        )
+    kills = 0
+    tally = {"done": 0, "failed": 0, "quarantined": 0}
+    worker = 0
+    t0 = time.perf_counter()
+    while True:
+        runner = CampaignRunner(root, worker_id=f"chaos-w{worker}")
+        try:
+            t = runner.run(poll_s=0.05)
+            for k in tally:
+                tally[k] += t.get(k, 0)
+            break  # drained
+        except WorkerKilled as exc:
+            kills += 1
+            worker += 1
+            log.warning(
+                "worker chaos-w%d killed (%s); lease will expire and a "
+                "replacement joins", worker - 1, exc,
+            )
+            # a SIGKILLed worker's claim outlives it by the lease
+            time.sleep(lease_s + 0.25)
+    write_status(root, queue)
+    return {
+        "tally": tally,
+        "workers_killed": kills,
+        "workers_used": worker + 1,
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }
+
+
+def _job_candidate_bytes(root: str, job_id: str) -> bytes | None:
+    path = os.path.join(root, "jobs", job_id, "candidates.singlepulse")
+    try:
+        with open(path, "rb") as f:
+            return f.read()
+    except OSError:
+        return None
+
+
+def _tree_residue(root: str) -> list[str]:
+    """Leaked atomic-write temps / reap tombstones / claim files."""
+    bad = []
+    for pat in ("**/*.tmp", "**/*.reap.*", "**/*.ckpt.tmp"):
+        bad.extend(glob.glob(os.path.join(root, pat), recursive=True))
+    bad.extend(glob.glob(os.path.join(root, "queue", "claims", "*.json")))
+    return sorted(bad)
+
+
+def run_campaign_soak(
+    workdir: str,
+    faults_spec: str,
+    seed: int,
+    n_obs: int = 3,
+    nsamps: int = 1 << 12,
+    max_attempts: int = 3,
+    lease_s: float = 1.0,
+    config: dict | None = None,
+) -> dict:
+    """Reference campaign (fault-free) + chaos campaign (seeded
+    schedule) over the same observations; returns the report section
+    with a ``violations`` list (empty = survived)."""
+    from ..campaign.queue import JobQueue, job_id_for
+    from ..campaign.rollup import load_campaign_status
+    from ..obs.schema import validate_manifest
+    from ..resilience import STATS, faults
+    from ..resilience.faults import parse_faults
+
+    plan = parse_faults(faults_spec, seed)
+    unknown = set(plan.rules) - TRANSIENT_SITES
+    if unknown:
+        raise ValueError(f"non-transient fault sites: {sorted(unknown)}")
+
+    config = config or {"dm_end": 20.0, "min_snr": 7.0, "n_widths": 6}
+    data_dir = os.path.join(workdir, "data")
+    inputs = make_observations(data_dir, n_obs=n_obs, nsamps=nsamps)
+    job_ids = [job_id_for(p) for p in inputs]
+
+    # --- reference: the ground truth this soak judges against --------
+    faults.configure(None)
+    STATS.reset()
+    ref_root = os.path.join(workdir, "ref")
+    log.info("chaos soak: fault-free reference campaign (%d obs)", n_obs)
+    ref = _run_campaign(ref_root, inputs, config, lease_s, max_attempts)
+    ref_cands = {j: _job_candidate_bytes(ref_root, j) for j in job_ids}
+    if ref["tally"]["done"] != n_obs or any(
+        v is None for v in ref_cands.values()
+    ):
+        raise RuntimeError(
+            f"reference campaign did not complete cleanly: {ref}"
+        )
+
+    # --- chaos: same inputs, seeded schedule --------------------------
+    STATS.reset()
+    active = faults.configure(faults_spec, seed)
+    chaos_root = os.path.join(workdir, "chaos")
+    log.info(
+        "chaos soak: campaign under schedule %r (seed %d)",
+        faults_spec, seed,
+    )
+    try:
+        chaos = _run_campaign(
+            chaos_root, inputs, config, lease_s, max_attempts
+        )
+    finally:
+        faults.configure(None)
+    stats = STATS.snapshot()
+    injection_log = active.to_doc() if active else {}
+
+    # --- invariants ---------------------------------------------------
+    violations: list[str] = []
+    queue = JobQueue(chaos_root)
+    counts = queue.counts()
+
+    # exactly-once: every job terminal, none lost, none in two states
+    if counts["total"] != n_obs:
+        violations.append(
+            f"jobs lost or duplicated: {counts['total']}/{n_obs} records"
+        )
+    if counts["done"] + counts["quarantined"] != counts["total"]:
+        violations.append(f"campaign not drained exactly-once: {counts}")
+    for j in job_ids:
+        d = os.path.exists(
+            os.path.join(chaos_root, "queue", "done", f"{j}.json")
+        )
+        q = os.path.exists(
+            os.path.join(chaos_root, "queue", "quarantine", f"{j}.json")
+        )
+        if d == q:  # both (double-terminal) or neither (lost)
+            violations.append(
+                f"job {j}: done={d} quarantined={q} (must be exactly one)"
+            )
+
+    # transient-only schedule: zero quarantine, bitwise-equal products
+    if counts["quarantined"]:
+        violations.append(
+            f"{counts['quarantined']} job(s) quarantined under a "
+            "transient-only schedule"
+        )
+    for j in job_ids:
+        got = _job_candidate_bytes(chaos_root, j)
+        if got is None:
+            violations.append(f"job {j}: no candidate file after soak")
+        elif got != ref_cands[j]:
+            violations.append(
+                f"job {j}: candidates differ from the fault-free run"
+            )
+
+    # clean tree
+    residue = _tree_residue(chaos_root)
+    if residue:
+        violations.append(f"leaked files: {residue[:8]}")
+
+    # valid telemetry + rollup with the resilience section
+    for j in job_ids:
+        man_path = os.path.join(chaos_root, "jobs", j, "telemetry.json")
+        try:
+            with open(man_path) as f:
+                validate_manifest(json.load(f))
+        except Exception as exc:
+            violations.append(
+                f"job {j}: telemetry manifest invalid: {exc!s:.200}"
+            )
+    try:
+        rollup = load_campaign_status(
+            os.path.join(chaos_root, "campaign_status.json")
+        )
+        if "resilience" not in rollup:
+            violations.append("rollup lacks the resilience section")
+    except Exception as exc:
+        violations.append(f"campaign rollup unreadable: {exc!s:.200}")
+
+    # bounded retries: policy budget x injections per site
+    from ..resilience.policy import DB_RETRY, IO_RETRY
+
+    budget = max(IO_RETRY.max_attempts, DB_RETRY.max_attempts)
+    for site, n in stats["retries"].items():
+        injected = stats["faults_injected"].get(site.split(":")[0], 0)
+        if n > budget * max(1, injected):
+            violations.append(
+                f"unbounded retries at {site}: {n} retries for "
+                f"{injected} injection(s) (budget {budget}/each)"
+            )
+
+    # attribution: every fired site left a mark on its recovery path
+    for site, n in stats["faults_injected"].items():
+        tables = RECOVERY_TABLES.get(site, ())
+        if tables and not any(
+            any(k.startswith(site) or site in k for k in stats[t])
+            for t in tables
+        ):
+            violations.append(
+                f"fault {site} fired {n}x but no recovery path "
+                f"({'/'.join(tables)}) recorded handling it"
+            )
+    if "worker.kill" in stats["faults_injected"]:
+        # the reaper is worker.kill's recovery: the killed job must
+        # have consumed extra attempts yet still completed
+        reaped = [
+            d for d in queue.done_records()
+            if int(d.get("attempts", 1)) > 1
+        ]
+        if chaos["workers_killed"] and not reaped:
+            violations.append(
+                "worker.kill fired but no done record shows a reaped "
+                "retry (attempts > 1)"
+            )
+
+    return {
+        "n_obs": n_obs,
+        "faults": faults_spec,
+        "seed": seed,
+        "reference": ref,
+        "chaos": chaos,
+        "queue": counts,
+        "stats": stats,
+        "injections": injection_log,
+        "violations": violations,
+    }
+
+
+# --------------------------------------------------------------------------
+# stream soak
+# --------------------------------------------------------------------------
+
+def _run_stream(outdir: str, fil_path: str) -> dict:
+    from ..io.sigproc import read_filterbank
+    from ..io.stream_source import ReplaySource
+    from ..obs.telemetry import RunTelemetry
+    from ..stream.driver import StreamConfig, StreamingSearch
+
+    os.makedirs(outdir, exist_ok=True)
+    cfg = StreamConfig(
+        outdir=outdir, dm_end=20.0, min_snr=7.0, n_widths=6,
+        chunk_samples=1024, decimate=8, latency_slo_s=30.0,
+        warmup=False,
+    )
+    tel = RunTelemetry()
+    with tel.activate():
+        fil = read_filterbank(fil_path)
+        result = StreamingSearch(cfg).run(
+            ReplaySource(fil, block_samples=512, rate=0.0)
+        )
+        tel.write(os.path.join(outdir, "telemetry.json"))
+    return {
+        "triggers": [
+            (int(c.dm_idx), int(c.sample), int(c.width), float(c.snr))
+            for c in result.candidates
+        ],
+        "n_chunks": result.n_chunks,
+        "drops": result.drops,
+        "jit_programs_steady": result.jit_programs_steady,
+        "events": tel.events,
+    }
+
+
+def run_stream_soak(
+    workdir: str, faults_spec: str, seed: int, nsamps: int = 1 << 12
+) -> dict:
+    """Replay the same recording fault-free and under the schedule;
+    the stream must emit identical triggers with zero drops."""
+    from ..resilience import STATS, faults
+    from ..resilience.faults import parse_faults
+
+    plan = parse_faults(faults_spec, seed)
+    unknown = set(plan.rules) - {"fil.read"}
+    if unknown:
+        raise ValueError(
+            f"stream soak drills fil.read only, got: {sorted(unknown)}"
+        )
+    [fil_path] = make_observations(
+        os.path.join(workdir, "stream_data"), n_obs=1, nsamps=nsamps
+    )
+    faults.configure(None)
+    STATS.reset()
+    ref = _run_stream(os.path.join(workdir, "stream_ref"), fil_path)
+    STATS.reset()
+    active = faults.configure(faults_spec, seed)
+    try:
+        chaos = _run_stream(
+            os.path.join(workdir, "stream_chaos"), fil_path
+        )
+    finally:
+        faults.configure(None)
+    stats = STATS.snapshot()
+
+    violations: list[str] = []
+    if not ref["triggers"]:
+        raise RuntimeError("reference stream produced no triggers")
+    if chaos["triggers"] != ref["triggers"]:
+        violations.append(
+            f"stream triggers differ: {len(chaos['triggers'])} vs "
+            f"{len(ref['triggers'])} reference"
+        )
+    if chaos["drops"].get("blocks") or chaos["drops"].get("gap_samples"):
+        violations.append(f"stream dropped data: {chaos['drops']}")
+    if chaos["jit_programs_steady"]:
+        violations.append(
+            f"{chaos['jit_programs_steady']} steady-state recompile(s) "
+            "under faults"
+        )
+    injected = stats["faults_injected"].get("fil.read", 0)
+    if injected and not (
+        stats["retries"].get("fil.read") or stats["recoveries"].get("fil.read")
+    ):
+        violations.append(
+            "fil.read fired on the stream but no retry/recovery "
+            "recorded handling it"
+        )
+    kinds = {e["kind"] for e in chaos["events"]}
+    if injected and "fault_injected" not in kinds:
+        violations.append(
+            "injections happened without fault_injected telemetry"
+        )
+    return {
+        "faults": faults_spec,
+        "seed": seed,
+        "reference": {k: ref[k] for k in ("n_chunks", "drops")},
+        "chaos": {k: chaos[k] for k in ("n_chunks", "drops")},
+        "n_triggers": len(ref["triggers"]),
+        "stats": stats,
+        "injections": active.to_doc() if active else {},
+        "violations": violations,
+    }
+
+
+# --------------------------------------------------------------------------
+# CLI
+# --------------------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="peasoup-chaos",
+        description="Chaos soak: run campaign/stream workloads under a "
+        "seeded fault schedule and assert the survival invariants "
+        "(exactly-once, bitwise-equal candidates, clean tree, valid "
+        "telemetry, bounded + attributed recovery).",
+    )
+    p.add_argument(
+        "--mode", choices=("campaign", "stream", "both"), default="both",
+    )
+    p.add_argument(
+        "--faults", default=None,
+        help="fault schedule (resilience/faults.py grammar); default: "
+        f"campaign {DEFAULT_CAMPAIGN_FAULTS!r}, "
+        f"stream {DEFAULT_STREAM_FAULTS!r}",
+    )
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument(
+        "-o", "--workdir", default=None,
+        help="soak directory (default: a fresh temp dir)",
+    )
+    p.add_argument("--n-obs", type=int, default=3)
+    p.add_argument(
+        "--nsamps", type=int, default=1 << 12,
+        help="samples per synthetic observation",
+    )
+    p.add_argument(
+        "--lease", type=float, default=1.0,
+        help="campaign claim lease seconds (kill recovery waits it out)",
+    )
+    p.add_argument(
+        "--report", default=None,
+        help="chaos_report.json path (default: <workdir>/chaos_report.json)",
+    )
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    workdir = args.workdir or tempfile.mkdtemp(prefix="peasoup-chaos-")
+    os.makedirs(workdir, exist_ok=True)
+    report: dict = {
+        "schema": REPORT_SCHEMA,
+        "version": REPORT_VERSION,
+        "workdir": os.path.abspath(workdir),
+        "mode": args.mode,
+    }
+    try:
+        violations: list[str] = []
+        if args.mode in ("campaign", "both"):
+            sec = run_campaign_soak(
+                workdir,
+                args.faults or DEFAULT_CAMPAIGN_FAULTS,
+                args.seed,
+                n_obs=args.n_obs,
+                nsamps=args.nsamps,
+                lease_s=args.lease,
+            )
+            report["campaign"] = sec
+            violations += [f"campaign: {v}" for v in sec["violations"]]
+        if args.mode in ("stream", "both"):
+            sec = run_stream_soak(
+                workdir,
+                args.faults if args.mode == "stream" and args.faults
+                else DEFAULT_STREAM_FAULTS,
+                args.seed,
+                nsamps=args.nsamps,
+            )
+            report["stream"] = sec
+            violations += [f"stream: {v}" for v in sec["violations"]]
+        report["violations"] = violations
+        report["ok"] = not violations
+    except Exception as exc:
+        import traceback
+
+        traceback.print_exc()
+        report["ok"] = False
+        report["error"] = f"{type(exc).__name__}: {exc!s:.500}"
+        _write_report(report, args)
+        print("peasoup-chaos: internal error (exit 2)", file=sys.stderr)
+        return 2
+    _write_report(report, args)
+    if report["ok"]:
+        print(
+            f"peasoup-chaos: SURVIVED ({args.mode}; "
+            f"workdir {workdir})"
+        )
+        return 0
+    print("peasoup-chaos: INVARIANT VIOLATIONS:", file=sys.stderr)
+    for v in violations:
+        print(f"  - {v}", file=sys.stderr)
+    return 1
+
+
+def _write_report(report: dict, args) -> None:
+    path = args.report or os.path.join(
+        report["workdir"], "chaos_report.json"
+    )
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2, default=str)
+        f.write("\n")
+    print(f"peasoup-chaos: report -> {path}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -91,6 +91,50 @@ def render_streaming(sec: dict) -> list[str]:
     return lines
 
 
+def render_resilience(sec: dict) -> list[str]:
+    """Lines for a status snapshot's ``resilience`` section (written by
+    peasoup_tpu/resilience/stats.py): only what differs from a clean
+    run is shown, so a healthy process renders nothing."""
+    lines = []
+
+    def _total(table: str) -> int:
+        return sum((sec.get(table) or {}).values())
+
+    bits = []
+    for table, label in (
+        ("retries", "retries"),
+        ("recoveries", "recovered"),
+        ("degradations", "degradations"),
+        ("corrupt_artifacts", "quarantined artifacts"),
+    ):
+        n = _total(table)
+        if n:
+            bits.append(f"{label}={n}")
+    faults = sec.get("faults_injected") or {}
+    if faults:
+        bits.append(
+            "faults injected: "
+            + " ".join(f"{k}x{v}" for k, v in sorted(faults.items()))
+        )
+    if bits:
+        lines.append("  resilience: " + "  ".join(bits))
+    crashes = sec.get("thread_crashes") or {}
+    if crashes:
+        lines.append(
+            "  *** DEGRADED: background thread crash(es): "
+            + " ".join(f"{k}x{v}" for k, v in sorted(crashes.items()))
+            + " ***"
+        )
+    giveups = sec.get("giveups") or {}
+    if giveups:
+        lines.append(
+            "  *** retry budget exhausted at: "
+            + " ".join(f"{k}x{v}" for k, v in sorted(giveups.items()))
+            + " ***"
+        )
+    return lines
+
+
 def render_status(st: dict, stale_after: float = 0.0) -> str:
     """One compact text block for a status snapshot."""
     prog = st.get("progress") or {}
@@ -125,6 +169,8 @@ def render_status(st: dict, stale_after: float = 0.0) -> str:
         lines.append(f"  device memory high-water: {mem / 1e9:.2f} GB")
     if isinstance(st.get("streaming"), dict):
         lines.extend(render_streaming(st["streaming"]))
+    if isinstance(st.get("resilience"), dict):
+        lines.extend(render_resilience(st["resilience"]))
     if st.get("stalled"):
         lines.append(
             f"  *** STALLED: no progress for "
@@ -197,6 +243,8 @@ def render_campaign_status(st: dict, stale_after: float = 0.0) -> str:
                 + f" block={plan.get('dedisp_block', '?')} "
                 f"[{plan.get('source', '?')}]"
             )
+    if isinstance(st.get("resilience"), dict) and st["resilience"]:
+        lines.extend(render_resilience(st["resilience"]))
     for rj in st.get("running_jobs") or []:
         prog = rj.get("progress") or {}
         frac = prog.get("frac")

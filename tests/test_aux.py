@@ -104,6 +104,10 @@ def test_checkpoint_missing_and_corrupt(tmp_path):
     bad = tmp_path / "bad.npz"
     bad.write_bytes(b"not an npz at all")
     assert SearchCheckpoint(str(bad), "k").load() == {}
+    # unified resilience semantics: the damaged store is quarantined
+    # (renamed, never deleted), so the torn bytes survive for forensics
+    assert not bad.exists()
+    assert (tmp_path / "bad.npz.corrupt").read_bytes() == b"not an npz at all"
 
 
 def test_checkpoint_atomic_no_tmp_left(tmp_path):

@@ -1,0 +1,100 @@
+"""Chaos-soak harness tests: the end-to-end survival contract under a
+seeded compound fault schedule (flaky reads + sqlite contention + a
+worker kill), the stream replay drill, and the report/CLI plumbing.
+These are the acceptance tests for the composition of every recovery
+path — the unit drills live in test_resilience.py.
+"""
+
+import json
+import os
+
+import pytest
+
+from peasoup_tpu.resilience import faults
+from peasoup_tpu.resilience.stats import STATS
+from peasoup_tpu.tools import chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.configure(None)
+    STATS.reset()
+    yield
+    faults.configure(None)
+    STATS.reset()
+
+
+class TestCampaignSoak:
+    def test_compound_schedule_survives(self, tmp_path):
+        """The acceptance schedule: flaky reads + one sqlite lock +
+        one worker kill over a 3-obs campaign. Every invariant must
+        hold: exactly-once, bitwise-equal candidates, clean tree,
+        valid telemetry, bounded + attributed recovery."""
+        sec = chaos.run_campaign_soak(
+            str(tmp_path),
+            "fil.read:p=0.25:n=4,db.ingest:at=1,worker.kill:at=obs0",
+            seed=7,
+            n_obs=3,
+            lease_s=0.8,
+        )
+        assert sec["violations"] == []
+        assert sec["queue"]["done"] == 3
+        assert sec["queue"]["quarantined"] == 0
+        assert sec["chaos"]["workers_killed"] == 1
+        inj = {r["site"] for r in sec["injections"]["injected"]}
+        assert "worker.kill" in inj
+        # attribution: each fired transient site shows recovery marks
+        stats = sec["stats"]
+        for site in inj & {"fil.read", "db.ingest"}:
+            assert stats["retries"].get(site) or stats[
+                "recoveries"
+            ].get(site), (site, stats)
+        # the kill's recovery is the reaper: the killed job re-ran
+        from peasoup_tpu.campaign.queue import JobQueue
+
+        done = JobQueue(os.path.join(tmp_path, "chaos")).done_records()
+        assert any(int(d.get("attempts", 1)) > 1 for d in done)
+        # rollup carries the aggregated per-job resilience deltas
+        from peasoup_tpu.campaign.rollup import load_campaign_status
+
+        st = load_campaign_status(
+            os.path.join(tmp_path, "chaos", "campaign_status.json")
+        )
+        assert "resilience" in st
+
+    def test_rejects_non_transient_schedule(self, tmp_path):
+        with pytest.raises(ValueError, match="unknown fault site"):
+            chaos.run_campaign_soak(str(tmp_path), "bogus.site:n=1", 1)
+
+
+class TestStreamSoak:
+    def test_replay_faults_reproduce_triggers(self, tmp_path):
+        sec = chaos.run_stream_soak(
+            str(tmp_path), "fil.read:at=replay:n=2", seed=7
+        )
+        assert sec["violations"] == []
+        assert sec["n_triggers"] >= 1
+        assert sec["stats"]["faults_injected"]["fil.read"] == 2
+        assert sec["stats"]["recoveries"].get("fil.read", 0) >= 1
+
+    def test_rejects_non_stream_sites(self, tmp_path):
+        with pytest.raises(ValueError, match="fil.read only"):
+            chaos.run_stream_soak(str(tmp_path), "worker.kill", 1)
+
+
+class TestCLI:
+    def test_main_writes_report_and_exits_zero(self, tmp_path, capsys):
+        rc = chaos.main(
+            [
+                "--mode", "stream", "-o", str(tmp_path),
+                "--seed", "7",
+            ]
+        )
+        assert rc == 0
+        with open(tmp_path / "chaos_report.json") as f:
+            report = json.load(f)
+        assert report["schema"] == chaos.REPORT_SCHEMA
+        assert report["ok"] is True
+        assert report["stream"]["violations"] == []
+        out = capsys.readouterr().out
+        assert "SURVIVED" in out

@@ -216,6 +216,9 @@ class TestTuningCache:
             )
         assert p.source in ("tuned", "analytic")  # re-tuned, no crash
         assert any("re-tuning" in r.message for r in caplog.records)
+        # unified resilience semantics: the torn cache is quarantined
+        # (not deleted) before the re-tune persists a fresh one
+        assert os.path.exists(path + ".corrupt")
         # and the rewritten cache is valid again
         tuning.validate_cache(tuning.load_cache(path))
 

@@ -589,6 +589,11 @@ class TestCheckpointCorruption:
             "discarding unreadable checkpoint" in r.message
             for r in caplog.records
         )
+        # unified resilience semantics: quarantined aside, not deleted
+        import os
+
+        assert os.path.exists(base + ".corrupt")
+        assert not os.path.exists(base)
 
         # pure garbage (not even a zip): same contract
         with open(base, "wb") as f:
