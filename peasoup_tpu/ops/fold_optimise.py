@@ -136,14 +136,16 @@ class FoldOptimiser:
         self.templates_im = jnp.asarray(np.imag(templates).astype(np.float32))
 
     def optimise(
-        self, folds: np.ndarray, periods: np.ndarray, tobs: float
+        self, folds: np.ndarray, periods: np.ndarray, tobs
     ) -> list[dict]:
         """Optimise K folded candidates.
 
         Args:
           folds: (K, nints, nbins) fold profiles.
           periods: (K,) trial periods in seconds.
-          tobs: observation length (seconds).
+          tobs: observation length (seconds) — a scalar, or a (K,)
+            array when the batch mixes observations of different
+            lengths (the survey folder's cross-observation batches).
 
         Returns one dict per candidate: opt_sn, opt_period, opt_width,
         opt_bin, opt_fold (nints, nbins), opt_prof (nbins,).
@@ -163,13 +165,16 @@ class FoldOptimiser:
         opt_shift = np.asarray(opt_shift)
         opt_subs = np.asarray(opt_subs)
         opt_prof = np.asarray(opt_prof)
+        tobs_k = np.broadcast_to(
+            np.asarray(tobs, dtype=np.float64), (folds.shape[0],)
+        )
         results = []
         for k in range(folds.shape[0]):
             sn1, sn2 = calculate_sn(
                 opt_prof[k], int(opt_bin[k]), int(opt_template[k]), self.nbins
             )
             p = float(periods[k])
-            opt_period = p * (((32.0 - float(opt_shift[k])) * p) / (self.nbins * tobs) + 1.0)
+            opt_period = p * (((32.0 - float(opt_shift[k])) * p) / (self.nbins * float(tobs_k[k])) + 1.0)
             results.append(
                 dict(
                     opt_sn=max(sn1, sn2),

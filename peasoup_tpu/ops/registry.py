@@ -44,6 +44,7 @@ _PROGRAM_MODULES = (
     "peasoup_tpu.ops.peaks",
     "peasoup_tpu.ops.fold",
     "peasoup_tpu.ops.fold_optimise",
+    "peasoup_tpu.ops.survey_fold",
     "peasoup_tpu.ops.singlepulse",
     "peasoup_tpu.ops.streaming",
     "peasoup_tpu.ops.ffa",
@@ -104,6 +105,13 @@ class ShapeCtx:
     accel_pad: int = 0  # padded accel-trial columns per DM row
     max_peaks: int = 128
     select_smax: int = 0  # gather-free resample span (0 = gather path)
+    # survey-fold geometry (peasoup_tpu/sift/fold.py): candidates per
+    # fixed batch and the bucket's power-of-two series length; 0 = not
+    # a fold ctx, so the survey_fold hook declines it
+    fold_batch: int = 0
+    fold_nsamps: int = 0
+    fold_nbins: int = 64
+    fold_nints: int = 16
 
 
 @dataclass(frozen=True)

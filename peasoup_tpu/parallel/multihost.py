@@ -272,6 +272,40 @@ def run_single_pulse_search(fil, config):
     return search.finalize(fil, merged)
 
 
+def run_survey_fold(observations, folder) -> list[dict]:
+    """Multi-host survey folding (peasoup_tpu/sift/fold.py):
+    observation-level data parallelism. Observations are dealt
+    round-robin to processes (coarse but deterministic balancing — the
+    fold cost of an observation scales with its candidate count, which
+    round-robin spreads), each process batch-folds its share on LOCAL
+    chips, and the outcome dicts are allgathered over DCN in process
+    order so every process returns the identical full outcome list.
+
+    Single-process: exactly ``folder.fold_outcomes(observations)``.
+    """
+    import pickle
+
+    initialize()
+    nproc = jax.process_count()
+    if nproc == 1:
+        return folder.fold_outcomes(observations)
+    rank = jax.process_index()
+    mine = observations[rank::nproc]
+    log.info(
+        "multi-host survey fold: process %d/%d folds %d of %d "
+        "observations", rank, nproc, len(mine), len(observations),
+    )
+    current_telemetry().event(
+        "multihost_fold", processes=nproc, process=rank,
+        observations=len(mine), total=len(observations),
+    )
+    outcomes = folder.fold_outcomes(mine)
+    merged: list[dict] = []
+    for blob in _allgather_pickled(pickle.dumps(outcomes)):
+        merged.extend(pickle.loads(blob))
+    return merged
+
+
 def process_local_slice(mesh: Mesh, axis: str) -> tuple[int, int]:
     """The [start, stop) block of ``axis`` whose shards live on THIS
     process — the host-side work partition for feeding per-process

@@ -156,7 +156,105 @@ def cache_lookup(doc: dict, fingerprint: str, key: str) -> dict | None:
 
 
 def cache_store(doc: dict, fingerprint: str, key: str, plan_doc: dict):
+    # stamp the entry so `peasoup-perf tune --list/--prune` can report
+    # ages and age-prune (entries written before the stamp existed
+    # read as infinitely old)
+    stored_unix = time.time()
+    plan_doc = dict(plan_doc, stored_unix=round(stored_unix, 3))
     doc.setdefault("devices", {}).setdefault(fingerprint, {})[key] = plan_doc
+
+
+# --------------------------------------------------------------------------
+# cache hygiene: list entries with age, prune stale fingerprints
+# --------------------------------------------------------------------------
+
+def list_entries(cache_path: str | None = None) -> list[dict]:
+    """One row per cached plan: fingerprint, bucket key, the shape
+    knobs, age since it was stored, and whether the fingerprint is
+    stale (not THIS device — a laptop cache full of pod-slice entries,
+    or vice versa)."""
+    path = cache_path or default_cache_path()
+    doc = load_cache(path)
+    now = time.time()
+    fp_now = device_fingerprint()
+    rows = []
+    for fp, entries in sorted((doc.get("devices") or {}).items()):
+        for key, plan_doc in sorted(entries.items()):
+            stored = plan_doc.get("stored_unix")
+            rows.append(
+                {
+                    "fingerprint": fp,
+                    "key": key,
+                    "engine": plan_doc.get("engine"),
+                    "source": plan_doc.get("source"),
+                    "dedisp_block": plan_doc.get("dedisp_block"),
+                    "subbands": plan_doc.get("subbands"),
+                    "stored_unix": stored,
+                    "age_s": (
+                        None if stored is None
+                        else round(max(0.0, now - float(stored)), 3)
+                    ),
+                    "stale": fp != fp_now,
+                }
+            )
+    return rows
+
+
+def prune_cache(
+    cache_path: str | None = None,
+    *,
+    older_than_s: float | None = None,
+    keep_stale: bool = False,
+    dry_run: bool = False,
+) -> list[dict]:
+    """Remove dead weight from the tuning cache; returns the removed
+    rows (as :func:`list_entries` shapes them).
+
+    Pruned: entries under a stale device fingerprint (unless
+    ``keep_stale``), and — when ``older_than_s`` is given — entries on
+    ANY fingerprint older than that (un-stamped legacy entries count
+    as infinitely old). ``dry_run`` reports without rewriting."""
+    path = cache_path or default_cache_path()
+    doc = load_cache(path)
+    now = time.time()
+    fp_now = device_fingerprint()
+    removed = []
+    devices = doc.get("devices") or {}
+    for fp in list(devices):
+        for key in list(devices[fp]):
+            plan_doc = devices[fp][key]
+            stored = plan_doc.get("stored_unix")
+            age = None if stored is None else now - float(stored)
+            stale = fp != fp_now
+            too_old = older_than_s is not None and (
+                age is None or age > older_than_s
+            )
+            if (stale and not keep_stale) or too_old:
+                removed.append(
+                    {
+                        "fingerprint": fp, "key": key,
+                        "engine": plan_doc.get("engine"),
+                        "source": plan_doc.get("source"),
+                        "dedisp_block": plan_doc.get("dedisp_block"),
+                        "subbands": plan_doc.get("subbands"),
+                        "stored_unix": stored,
+                        "age_s": (
+                            None if age is None else round(age, 3)
+                        ),
+                        "stale": stale,
+                    }
+                )
+                if not dry_run:
+                    del devices[fp][key]
+        if not dry_run and fp in devices and not devices[fp]:
+            del devices[fp]
+    if removed and not dry_run:
+        save_cache(path, doc)
+        log.info(
+            "pruned %d tuning-cache entr%s from %s",
+            len(removed), "y" if len(removed) == 1 else "ies", path,
+        )
+    return removed
 
 
 # --------------------------------------------------------------------------

@@ -34,6 +34,30 @@ def _deredden_tim(tim: jax.Array, *, size: int, pos5: int, pos25: int) -> jax.Ar
     return jnp.fft.irfft(fser, n=size) * size
 
 
+def fold_geometry(
+    trials_nsamps: int,
+    tsamp: float,
+    pos5_freq: float = 0.05,
+    pos25_freq: float = 0.5,
+) -> tuple[int, float, float, int, int]:
+    """(size, tsamp_f32, tobs, pos5, pos25) for one observation's fold.
+
+    The reference's quirky constants in one place — the power-of-two
+    truncation, the f32 tsamp/tobs roundings, the whitening band edges
+    — shared by :class:`MultiFolder` and the survey folder
+    (peasoup_tpu/sift/fold.py) so the two paths provably derive the
+    same per-candidate geometry (their outputs are pinned bitwise-equal
+    in tests/test_sift.py)."""
+    size = prev_power_of_two(trials_nsamps)
+    tsamp32 = float(np.float32(tsamp))
+    tobs = float(np.float32(size) * np.float32(tsamp))
+    bin_width = 1.0 / (size * tsamp32)
+    return (
+        size, tsamp32, tobs,
+        int(pos5_freq / bin_width), int(pos25_freq / bin_width),
+    )
+
+
 class MultiFolder:
     min_period = 1e-3
     max_period = 10.0
@@ -55,21 +79,19 @@ class MultiFolder:
     ):
         self.trials = trials
         self.dm_offset = dm_offset
-        self.nsamps = prev_power_of_two(trials_nsamps)
         # the reference folds with the f32 tsamp member
         # (timeseries.hpp:54; double tsamp_by_period = tsamp/period in
         # kernels.cu:641 sees the f32-rounded value) — the fold's
         # phase-bin assignment is sensitive to this at the 1e-8 level,
         # which flips ~0.06% of samples into adjacent bins over a 2^17
-        # series
-        self.tsamp = float(np.float32(tsamp))
-        # float tobs = nsamps*tsamp (folder.hpp:358: uint*float in f32)
-        self.tobs = float(np.float32(self.nsamps) * np.float32(tsamp))
+        # series; tobs = nsamps*tsamp is a uint*float f32 product
+        # (folder.hpp:358). All derived in fold_geometry, shared with
+        # the survey folder.
+        (
+            self.nsamps, self.tsamp, self.tobs, self.pos5, self.pos25
+        ) = fold_geometry(trials_nsamps, tsamp, pos5_freq, pos25_freq)
         self.nbins = nbins
         self.nints = nints
-        bin_width = 1.0 / (self.nsamps * self.tsamp)
-        self.pos5 = int(pos5_freq / bin_width)
-        self.pos25 = int(pos25_freq / bin_width)
         self.optimiser = FoldOptimiser(nbins, nints)
 
     def fold_n(self, cands: List[Candidate], n: int) -> List[Candidate]:

@@ -1,0 +1,25 @@
+"""peasoup-sift: survey-scale batched folding + candidate sifting.
+
+The post-campaign layer that turns the campaign candidate database
+(peasoup_tpu/campaign/db.py) into the product a survey team consumes
+(the GSP/CRAFTS model, arXiv:2110.12749, with PulsarX-style bulk
+folding, arXiv:2309.02544):
+
+- :mod:`~peasoup_tpu.sift.fold` — shape-bucketed batched folding of
+  every DB candidate across observations through ONE compiled program
+  per bucket (:mod:`peasoup_tpu.ops.survey_fold`).
+- :mod:`~peasoup_tpu.sift.crossmatch` — known-pulsar ephemeris
+  cross-match with harmonic/sub-harmonic ladders.
+- :mod:`~peasoup_tpu.sift.dedup` — campaign-level harmonic/DM dedup
+  across observations + multi-beam coincidence vetoing.
+- :mod:`~peasoup_tpu.sift.repeats` — repeat single-pulse association
+  and RRAT period inference from TOA-difference GCD fitting.
+- :mod:`~peasoup_tpu.sift.service` — the ``peasoup-sift run``
+  orchestration writing the ``sift_*`` tables.
+- :mod:`~peasoup_tpu.sift.report` — the self-contained HTML survey
+  report rendered from DB + campaign rollup.
+"""
+
+from .service import SiftConfig, SiftRun
+
+__all__ = ["SiftConfig", "SiftRun"]

@@ -1,0 +1,431 @@
+"""The ``peasoup-sift run`` orchestration.
+
+One run consumes the campaign candidate database end to end:
+
+  load -> batch-fold (ops/survey_fold via sift/fold) -> known-pulsar
+  cross-match -> multi-beam coincidence veto -> campaign-level
+  harmonic/DM dedup -> repeat single-pulse association -> one
+  transaction writing the ``sift_*`` tables.
+
+The run is wired into the full observability + resilience stacks: a
+``sift`` status section (heartbeat/status.json + telemetry manifest),
+stage transitions and per-pass events/timers, filterbank reads through
+``IO_RETRY``, every DB transaction through ``DB_RETRY`` with the
+``db.ingest`` fault seam, and the fold pass degrading (batch shrink)
+under ``device.oom``. Re-running replaces the previous sifted product
+wholesale (latest run wins), so the sift is an idempotent post-pass a
+survey team can repeat as observations keep arriving.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+import uuid
+
+import numpy as np
+
+from ..campaign.db import DB_FILENAME, CandidateDB
+from ..obs import get_logger
+from ..obs.telemetry import current as current_telemetry
+from .crossmatch import load_catalogue, match_candidate
+from .dedup import dedup_candidates, multibeam_veto
+from .fold import FoldCandidate, FoldObservation, SurveyFolder
+from .repeats import repeat_sources
+
+log = get_logger("sift.service")
+
+
+@dataclasses.dataclass
+class SiftConfig:
+    """Knobs for one sift run (persisted in the ``sift_runs`` row)."""
+
+    workdir: str = "."  # campaign root (holds candidates.sqlite)
+    db_path: str = ""  # explicit DB override
+    # batched survey folding
+    fold: bool = True
+    fold_batch: int = 64  # candidates per fixed device batch
+    fold_nbins: int = 64
+    fold_nints: int = 16
+    max_fold_per_obs: int = 256  # top-N by S/N folded per observation
+    fold_snr_min: float = 6.0  # folded S/N confirming a candidate
+    # adopt the optimiser's refined period only when the observation
+    # spans at least this many pulses — the phase-shift period update
+    # is meaningless when the fold holds a handful of rotations
+    opt_period_min_pulses: float = 16.0
+    # known-pulsar cross-match
+    catalogue: str = ""  # "" = the checked-in convenience catalogue
+    max_harm: int = 16
+    period_tol: float = 2e-3
+    dm_tol: float = 2.0
+    dm_tol_frac: float = 0.05
+    # campaign-level dedup
+    dedup_max_harm: int = 8
+    dedup_period_tol: float = 2e-3
+    dedup_dm_tol: float = 2.0
+    # multi-beam coincidence veto
+    beam_thresh: int = 4
+    coinc_snr: float = 6.0
+    # repeat single-pulse association
+    sp_dm_tol: float = 1.0
+    sp_min_pulses: int = 3
+    sp_min_obs: int = 2
+    sp_min_period: float = 0.05
+    sp_max_harm: int = 1000
+    sp_phase_tol: float = 0.02
+
+    def resolved_db(self) -> str:
+        return self.db_path or os.path.join(self.workdir, DB_FILENAME)
+
+
+class SiftRun:
+    """One sift pass over a campaign database."""
+
+    def __init__(self, cfg: SiftConfig) -> None:
+        self.cfg = cfg
+        self._progress: dict = {"stage": "idle"}
+
+    # --- the sift status section (status.json + manifest) -------------
+    def status_section(self) -> dict:
+        return dict(self._progress)
+
+    def _mark(self, stage: str, **fields) -> None:
+        self._progress.update({"stage": stage, **fields})
+
+    # --- fold input assembly ------------------------------------------
+    def build_fold_inputs(
+        self, obs_rows: list[dict], cands: list[dict]
+    ) -> list[FoldObservation]:
+        """Re-dedisperse each observation at its candidates' DMs and
+        package the survey folder's inputs. A missing/unreadable input
+        file skips that observation with an event (the sift must
+        survive an archive where raw data has been aged out)."""
+        from ..io.sigproc import read_filterbank
+        from ..ops.dedisperse import dedisperse_device, output_scale
+        from ..plan.dm_plan import delay_table
+
+        tel = current_telemetry()
+        by_job: dict[str, list[dict]] = {}
+        for c in cands:
+            by_job.setdefault(c["job_id"], []).append(c)
+        out: list[FoldObservation] = []
+        for obs in obs_rows:
+            rows = by_job.get(obs["job_id"])
+            if not rows:
+                continue
+            rows = sorted(
+                rows, key=lambda c: -float(c.get("snr") or 0.0)
+            )[: self.cfg.max_fold_per_obs]
+            try:
+                fil = read_filterbank(obs["input"])
+            except Exception as exc:
+                tel.event(
+                    "sift_obs_skipped", job_id=obs["job_id"],
+                    input=obs.get("input"),
+                    error=f"{type(exc).__name__}: {exc!s:.200}",
+                )
+                log.warning(
+                    "skipping %s: cannot read %s (%s)",
+                    obs["job_id"], obs.get("input"), exc,
+                )
+                continue
+            hdr = fil.header
+            # the dedisp-parity delay table at this observation's
+            # geometry; one trial per distinct candidate DM
+            per_unit = np.abs(
+                delay_table(hdr.fch1, hdr.foff, hdr.nchans, hdr.tsamp)
+            )
+            dms = sorted({float(c["dm"]) for c in rows})
+            dm_row = {dm: i for i, dm in enumerate(dms)}
+            prod = (
+                np.asarray(dms, dtype=np.float32)[:, None]
+                * per_unit[None, :]
+            ).astype(np.float32)
+            delays = np.rint(prod).astype(np.int32)
+            max_delay = int(delays.max()) if delays.size else 0
+            out_nsamps = fil.nsamps - max_delay
+            if out_nsamps < 64:
+                tel.event(
+                    "sift_obs_skipped", job_id=obs["job_id"],
+                    error=f"too short after dedispersion "
+                    f"({out_nsamps} samples)",
+                )
+                continue
+            import jax
+
+            trials = np.asarray(
+                jax.device_get(
+                    dedisperse_device(
+                        fil.data, delays,
+                        np.ones(hdr.nchans, dtype=np.float32),
+                        out_nsamps,
+                        scale=output_scale(hdr.nbits, hdr.nchans),
+                    )
+                )
+            )
+            out.append(
+                FoldObservation(
+                    job_id=obs["job_id"],
+                    trials=trials,
+                    trials_nsamps=out_nsamps,
+                    tsamp=float(hdr.tsamp),
+                    cands=[
+                        FoldCandidate(
+                            key=c["id"],
+                            period=float(c["period"]),
+                            acc=float(c.get("acc") or 0.0),
+                            dm_row=dm_row[float(c["dm"])],
+                        )
+                        for c in rows
+                    ],
+                )
+            )
+        return out
+
+    # --- the run -------------------------------------------------------
+    def run(self) -> dict:
+        cfg = self.cfg
+        tel = current_telemetry()
+        tel.set_status_section("sift", self.status_section)
+        t_run = time.perf_counter()
+        db_path = cfg.resolved_db()
+        if not os.path.exists(db_path):
+            raise FileNotFoundError(
+                f"no campaign database at {db_path} (run the campaign "
+                "and `peasoup-campaign ingest` first)"
+            )
+        run_id = uuid.uuid4().hex[:12]
+
+        with CandidateDB(db_path) as db:
+            tel.set_stage("loading")
+            self._mark("loading")
+            obs_rows = db.observations()
+            periodicity = db.all_candidates("periodicity")
+            single_pulse = db.all_candidates("single_pulse")
+            self._mark(
+                "loaded", observations=len(obs_rows),
+                periodicity=len(periodicity),
+                single_pulse=len(single_pulse),
+            )
+
+            # --- batched survey folding --------------------------------
+            outcomes_by_key: dict = {}
+            n_folded = 0
+            if cfg.fold and periodicity:
+                tel.set_stage("folding")
+                self._mark("folding", folded=0)
+                t0 = time.perf_counter()
+                fold_inputs = self.build_fold_inputs(
+                    obs_rows, periodicity
+                )
+                from ..parallel.multihost import run_survey_fold
+
+                folder = SurveyFolder(
+                    nbins=cfg.fold_nbins, nints=cfg.fold_nints,
+                    batch=cfg.fold_batch,
+                )
+                outcomes = run_survey_fold(fold_inputs, folder)
+                outcomes_by_key = {o["key"]: o for o in outcomes}
+                n_folded = len(outcomes)
+                tel.add_timer("sift_folding", time.perf_counter() - t0)
+                tel.event(
+                    "sift_folded", candidates=n_folded,
+                    observations=len(fold_inputs),
+                )
+                self._mark("folded", folded=n_folded)
+
+            # effective parameters post-fold: the optimiser's refined
+            # period and S/N supersede the search's trial values
+            for c in periodicity:
+                o = outcomes_by_key.get(c["id"])
+                c["eff_period"] = float(c["period"] or 0.0)
+                if o is not None:
+                    c["folded_snr"] = float(o["opt_sn"])
+                    c["opt_period"] = float(o["opt_period"])
+                    trial_p = float(c["period"] or 0.0)
+                    if (
+                        trial_p > 0
+                        and o["tobs"]
+                        >= cfg.opt_period_min_pulses * trial_p
+                    ):
+                        c["eff_period"] = float(o["opt_period"])
+
+            # --- known-pulsar cross-match ------------------------------
+            tel.set_stage("crossmatch")
+            self._mark("crossmatch")
+            t0 = time.perf_counter()
+            catalogue = load_catalogue(cfg.catalogue or None)
+            known_matches: list[dict] = []
+            match_by_id: dict = {}
+            for c in periodicity:
+                m = match_candidate(
+                    c["eff_period"], float(c["dm"]), catalogue,
+                    max_harm=cfg.max_harm, period_tol=cfg.period_tol,
+                    dm_tol=cfg.dm_tol, dm_tol_frac=cfg.dm_tol_frac,
+                )
+                if m is not None:
+                    match_by_id[c["id"]] = m
+                    known_matches.append(
+                        dict(m, candidate_id=c["id"], job_id=c["job_id"])
+                    )
+            tel.add_timer("sift_crossmatch", time.perf_counter() - t0)
+            tel.event(
+                "sift_crossmatch", matches=len(known_matches),
+                pulsars=len({m["psr"] for m in known_matches}),
+            )
+            self._mark("crossmatched", known=len(known_matches))
+
+            # --- multi-beam coincidence veto ---------------------------
+            tel.set_stage("coincidence")
+            vetoed = multibeam_veto(
+                [
+                    {
+                        "id": c["id"], "period": c["eff_period"],
+                        "dm": c["dm"], "snr": c["snr"],
+                        "beam": c.get("beam"),
+                    }
+                    for c in periodicity
+                ],
+                snr_thresh=cfg.coinc_snr,
+                beam_thresh=cfg.beam_thresh,
+                period_tol=cfg.dedup_period_tol,
+                dm_cell=cfg.dedup_dm_tol,
+            )
+            tel.event("sift_coincidence", vetoed=len(vetoed))
+
+            # --- campaign-level dedup ----------------------------------
+            tel.set_stage("dedup")
+            self._mark("dedup")
+            t0 = time.perf_counter()
+            groups = dedup_candidates(
+                [
+                    {
+                        "id": c["id"], "job_id": c["job_id"],
+                        "period": c["eff_period"], "dm": c["dm"],
+                        "snr": c["snr"],
+                    }
+                    for c in periodicity
+                ],
+                max_harm=cfg.dedup_max_harm,
+                period_tol=cfg.dedup_period_tol,
+                dm_tol=cfg.dedup_dm_tol,
+            )
+            by_id = {c["id"]: c for c in periodicity}
+            catalogue_rows: list[dict] = []
+            for g in groups:
+                lead = by_id[g["leader"]["id"]]
+                member_matches = [
+                    match_by_id[m["id"]]
+                    for m in g["members"]
+                    if m["id"] in match_by_id
+                ]
+                known = (
+                    min(
+                        member_matches,
+                        key=lambda m: m["period_frac_err"],
+                    )
+                    if member_matches else None
+                )
+                is_rfi = all(
+                    m["id"] in vetoed for m in g["members"]
+                ) and bool(vetoed)
+                folded_snr = float(lead.get("folded_snr") or 0.0)
+                confirmed = folded_snr >= cfg.fold_snr_min
+                if known is not None:
+                    label, tier = "known", 1
+                elif is_rfi:
+                    label, tier = "rfi", 3
+                elif g["n_obs"] >= 2 and confirmed:
+                    label, tier = "candidate", 1
+                elif g["n_obs"] >= 2 or confirmed:
+                    label, tier = "candidate", 2
+                else:
+                    label, tier = "candidate", 3
+                fold_out = outcomes_by_key.get(lead["id"])
+                catalogue_rows.append(
+                    {
+                        "kind": "periodicity",
+                        "label": label,
+                        "tier": tier,
+                        "dm": float(lead["dm"]),
+                        "snr": float(lead["snr"]),
+                        "period": float(lead["eff_period"]),
+                        "folded_snr": folded_snr or None,
+                        "opt_period": lead.get("opt_period"),
+                        "known_source": known["psr"] if known else None,
+                        "harmonic": known["harmonic"] if known else None,
+                        "n_obs": g["n_obs"],
+                        "members": len(g["members"]),
+                        "job_ids": g["job_ids"],
+                        "fold": (
+                            None
+                            if fold_out is None
+                            else {
+                                "prof": [
+                                    round(float(v), 3)
+                                    for v in fold_out["opt_prof"]
+                                ],
+                                "subints": [
+                                    [round(float(v), 3) for v in row]
+                                    for row in fold_out["opt_fold"]
+                                ],
+                            }
+                        ),
+                    }
+                )
+            tel.add_timer("sift_dedup", time.perf_counter() - t0)
+            tel.event(
+                "sift_dedup", groups=len(groups),
+                candidates=len(periodicity),
+            )
+            self._mark("deduped", catalogue=len(catalogue_rows))
+
+            # --- repeat single-pulse association -----------------------
+            tel.set_stage("repeats")
+            t0 = time.perf_counter()
+            sp_sources = repeat_sources(
+                single_pulse,
+                dm_tol=cfg.sp_dm_tol,
+                min_pulses=cfg.sp_min_pulses,
+                min_obs=cfg.sp_min_obs,
+                min_period=cfg.sp_min_period,
+                max_harm=cfg.sp_max_harm,
+                phase_tol=cfg.sp_phase_tol,
+            )
+            for s in sp_sources:
+                s.pop("member_ids", None)
+            tel.add_timer("sift_repeats", time.perf_counter() - t0)
+            tel.event("sift_repeats", sources=len(sp_sources))
+
+            # --- write the sifted product ------------------------------
+            tel.set_stage("ingest")
+            self._mark("ingest")
+            config_doc = dataclasses.asdict(cfg)
+            config_doc["n_folded"] = n_folded
+            tally = db.ingest_sift_run(
+                run_id, config_doc, catalogue_rows, known_matches,
+                sp_sources,
+            )
+            tel.set_stage("done")
+            summary = {
+                "run_id": run_id,
+                "db_path": db_path,
+                "observations": len(obs_rows),
+                "periodicity": len(periodicity),
+                "single_pulse": len(single_pulse),
+                "duration_s": round(time.perf_counter() - t_run, 3),
+                **tally,
+            }
+            self._mark("done", **{
+                k: v for k, v in summary.items() if k != "db_path"
+            })
+            log.info(
+                "sift run %s: %d folded, %d catalogue rows (%d known, "
+                "%d rfi), %d repeat single-pulse sources in %.1fs",
+                run_id, tally["n_folded"], tally["n_catalogue"],
+                tally["n_known"], tally["n_rfi"],
+                tally["n_sp_sources"], summary["duration_s"],
+            )
+            tel.event("sift_done", **summary)
+            return summary

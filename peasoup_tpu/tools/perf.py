@@ -101,12 +101,38 @@ def build_parser() -> argparse.ArgumentParser:
     t = sub.add_parser(
         "tune",
         help="auto-tune the dedispersion plan for one shape bucket "
-        "into the tuning cache",
+        "into the tuning cache (or --list/--prune its entries)",
     )
     t.add_argument(
-        "--bucket", required=True,
+        "--bucket", default=None,
         help="shape bucket as nchans,nbits,nsamps,tsamp,fch1,foff "
         "(the campaign bucket key fields)",
+    )
+    t.add_argument(
+        "--list", dest="list_entries", action="store_true",
+        help="list cached plans with device fingerprint, knobs and "
+        "age instead of tuning",
+    )
+    t.add_argument(
+        "--prune", action="store_true",
+        help="remove entries under stale device fingerprints (not "
+        "this device); with --older-than-days also age-prune "
+        "everything else",
+    )
+    t.add_argument(
+        "--older-than-days", type=float, default=None,
+        help="with --prune: also remove entries older than this many "
+        "days on ANY fingerprint (un-stamped legacy entries count as "
+        "infinitely old)",
+    )
+    t.add_argument(
+        "--keep-stale", action="store_true",
+        help="with --prune: keep other devices' entries (age-prune "
+        "only)",
+    )
+    t.add_argument(
+        "--dry-run", action="store_true",
+        help="with --prune: report what would go without rewriting",
     )
     t.add_argument(
         "--pipeline", default="search", choices=("search", "spsearch"),
@@ -292,6 +318,66 @@ def _cmd_check(args) -> int:
     return 0
 
 
+def _fmt_age(age_s) -> str:
+    if age_s is None:
+        return "age unknown"
+    if age_s >= 86400:
+        return f"{age_s / 86400:.1f}d old"
+    if age_s >= 3600:
+        return f"{age_s / 3600:.1f}h old"
+    return f"{age_s:.0f}s old"
+
+
+def _render_entry(row: dict) -> str:
+    knobs = f"dedisp_block={row['dedisp_block']}"
+    if row.get("subbands"):
+        knobs += f" subbands={row['subbands']}"
+    return (
+        f"  {row['fingerprint']}  {row['key']}  {row['engine']}"
+        f"  {knobs}  [{row['source']}, {_fmt_age(row['age_s'])}"
+        + (", STALE device]" if row["stale"] else "]")
+    )
+
+
+def _cmd_tune_list(args) -> int:
+    from peasoup_tpu.perf.tuning import default_cache_path, list_entries
+
+    rows = list_entries(args.cache)
+    for row in rows:
+        print(_render_entry(row))
+    stale = sum(1 for r in rows if r["stale"])
+    print(
+        f"peasoup-perf tune --list: {len(rows)} entr"
+        f"{'y' if len(rows) == 1 else 'ies'} in "
+        f"{args.cache or default_cache_path()}"
+        + (f" ({stale} under stale fingerprints)" if stale else "")
+    )
+    return 0
+
+
+def _cmd_tune_prune(args) -> int:
+    from peasoup_tpu.perf.tuning import default_cache_path, prune_cache
+
+    removed = prune_cache(
+        args.cache,
+        older_than_s=(
+            args.older_than_days * 86400.0
+            if args.older_than_days is not None else None
+        ),
+        keep_stale=args.keep_stale,
+        dry_run=args.dry_run,
+    )
+    for row in removed:
+        print(_render_entry(row))
+    print(
+        f"peasoup-perf tune --prune: "
+        f"{'would remove' if args.dry_run else 'removed'} "
+        f"{len(removed)} entr{'y' if len(removed) == 1 else 'ies'} "
+        f"from {args.cache or default_cache_path()}"
+    )
+    return 0
+
+
 def _cmd_tune(args) -> int:
     import json
 
@@ -301,6 +387,16 @@ def _cmd_tune(args) -> int:
         resolve_plan_for_bucket,
     )
 
+    if sum(map(bool, (args.bucket, args.list_entries, args.prune))) != 1:
+        print(
+            "peasoup-perf tune: give exactly one of --bucket, --list, "
+            "--prune", file=sys.stderr,
+        )
+        return 2
+    if args.list_entries:
+        return _cmd_tune_list(args)
+    if args.prune:
+        return _cmd_tune_prune(args)
     parts = [s.strip() for s in args.bucket.split(",")]
     if len(parts) != 6:
         print(

@@ -135,6 +135,25 @@ def render_resilience(sec: dict) -> list[str]:
     return lines
 
 
+def render_sift(sec: dict) -> list[str]:
+    """Lines for a status snapshot's ``sift`` section (written by
+    peasoup_tpu/sift/service.py): the current pass and whichever
+    tallies exist yet."""
+    bits = [f"pass={sec.get('stage', '?')}"]
+    for key, label in (
+        ("observations", "obs"),
+        ("periodicity", "periodicity"),
+        ("single_pulse", "single-pulse"),
+        ("folded", "folded"),
+        ("known", "known"),
+        ("catalogue", "catalogue"),
+        ("n_sp_sources", "repeat-SP"),
+    ):
+        if sec.get(key) is not None:
+            bits.append(f"{label}={sec[key]}")
+    return ["  sift: " + "  ".join(bits)]
+
+
 def render_status(st: dict, stale_after: float = 0.0) -> str:
     """One compact text block for a status snapshot."""
     prog = st.get("progress") or {}
@@ -169,6 +188,8 @@ def render_status(st: dict, stale_after: float = 0.0) -> str:
         lines.append(f"  device memory high-water: {mem / 1e9:.2f} GB")
     if isinstance(st.get("streaming"), dict):
         lines.extend(render_streaming(st["streaming"]))
+    if isinstance(st.get("sift"), dict):
+        lines.extend(render_sift(st["sift"]))
     if isinstance(st.get("resilience"), dict):
         lines.extend(render_resilience(st["resilience"]))
     if st.get("stalled"):

@@ -272,6 +272,91 @@ class TestTuningCache:
         assert "served from cache" in capsys.readouterr().out
 
 
+class TestTuneCacheHygiene:
+    """ISSUE satellite: `peasoup-perf tune --list/--prune` over
+    tuning_cache.json — entries listed with age, stale device
+    fingerprints pruned."""
+
+    def _seed_cache(self, path: str) -> str:
+        tuning.resolve_plan_for_bucket(BUCKET, "spsearch", OVR, path)
+        doc = tuning.load_cache(path)
+        fp = next(iter(doc["devices"]))
+        # a stale fingerprint holding a copy of the entry, plus an
+        # un-stamped legacy entry (age unknown -> infinitely old)
+        doc["devices"]["tpu:fake-v9:n8"] = {
+            k: dict(v) for k, v in doc["devices"][fp].items()
+        }
+        legacy = dict(next(iter(doc["devices"][fp].values())))
+        legacy.pop("stored_unix", None)
+        doc["devices"][fp]["spsearch|legacy|0|0|0|0|0"] = legacy
+        tuning.save_cache(path, doc)
+        return fp
+
+    def test_entries_listed_with_age_and_staleness(self, tmp_path):
+        path = str(tmp_path / "tc.json")
+        fp = self._seed_cache(path)
+        rows = tuning.list_entries(path)
+        assert len(rows) == 3
+        by_fp = {}
+        for r in rows:
+            by_fp.setdefault(r["fingerprint"], []).append(r)
+        assert all(r["stale"] for r in by_fp["tpu:fake-v9:n8"])
+        assert all(not r["stale"] for r in by_fp[fp])
+        stamped = [r for r in rows if r["stored_unix"] is not None]
+        assert stamped and all(
+            r["age_s"] is not None and r["age_s"] >= 0 for r in stamped
+        )
+        legacy = [r for r in rows if r["stored_unix"] is None]
+        assert len(legacy) == 1 and legacy[0]["age_s"] is None
+
+    def test_prune_removes_stale_fingerprints_only(self, tmp_path):
+        path = str(tmp_path / "tc.json")
+        fp = self._seed_cache(path)
+        removed = tuning.prune_cache(path, dry_run=True)
+        assert {r["fingerprint"] for r in removed} == {"tpu:fake-v9:n8"}
+        assert len(tuning.list_entries(path)) == 3  # dry run: intact
+        removed = tuning.prune_cache(path)
+        assert len(removed) == 1
+        doc = tuning.load_cache(path)
+        assert list(doc["devices"]) == [fp]  # empty group dropped
+        tuning.validate_cache(doc)
+
+    def test_prune_older_than_catches_legacy_unstamped(self, tmp_path):
+        path = str(tmp_path / "tc.json")
+        self._seed_cache(path)
+        removed = tuning.prune_cache(
+            path, older_than_s=3600.0, keep_stale=True
+        )
+        # fresh entries survive; the un-stamped legacy one reads as
+        # infinitely old and goes
+        assert len(removed) == 1
+        assert removed[0]["stored_unix"] is None
+
+    def test_tune_list_prune_cli(self, tmp_path, capsys):
+        from peasoup_tpu.tools.perf import main as perf_main
+
+        cache = str(tmp_path / "tc.json")
+        self._seed_cache(cache)
+        rc = perf_main(["tune", "--list", "--cache", cache])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "3 entries" in out
+        assert "STALE device" in out
+        assert "1 under stale fingerprints" in out
+        rc = perf_main(
+            ["tune", "--prune", "--dry-run", "--cache", cache]
+        )
+        assert rc == 0
+        assert "would remove 1 entry" in capsys.readouterr().out
+        rc = perf_main(["tune", "--prune", "--cache", cache])
+        assert rc == 0
+        assert "removed 1 entry" in capsys.readouterr().out
+        assert len(tuning.list_entries(cache)) == 2
+        # exactly one of --bucket/--list/--prune
+        assert perf_main(["tune", "--list", "--prune"]) == 2
+        assert perf_main(["tune"]) == 2
+
+
 # --------------------------------------------------------------------------
 # warmup-aware claiming
 # --------------------------------------------------------------------------

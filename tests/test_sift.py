@@ -1,0 +1,731 @@
+"""Survey sifting tests: DB schema versioning/migration, batched
+survey-fold bitwise parity with the per-observation folder (including
+across a shape-bucket boundary and under an injected device OOM),
+known-pulsar cross-match ladders, campaign-level dedup, multi-beam
+coincidence vetoing, RRAT period inference, the end-to-end sift run +
+report, and the peasoup-sift CLI.
+"""
+
+import json
+import os
+import sqlite3
+
+import numpy as np
+import pytest
+
+from peasoup_tpu.campaign.db import (
+    _SCHEMA_V1,
+    SCHEMA_VERSION,
+    CandidateDB,
+    SchemaVersionError,
+)
+from peasoup_tpu.core.candidates import Candidate
+from peasoup_tpu.io.sigproc import (
+    Filterbank,
+    SigprocHeader,
+    write_filterbank,
+)
+from peasoup_tpu.obs.telemetry import RunTelemetry
+from peasoup_tpu.pipeline.folder import MultiFolder, fold_geometry
+from peasoup_tpu.resilience import faults
+from peasoup_tpu.resilience.stats import STATS
+from peasoup_tpu.sift.crossmatch import (
+    harmonic_identify,
+    load_catalogue,
+    match_candidate,
+)
+from peasoup_tpu.sift.dedup import dedup_candidates, multibeam_veto
+from peasoup_tpu.sift.fold import (
+    FoldCandidate,
+    FoldObservation,
+    SurveyFolder,
+)
+from peasoup_tpu.sift.repeats import infer_period, repeat_sources
+from peasoup_tpu.sift.service import SiftConfig, SiftRun
+
+P0 = 0.714519699726  # J0332+5434 (B0329+54)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.configure(None)
+    STATS.reset()
+    yield
+    faults.configure(None)
+    STATS.reset()
+
+
+# --------------------------------------------------------------------------
+# database schema versioning + migration
+# --------------------------------------------------------------------------
+
+class TestDBSchema:
+    def _legacy_v1(self, path: str) -> None:
+        conn = sqlite3.connect(path)
+        conn.executescript(_SCHEMA_V1)
+        conn.execute(
+            "INSERT INTO observations (job_id, input, source_name, "
+            "tstart, tsamp, nchans, nsamps, ingested_unix) VALUES "
+            "('j1', 'a.fil', 'SRC', 55000.0, 2.56e-4, 8, 4096, 0)"
+        )
+        conn.execute(
+            "INSERT INTO candidates (job_id, kind, dm, snr, period) "
+            "VALUES ('j1', 'periodicity', 26.7, 9.0, 0.714)"
+        )
+        conn.commit()
+        conn.close()
+
+    def test_fresh_db_opens_at_current_version(self, tmp_path):
+        with CandidateDB(str(tmp_path / "c.sqlite")) as db:
+            assert db.schema_version() == SCHEMA_VERSION
+            # sift tables exist and start empty
+            assert db.sift_catalogue() == []
+            assert db.latest_sift_run() is None
+            # v2 observation columns exist
+            cols = {
+                r[1]
+                for r in db._conn.execute(
+                    "PRAGMA table_info(observations)"
+                )
+            }
+            assert {"beam", "src_raj", "src_dej"} <= cols
+
+    def test_legacy_v1_migrates_up_in_place(self, tmp_path):
+        """ISSUE satellite (up): a pre-sift campaign DB upgrades in
+        place, keeping its rows and gaining the new tables/columns."""
+        path = str(tmp_path / "c.sqlite")
+        self._legacy_v1(path)
+        with CandidateDB(path) as db:
+            assert db.schema_version() == SCHEMA_VERSION
+            obs = db.observations()
+            assert len(obs) == 1 and obs[0]["job_id"] == "j1"
+            assert obs[0]["beam"] is None  # migrated rows: unknown beam
+            cands = db.all_candidates("periodicity")
+            assert len(cands) == 1 and cands[0]["dm"] == 26.7
+            assert db.sift_catalogue() == []
+        # idempotent: a second open finds nothing to do
+        with CandidateDB(path) as db:
+            assert db.schema_version() == SCHEMA_VERSION
+
+    def test_future_version_refused_loudly(self, tmp_path):
+        """ISSUE satellite (down): a DB from a newer peasoup_tpu is
+        refused, never silently misread."""
+        path = str(tmp_path / "c.sqlite")
+        self._legacy_v1(path)
+        conn = sqlite3.connect(path)
+        conn.execute(f"PRAGMA user_version = {SCHEMA_VERSION + 7}")
+        conn.commit()
+        conn.close()
+        with pytest.raises(SchemaVersionError, match="newer"):
+            CandidateDB(path)
+
+    def test_sift_ingest_replaces_wholesale(self, tmp_path):
+        path = str(tmp_path / "c.sqlite")
+        row = {
+            "kind": "periodicity", "label": "candidate", "tier": 2,
+            "dm": 10.0, "snr": 9.0, "period": 0.5, "job_ids": ["j1"],
+        }
+        with CandidateDB(path) as db:
+            db.ingest_sift_run("run1", {}, [row, dict(row, dm=11.0)],
+                               [], [])
+            assert len(db.sift_catalogue()) == 2
+            db.ingest_sift_run("run2", {}, [row], [], [
+                {"dm": 40.0, "n_obs": 2, "n_pulses": 5,
+                 "best_snr": 8.0, "period_s": 0.5,
+                 "period_frac_resid": 0.001, "job_ids": ["j1", "j2"],
+                 "toas_s": [0.0, 0.5]},
+            ])
+            # latest run wins wholesale
+            assert len(db.sift_catalogue()) == 1
+            assert db.latest_sift_run()["run_id"] == "run2"
+            assert len(db.sift_sp_sources()) == 1
+
+
+# --------------------------------------------------------------------------
+# batched survey fold: bitwise parity with pipeline/folder.py
+# --------------------------------------------------------------------------
+
+def make_trials(ndm: int, nsamps: int, seed: int = 0) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    trials = rng.integers(20, 45, size=(ndm, nsamps), dtype=np.uint8)
+    # a periodic brightening so folds/optimiser see structure
+    period = max(64, nsamps // 37)
+    for s in range(0, nsamps, period):
+        trials[:, s : s + 3] += 40
+    return trials
+
+
+def multifolder_outcomes(trials, trials_nsamps, tsamp, cands):
+    """The per-observation reference path on the same candidates."""
+    mf = MultiFolder(trials, trials_nsamps, tsamp)
+    return {
+        o["cand_idx"]: o
+        for o in mf.fold_outcomes(list(cands), len(cands))
+    }
+
+
+def survey_obs(job_id, trials, trials_nsamps, tsamp, cands):
+    return FoldObservation(
+        job_id=job_id, trials=trials, trials_nsamps=trials_nsamps,
+        tsamp=tsamp,
+        cands=[
+            FoldCandidate(
+                key=i, period=1.0 / c.freq, acc=c.acc, dm_row=c.dm_idx
+            )
+            for i, c in enumerate(cands)
+        ],
+    )
+
+
+class TestSurveyFoldParity:
+    TSAMP = 0.000256
+
+    def _cands(self, ndm, nsamps, seed=1):
+        rng = np.random.default_rng(seed)
+        out = []
+        for i in range(6):
+            p = float(rng.uniform(0.004, 0.05))
+            out.append(
+                Candidate(
+                    dm=float(i), dm_idx=int(rng.integers(0, ndm)),
+                    acc=float(rng.uniform(-20, 20)), snr=9.0,
+                    freq=1.0 / p,
+                )
+            )
+        return out
+
+    def test_bitwise_equal_to_multifolder(self):
+        """ISSUE satellite: the batched survey fold is bitwise-equal
+        to the per-observation folder path on the same candidates."""
+        trials = make_trials(4, 4000)
+        cands = self._cands(4, 4000)
+        want = multifolder_outcomes(trials, 4000, self.TSAMP, cands)
+        got = SurveyFolder(batch=4).fold_outcomes(
+            [survey_obs("jobA", trials, 4000, self.TSAMP, cands)]
+        )
+        assert len(got) == len(want) == len(cands)
+        for o in got:
+            ref = want[o["key"]]
+            assert o["opt_sn"] == ref["opt_sn"]
+            assert o["opt_period"] == ref["opt_period"]
+            assert np.array_equal(o["opt_fold"], ref["opt_fold"])
+
+    def test_parity_across_shape_bucket_boundary(self):
+        """Two observations on opposite sides of a power-of-two
+        boundary (sizes 2048 and 4096) fold in one pass, each
+        bitwise-equal to its own MultiFolder."""
+        obs = []
+        want = {}
+        for j, nsamps in enumerate((4000, 4160)):
+            geom = fold_geometry(nsamps, self.TSAMP)
+            assert geom[0] == (2048 if j == 0 else 4096)
+            trials = make_trials(3, nsamps, seed=j)
+            cands = self._cands(3, nsamps, seed=10 + j)
+            want[f"job{j}"] = multifolder_outcomes(
+                trials, nsamps, self.TSAMP, cands
+            )
+            obs.append(
+                survey_obs(f"job{j}", trials, nsamps, self.TSAMP, cands)
+            )
+        got = SurveyFolder(batch=4).fold_outcomes(obs)
+        assert len(got) == 12
+        for o in got:
+            ref = want[o["job_id"]][o["key"]]
+            assert o["opt_sn"] == ref["opt_sn"]
+            assert o["opt_period"] == ref["opt_period"]
+            assert np.array_equal(o["opt_fold"], ref["opt_fold"])
+
+    def test_bitwise_equal_under_device_oom(self):
+        """ISSUE satellite: an injected device.oom mid-pass shrinks
+        the batch (DegradationLadder rung) and the outcomes stay
+        bitwise-equal to the fault-free run."""
+        trials = make_trials(4, 4000, seed=3)
+        cands = self._cands(4, 4000, seed=4)
+        obs = [survey_obs("jobA", trials, 4000, self.TSAMP, cands)]
+        want = {
+            o["key"]: o for o in SurveyFolder(batch=4).fold_outcomes(obs)
+        }
+        faults.configure("device.oom:at=1")
+        tel = RunTelemetry()
+        with tel.activate():
+            got = SurveyFolder(batch=4).fold_outcomes(obs)
+        degs = [e for e in tel.events if e["kind"] == "degradation"]
+        assert degs and degs[0]["ladder"] == "sift.fold"
+        assert degs[0]["rung"] == "batch_shrink"
+        assert len(got) == len(want)
+        for o in got:
+            ref = want[o["key"]]
+            assert o["opt_sn"] == ref["opt_sn"]
+            assert o["opt_period"] == ref["opt_period"]
+            assert np.array_equal(o["opt_fold"], ref["opt_fold"])
+
+    def test_oom_exhaustion_raises_at_batch_one(self):
+        trials = make_trials(2, 4000, seed=5)
+        cands = self._cands(2, 4000, seed=6)
+        obs = [survey_obs("jobA", trials, 4000, self.TSAMP, cands)]
+        faults.configure("device.oom:n=99")
+        with pytest.raises(Exception, match="RESOURCE_EXHAUSTED"):
+            SurveyFolder(batch=2).fold_outcomes(obs)
+
+    def test_zero_steady_state_recompiles(self):
+        """Many same-bucket batches reuse ONE compiled fold program
+        and ONE compiled optimiser (compile counters at zero after the
+        first batch)."""
+        from peasoup_tpu.campaign.runner import jit_programs_compiled
+
+        trials = make_trials(6, 4000, seed=7)
+        folder = SurveyFolder(batch=4)
+        obs0 = [survey_obs("warm", trials, 4000, self.TSAMP,
+                           self._cands(6, 4000, seed=8))]
+        folder.fold_outcomes(obs0)  # compiles once
+        tel = RunTelemetry()
+        with tel.activate():
+            for seed in (9, 10, 11):
+                got = folder.fold_outcomes(
+                    [
+                        survey_obs(
+                            f"obs{seed}", trials, 4000, self.TSAMP,
+                            self._cands(6, 4000, seed=seed),
+                        )
+                    ]
+                )
+                assert got
+        assert jit_programs_compiled(tel) == 0
+
+    def test_period_gates_match_multifolder(self):
+        trials = make_trials(2, 4000, seed=12)
+        cands = [
+            Candidate(dm_idx=0, acc=0.0, snr=9.0, freq=1.0 / 20.0),
+            Candidate(dm_idx=0, acc=0.0, snr=9.0, freq=1.0 / 5e-4),
+        ]
+        got = SurveyFolder(batch=2).fold_outcomes(
+            [survey_obs("jobA", trials, 4000, self.TSAMP, cands)]
+        )
+        assert got == []  # both outside (min_period, max_period)
+
+
+# --------------------------------------------------------------------------
+# sifting passes
+# --------------------------------------------------------------------------
+
+class TestCrossmatch:
+    def test_harmonic_ladder_identities(self):
+        assert harmonic_identify(P0, P0)[:2] == (1, 1)
+        assert harmonic_identify(P0 / 2, P0)[:2] == (1, 2)
+        assert harmonic_identify(P0 / 3, P0)[:2] == (1, 3)
+        assert harmonic_identify(2 * P0, P0)[:2] == (2, 1)
+        assert harmonic_identify(1.5 * P0, P0)[:2] == (3, 2)
+        assert harmonic_identify(0.123, P0) is None
+        # tolerance edge
+        assert harmonic_identify(P0 * 1.001, P0, tol=2e-3) is not None
+        assert harmonic_identify(P0 * 1.01, P0, tol=2e-3) is None
+
+    def test_match_candidate_dm_gate(self):
+        cat = load_catalogue()
+        m = match_candidate(P0, 26.8, cat)
+        assert m is not None and m["psr"] == "J0332+5434"
+        assert m["harmonic"] == "1/1"
+        # right period, hopeless DM: no match
+        assert match_candidate(P0, 200.0, cat) is None
+        # harmonic detection still identifies the source
+        m2 = match_candidate(P0 / 4, 26.0, cat)
+        assert m2 is not None and m2["harmonic"] == "1/4"
+
+    def test_catalogue_validation(self, tmp_path):
+        bad = tmp_path / "cat.json"
+        bad.write_text(json.dumps({"schema": "nope", "pulsars": []}))
+        with pytest.raises(ValueError, match="known_pulsars"):
+            load_catalogue(str(bad))
+        bad.write_text(
+            json.dumps(
+                {
+                    "schema": "peasoup_tpu.known_pulsars",
+                    "pulsars": [{"name": "X", "period_s": -1, "dm": 0}],
+                }
+            )
+        )
+        with pytest.raises(ValueError, match="bad catalogue entry"):
+            load_catalogue(str(bad))
+
+    def test_checked_in_catalogue_loads(self):
+        cat = load_catalogue()
+        assert len(cat) >= 15
+        names = {p["name"] for p in cat}
+        assert {"J0332+5434", "J0534+2200", "J0835-4510"} <= names
+
+
+class TestDedup:
+    def test_harmonics_merge_across_observations(self):
+        cands = [
+            {"id": 1, "job_id": "a", "period": P0, "dm": 26.7,
+             "snr": 12.0},
+            {"id": 2, "job_id": "b", "period": P0 / 2, "dm": 26.9,
+             "snr": 9.0},
+            {"id": 3, "job_id": "c", "period": 0.1234, "dm": 80.0,
+             "snr": 8.0},
+        ]
+        groups = dedup_candidates(cands)
+        assert len(groups) == 2
+        lead = groups[0]
+        assert lead["leader"]["id"] == 1  # strongest wins
+        assert {m["id"] for m in lead["members"]} == {1, 2}
+        assert lead["n_obs"] == 2
+        member = next(m for m in lead["members"] if m["id"] == 2)
+        assert member["harmonic"] == "1/2"
+
+    def test_dm_gate_prevents_merge(self):
+        cands = [
+            {"id": 1, "job_id": "a", "period": P0, "dm": 10.0,
+             "snr": 12.0},
+            {"id": 2, "job_id": "b", "period": P0, "dm": 40.0,
+             "snr": 9.0},
+        ]
+        assert len(dedup_candidates(cands, dm_tol=2.0)) == 2
+
+    def test_multibeam_veto_reuses_coincidence_op(self):
+        # the same (period, DM) cell firing in 5 beams is RFI; a
+        # single-beam candidate survives
+        rfi = [
+            {"id": i, "period": 0.02, "dm": 15.0, "snr": 9.0,
+             "beam": i + 1}
+            for i in range(5)
+        ]
+        psr = [{"id": 99, "period": P0, "dm": 26.7, "snr": 12.0,
+                "beam": 3}]
+        vetoed = multibeam_veto(
+            rfi + psr, snr_thresh=6.0, beam_thresh=4
+        )
+        assert vetoed == {0, 1, 2, 3, 4}
+        # too few beams overall: the veto stands down entirely
+        assert multibeam_veto(rfi[:2] + psr, beam_thresh=4) == set()
+        # no beam provenance recorded: nothing vetoed
+        nobeam = [dict(r, beam=None) for r in rfi]
+        assert multibeam_veto(nobeam + psr, beam_thresh=4) == set()
+
+
+class TestRepeats:
+    def test_gcd_period_recovery_within_tolerance(self):
+        p = 0.7321
+        toas = np.asarray([0.0, 3 * p, 7 * p, 18 * p, 40 * p])
+        toas = toas + np.random.default_rng(0).normal(
+            0, 0.002, size=toas.shape
+        )
+        fit = infer_period(toas)
+        assert fit is not None
+        period, resid = fit
+        assert abs(period - p) / p < 0.01
+        assert resid < 0.02
+
+    def test_largest_consistent_period_wins(self):
+        p = 0.5
+        toas = np.asarray([0.0, 2 * p, 3 * p, 7 * p])
+        period, _ = infer_period(toas)
+        assert abs(period - p) / p < 1e-6
+
+    def test_incommensurate_toas_yield_no_period(self):
+        toas = np.asarray([0.0, 1.0, 2.0 + np.pi / 10.0])
+        assert infer_period(toas, phase_tol=0.02) is None
+
+    def test_association_needs_obs_and_pulse_floor(self):
+        rows = [
+            {"id": 1, "job_id": "a", "dm": 40.0, "snr": 8.0,
+             "obs_tstart": 55000.0, "time_s": 0.5},
+            {"id": 2, "job_id": "a", "dm": 40.1, "snr": 8.5,
+             "obs_tstart": 55000.0, "time_s": 1.5},
+            {"id": 3, "job_id": "b", "dm": 40.2, "snr": 7.5,
+             "obs_tstart": 55000.01, "time_s": 1.0},
+            # far-away DM: its own (too small) group
+            {"id": 4, "job_id": "b", "dm": 90.0, "snr": 9.0,
+             "obs_tstart": 55000.01, "time_s": 2.0},
+        ]
+        srcs = repeat_sources(rows, min_pulses=3, min_obs=2)
+        assert len(srcs) == 1
+        assert srcs[0]["n_pulses"] == 3 and srcs[0]["n_obs"] == 2
+        # single-observation group fails the min_obs floor
+        assert repeat_sources(rows[:2], min_pulses=2, min_obs=2) == []
+
+
+# --------------------------------------------------------------------------
+# the end-to-end sift run + report + CLI
+# --------------------------------------------------------------------------
+
+def seed_campaign(tmp_path, with_rfi=False):
+    """A 2-observation campaign DB: an injected known pulsar (B0329
+    fundamental in obs0, its 1/2 harmonic in obs1 — the cross-obs
+    duplicate), a repeated single-pulse source (P = 0.5 s across both
+    observations), and optionally a multi-beam RFI comb."""
+    camp = tmp_path / "camp"
+    camp.mkdir(exist_ok=True)
+    nsamps, nchans, tsamp = 4096, 8, 0.000256
+    rng = np.random.default_rng(0)
+    prrat = 0.5
+    with CandidateDB(str(camp / "candidates.sqlite")) as db:
+        conn = db._conn
+        nobs = 6 if with_rfi else 2
+        for i in range(nobs):
+            data = np.clip(
+                np.rint(rng.normal(32.0, 4.0, size=(nsamps, nchans))),
+                0, 255,
+            ).astype(np.uint8)
+            hdr = SigprocHeader(
+                source_name=f"OBS{i}", tsamp=tsamp,
+                tstart=55000.0 + i * 0.01, fch1=1400.0, foff=-16.0,
+                nchans=nchans, nbits=8, nifs=1, data_type=1,
+                ibeam=i + 1,
+            )
+            write_filterbank(
+                str(camp / f"obs{i}.fil"),
+                Filterbank(header=hdr, data=data),
+            )
+            conn.execute(
+                "INSERT INTO observations (job_id, input, source_name,"
+                " tstart, tsamp, nchans, nsamps, ingested_unix, beam,"
+                " src_raj, src_dej) VALUES (?,?,?,?,?,?,?,?,?,?,?)",
+                (f"job{i}", str(camp / f"obs{i}.fil"), f"OBS{i}",
+                 55000.0 + i * 0.01, tsamp, nchans, nsamps, 0.0,
+                 i + 1, 0.0, 0.0),
+            )
+        conn.execute(
+            "INSERT INTO candidates (job_id, kind, dm, snr, period, "
+            "acc, nh) VALUES ('job0', 'periodicity', 26.76, 12.0, ?, "
+            "0.0, 2)", (P0,),
+        )
+        conn.execute(
+            "INSERT INTO candidates (job_id, kind, dm, snr, period, "
+            "acc, nh) VALUES ('job1', 'periodicity', 26.80, 9.0, ?, "
+            "0.0, 1)", (P0 / 2,),
+        )
+        conn.execute(
+            "INSERT INTO candidates (job_id, kind, dm, snr, period, "
+            "acc, nh) VALUES ('job1', 'periodicity', 80.0, 8.0, "
+            "0.1234, 0.0, 1)"
+        )
+        if with_rfi:
+            for i in range(6):  # one comb in every beam
+                conn.execute(
+                    "INSERT INTO candidates (job_id, kind, dm, snr, "
+                    "period, acc, nh) VALUES (?, 'periodicity', 5.0, "
+                    "9.5, 0.02, 0.0, 1)", (f"job{i}",),
+                )
+        for i, ks in enumerate([(1, 3, 7), (2, 5, 11)]):
+            for k in ks:
+                t = 0.05 + k * prrat
+                conn.execute(
+                    "INSERT INTO candidates (job_id, kind, dm, snr, "
+                    "time_s, sample, width, members) VALUES "
+                    "(?, 'single_pulse', ?, 8.0, ?, ?, 4, 3)",
+                    (f"job{i}", 40.0 + 0.1 * i, t, int(t / tsamp)),
+                )
+        conn.commit()
+    return camp
+
+
+class TestSiftEndToEnd:
+    def test_run_flags_known_merges_duplicates_finds_rrat(self, tmp_path):
+        camp = seed_campaign(tmp_path)
+        cfg = SiftConfig(
+            workdir=str(camp), fold_batch=8, sp_min_pulses=4
+        )
+        tel = RunTelemetry()
+        with tel.activate():
+            summary = SiftRun(cfg).run()
+        assert summary["n_folded"] == 3
+        assert summary["n_known"] == 1
+        assert summary["n_sp_sources"] == 1
+        with CandidateDB(str(camp / "candidates.sqlite")) as db:
+            cat = db.sift_catalogue()
+            assert len(cat) == 2
+            known = next(c for c in cat if c["label"] == "known")
+            # the injected pulsar: cross-matched, harmonic duplicate
+            # merged across observations into ONE catalogue row
+            assert known["known_source"] == "J0332+5434"
+            assert known["tier"] == 1
+            assert known["n_obs"] == 2 and known["members"] == 2
+            assert json.loads(known["job_ids"]) == ["job0", "job1"]
+            # folded: the postage stamp rode along as inline JSON
+            fold = json.loads(known["fold_json"])
+            assert len(fold["prof"]) == cfg.fold_nbins
+            assert len(fold["subints"]) == cfg.fold_nints
+            matches = db.sift_known_matches()
+            assert {m["harmonic"] for m in matches} == {"1/1", "1/2"}
+            # the repeated single-pulse source with its inferred period
+            [src] = db.sift_sp_sources()
+            assert src["n_pulses"] == 6 and src["n_obs"] == 2
+            assert abs(src["period_s"] - 0.5) / 0.5 < 0.01
+        # observability: stage events + the sift status section
+        kinds = [e["kind"] for e in tel.events]
+        assert "sift_folded" in kinds and "sift_done" in kinds
+        sections = tel.snapshot_sections()
+        assert sections["sift"]["stage"] == "done"
+
+    def test_multibeam_rfi_vetoed_e2e(self, tmp_path):
+        camp = seed_campaign(tmp_path, with_rfi=True)
+        cfg = SiftConfig(
+            workdir=str(camp), fold=False, sp_min_pulses=4,
+            beam_thresh=4,
+        )
+        SiftRun(cfg).run()
+        with CandidateDB(str(camp / "candidates.sqlite")) as db:
+            cat = db.sift_catalogue()
+            rfi = [c for c in cat if c["label"] == "rfi"]
+            assert len(rfi) == 1  # the comb deduped into one row
+            assert rfi[0]["members"] == 6
+            known = [c for c in cat if c["label"] == "known"]
+            assert len(known) == 1  # the pulsar survived the veto
+
+    def test_fold_outcomes_match_multifolder_e2e(self, tmp_path):
+        """Acceptance: the service's batched fold over re-dedispersed
+        DB candidates is bitwise-equal to MultiFolder on the same
+        trials."""
+        camp = seed_campaign(tmp_path)
+        cfg = SiftConfig(workdir=str(camp), fold_batch=8)
+        run = SiftRun(cfg)
+        with CandidateDB(str(camp / "candidates.sqlite")) as db:
+            obs_rows = db.observations()
+            cands = db.all_candidates("periodicity")
+        fold_inputs = run.build_fold_inputs(obs_rows, cands)
+        assert len(fold_inputs) == 2
+        # canonicalise periods through the folder's freq round trip
+        # (MultiFolder consumes 1/freq; 1/(1/p) is a ULP off p, and
+        # this test pins the fold machinery, not float inversion)
+        for fi in fold_inputs:
+            for c in fi.cands:
+                c.period = 1.0 / (1.0 / c.period)
+        got = {
+            o["key"]: o
+            for o in SurveyFolder(batch=8).fold_outcomes(fold_inputs)
+        }
+        n = 0
+        for fi in fold_inputs:
+            ref_cands = [
+                Candidate(
+                    dm_idx=c.dm_row, acc=c.acc, snr=9.0,
+                    freq=1.0 / c.period,
+                )
+                for c in fi.cands
+            ]
+            want = multifolder_outcomes(
+                fi.trials, fi.trials_nsamps, fi.tsamp, ref_cands
+            )
+            for i, c in enumerate(fi.cands):
+                o = got[c.key]
+                assert o["opt_sn"] == want[i]["opt_sn"]
+                assert o["opt_period"] == want[i]["opt_period"]
+                assert np.array_equal(
+                    o["opt_fold"], want[i]["opt_fold"]
+                )
+                n += 1
+        assert n == 3
+
+    def test_missing_input_file_skips_observation(self, tmp_path):
+        camp = seed_campaign(tmp_path)
+        os.unlink(camp / "obs1.fil")
+        cfg = SiftConfig(
+            workdir=str(camp), fold_batch=8, sp_min_pulses=4
+        )
+        tel = RunTelemetry()
+        with tel.activate():
+            summary = SiftRun(cfg).run()
+        assert summary["n_folded"] == 1  # only obs0's candidate folded
+        skips = [
+            e for e in tel.events if e["kind"] == "sift_obs_skipped"
+        ]
+        assert len(skips) == 1 and skips[0]["job_id"] == "job1"
+        # the sift still completes: crossmatch/dedup use trial periods
+        assert summary["n_known"] == 1
+
+    def test_missing_db_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="campaign database"):
+            SiftRun(SiftConfig(workdir=str(tmp_path))).run()
+
+    def test_report_schema_valid_and_self_contained(self, tmp_path):
+        from peasoup_tpu.sift.report import (
+            build_report,
+            render_html,
+            validate_report,
+        )
+
+        camp = seed_campaign(tmp_path)
+        SiftRun(
+            SiftConfig(workdir=str(camp), fold_batch=8, sp_min_pulses=4)
+        ).run()
+        with CandidateDB(str(camp / "candidates.sqlite")) as db:
+            doc = build_report(db, None)
+        validate_report(doc)
+        assert doc["labels"]["known"] == 1
+        assert doc["known_sources"][0]["psr"] == "J0332+5434"
+        page = render_html(doc)
+        # self-contained: the full report JSON is inline and the page
+        # references no external assets
+        assert '<script type="application/json" id="sift-report">' in page
+        assert "http://" not in page and "https://" not in page
+        embedded = page.split('id="sift-report">')[1].split("</script>")[0]
+        assert (
+            json.loads(embedded.replace("<\\/", "</"))["run"]["run_id"]
+            == doc["run"]["run_id"]
+        )
+
+    def test_report_schema_rejects_drift(self, tmp_path):
+        from peasoup_tpu.obs.schema import SchemaError
+        from peasoup_tpu.sift.report import build_report, validate_report
+
+        camp = seed_campaign(tmp_path)
+        SiftRun(
+            SiftConfig(workdir=str(camp), fold=False, sp_min_pulses=4)
+        ).run()
+        with CandidateDB(str(camp / "candidates.sqlite")) as db:
+            doc = build_report(db, None)
+        doc["catalogue"][0]["label"] = "maybe"
+        with pytest.raises(SchemaError):
+            validate_report(doc)
+
+
+class TestSiftCLI:
+    def test_run_and_report(self, tmp_path, capsys):
+        from peasoup_tpu.cli.sift import main as sift_main
+        from peasoup_tpu.obs.schema import validate_manifest
+        from peasoup_tpu.obs.telemetry import load_manifest
+
+        camp = seed_campaign(tmp_path)
+        rc = sift_main(
+            ["run", "-w", str(camp), "--fold-batch", "8",
+             "--config", '{"sp_min_pulses": 4}']
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "1 known" in out and "repeat single-pulse" in out
+        man = load_manifest(str(camp / "sift" / "telemetry.json"))
+        validate_manifest(man)
+        assert man["sift"]["stage"] == "done"
+        rc = sift_main(
+            ["report", "-w", str(camp), "--print-summary"]
+        )
+        assert rc == 0
+        assert "t1=1" in capsys.readouterr().out
+        assert os.path.getsize(camp / "sift" / "report.html") > 1000
+        doc = json.loads((camp / "sift" / "report.json").read_text())
+        assert doc["schema"] == "peasoup_tpu.sift_report"
+
+    def test_watch_renders_sift_section(self, tmp_path):
+        from peasoup_tpu.cli.sift import main as sift_main
+        from peasoup_tpu.obs.heartbeat import load_status
+        from peasoup_tpu.tools.watch import render_status
+
+        camp = seed_campaign(tmp_path)
+        assert sift_main(
+            ["run", "-w", str(camp), "--no-fold",
+             "--config", '{"sp_min_pulses": 4}']
+        ) == 0
+        st = load_status(str(camp / "sift" / "status.json"))
+        text = render_status(st)
+        assert "sift:" in text and "pass=done" in text
+
+    def test_bad_config_key_and_missing_db(self, tmp_path, capsys):
+        from peasoup_tpu.cli.sift import main as sift_main
+
+        camp = seed_campaign(tmp_path)
+        assert sift_main(
+            ["run", "-w", str(camp), "--config", '{"bogus": 1}']
+        ) == 2
+        assert "unknown SiftConfig keys" in capsys.readouterr().err
+        assert sift_main(["report", "-w", str(tmp_path / "empty")]) == 2
