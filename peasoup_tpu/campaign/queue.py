@@ -98,6 +98,7 @@ class Job:
     pipeline: str = "spsearch"
     config: dict = field(default_factory=dict)
     bucket: tuple | None = None
+    priority: int = 0  # higher claims sooner; outranks bucket affinity
     attempts: int = 0
     next_eligible_unix: float = 0.0
     last_error: str | None = None
@@ -110,6 +111,7 @@ class Job:
             "pipeline": self.pipeline,
             "config": self.config,
             "bucket": list(self.bucket) if self.bucket else None,
+            "priority": self.priority,
             "attempts": self.attempts,
             "next_eligible_unix": self.next_eligible_unix,
             "last_error": self.last_error,
@@ -125,6 +127,7 @@ class Job:
             pipeline=doc.get("pipeline", "spsearch"),
             config=doc.get("config") or {},
             bucket=tuple(b) if b else None,
+            priority=int(doc.get("priority", 0)),
             attempts=int(doc.get("attempts", 0)),
             next_eligible_unix=float(doc.get("next_eligible_unix", 0.0)),
             last_error=doc.get("last_error"),
@@ -310,15 +313,18 @@ class JobQueue:
         prefer_bucket: tuple | None = None,
         warm_buckets: "set[tuple] | frozenset[tuple] | None" = None,
     ) -> Claim | None:
-        """Claim the next eligible job. Jobs sharing ``prefer_bucket``
-        (the worker's previous shape bucket) come first, then jobs
-        whose bucket is in ``warm_buckets`` (buckets already
-        warmed/tuned — this worker's own plus any recorded in the
-        campaign's done records, see runner.py), then the remainder —
-        each tier grouped BY bucket — so a fleet of workers naturally
-        partitions into shape-coherent streaks, consecutive jobs hit
-        the compiled-program caches, and already-paid warmup/tuning
-        work is exploited before any new bucket is opened."""
+        """Claim the next eligible job, ranked priority class first
+        (higher ``Job.priority`` always claims sooner — an urgent
+        re-observation must not wait behind a warm-bucket streak),
+        then jobs sharing ``prefer_bucket`` (the worker's previous
+        shape bucket), then jobs whose bucket is in ``warm_buckets``
+        (buckets already warmed/tuned — this worker's own plus any
+        recorded in the campaign's done records, see runner.py), then
+        the remainder — each tier grouped BY bucket — so a fleet of
+        workers naturally partitions into shape-coherent streaks,
+        consecutive jobs hit the compiled-program caches, and
+        already-paid warmup/tuning work is exploited before any new
+        bucket is opened."""
         self.reap_stale()
         now = time.time()
         warm = {tuple(b) for b in warm_buckets} if warm_buckets else set()
@@ -337,6 +343,7 @@ class JobQueue:
             else:
                 tier = 2
             rank = (
+                -job.priority,
                 tier,
                 tuple(str(x) for x in bucket),
                 jid,
@@ -385,6 +392,17 @@ class JobQueue:
         state = self._record_failure(claim.job.job_id, error)
         self._release(claim)
         return state
+
+    def release(self, claim: Claim) -> None:
+        """Voluntary release by the claim holder — a worker leaving the
+        fleet cleanly hands its unstarted job back with ZERO attempts
+        consumed (a clean leave is elasticity, not a failure; the job
+        is immediately claimable by anyone)."""
+        self._release(claim)
+        log.info(
+            "claim on %s released cleanly by %s (no attempt consumed)",
+            claim.job.job_id, claim.worker_id,
+        )
 
     def _release(self, claim: Claim) -> None:
         try:

@@ -1,0 +1,190 @@
+"""File-backed worker registry: live fleet membership for a campaign.
+
+The queue (queue.py) already tolerates workers dying — leases expire
+and claims are reaped — but nothing *names* the fleet: operators
+watching a campaign cannot see who is working, and a worker joining
+mid-campaign cannot tell warm peers from ghosts. This module is the
+membership half of elasticity, built on the same idioms as the queue:
+
+- **register** — ``O_CREAT|O_EXCL`` of ``queue/workers/<id>.json``
+  carrying pid/hostname and a lease expiry. A stale entry left by a
+  previous incarnation of the same worker id (a restart) is taken over
+  with an atomic rewrite.
+- **beat** — the owner atomically rewrites its entry with a fresh
+  expiry plus live stats (jobs done, current job, last bucket); the
+  campaign runner beats from the same lease-renewal thread that keeps
+  its claim fresh, so a worker alive enough to hold a job is alive in
+  the registry too.
+- **deregister** — a clean leave unlinks the entry; joins and leaves
+  need no coordinator, mirroring claim release.
+- **reap** — anyone may unlink an EXPIRED entry (a SIGKILLed worker
+  never deregisters). Reaping membership is advisory — job recovery is
+  the queue reaper's — so the unlink needs no tombstone dance; a lost
+  race is a FileNotFoundError and a shrug.
+
+The rollup (rollup.py) reads the registry read-only into the ``fleet``
+status section; ``tools.watch`` renders it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import tempfile
+import time
+
+from ..obs import get_logger
+from ..resilience import faults
+
+log = get_logger("campaign.registry")
+
+_WORKERS = "workers"
+
+
+def _atomic_write_json(path: str, doc: dict) -> None:
+    d = os.path.dirname(path) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def _read_json(path: str) -> dict | None:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None  # gone, mid-replace, or torn: treat as absent
+
+
+class WorkerRegistry:
+    """Heartbeat files under ``<root>/queue/workers/``."""
+
+    def __init__(self, root: str, lease_s: float = 60.0) -> None:
+        self.root = os.path.abspath(root)
+        self.wdir = os.path.join(self.root, "queue", _WORKERS)
+        self.lease_s = float(lease_s)
+        os.makedirs(self.wdir, exist_ok=True)
+
+    def _path(self, worker_id: str) -> str:
+        safe = "".join(
+            c if c.isalnum() or c in "-_." else "_" for c in worker_id
+        )
+        return os.path.join(self.wdir, f"{safe[:80]}.json")
+
+    # --- lifecycle ----------------------------------------------------
+    def register(self, worker_id: str, **info) -> dict:
+        """Join the fleet. Idempotent for one incarnation; a stale or
+        duplicate entry for the same id is taken over (the newest pid
+        wins — worker ids are operator-chosen, and a restart reusing
+        one must not be locked out by its own corpse)."""
+        now = time.time()
+        doc = {
+            "worker_id": worker_id,
+            "pid": os.getpid(),
+            "hostname": socket.gethostname(),
+            "registered_unix": now,
+            "expires_unix": now + self.lease_s,
+            "jobs_done": 0,
+            "current_job": None,
+            "last_bucket": None,
+            **info,
+        }
+        path = self._path(worker_id)
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            prev = _read_json(path) or {}
+            if (
+                float(prev.get("expires_unix", 0)) >= now
+                and prev.get("pid") != doc["pid"]
+            ):
+                log.warning(
+                    "worker id %s already registered live by pid %s; "
+                    "taking over (newest registration wins)",
+                    worker_id, prev.get("pid"),
+                )
+            _atomic_write_json(path, doc)
+            return doc
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+        log.info("worker %s joined the fleet", worker_id)
+        return doc
+
+    def beat(self, worker_id: str, **updates) -> None:
+        """Renew the lease (and fold in live stats). Missing entry —
+        reaped from under a stalled worker — is re-created: a worker
+        that beats IS alive, whatever the reaper concluded."""
+        path = self._path(worker_id)
+        doc = _read_json(path)
+        if doc is None:
+            self.register(worker_id, **updates)
+            return
+        doc.update(updates)
+        now_unix = time.time()
+        doc["expires_unix"] = now_unix + self.lease_s
+        _atomic_write_json(path, doc)
+
+    def deregister(self, worker_id: str) -> None:
+        """Clean leave: remove the membership entry."""
+        try:
+            os.unlink(self._path(worker_id))
+            log.info("worker %s left the fleet", worker_id)
+        except FileNotFoundError:
+            pass  # reaped already — same outcome
+
+    # --- reading ------------------------------------------------------
+    def entries(self) -> list[dict]:
+        out = []
+        for name in sorted(os.listdir(self.wdir)):
+            if name.endswith(".json"):
+                doc = _read_json(os.path.join(self.wdir, name))
+                if doc:
+                    out.append(doc)
+        return out
+
+    def live(self, now: float | None = None) -> list[dict]:
+        now = time.time() if now is None else now
+        return [
+            e for e in self.entries()
+            if float(e.get("expires_unix", 0)) >= now
+        ]
+
+    # --- reaping ------------------------------------------------------
+    def reap(self, now: float | None = None) -> list[str]:
+        """Unlink expired entries (their worker was SIGKILLed or
+        wedged past its lease). Advisory membership only — the queue's
+        lease reaper owns job recovery — so a lost unlink race is
+        harmless. The same clock.skew chaos seam that drills the queue
+        reaper shifts this reaper's view too."""
+        now = time.time() if now is None else now
+        now += faults.clock_skew_s()
+        reaped = []
+        for name in sorted(os.listdir(self.wdir)):
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(self.wdir, name)
+            doc = _read_json(path)
+            if doc is None or float(doc.get("expires_unix", 0)) >= now:
+                continue
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                continue  # lost the race: already reaped
+            reaped.append(doc.get("worker_id", os.path.splitext(name)[0]))
+            log.warning(
+                "reaped dead worker %s from the fleet registry (lease "
+                "expired %.1fs ago)",
+                doc.get("worker_id"),
+                now - float(doc.get("expires_unix", 0)),
+            )
+        return reaped

@@ -13,12 +13,14 @@ peasoup_tpu.tools.watch <campaign_dir>`` tails it.
 
 from __future__ import annotations
 
+import glob
 import json
 import os
 import tempfile
 import time
 
 from .queue import JobQueue
+from .registry import WorkerRegistry
 
 CAMPAIGN_SCHEMA = "peasoup_tpu.campaign_status"
 CAMPAIGN_VERSION = 1
@@ -121,6 +123,52 @@ def build_status(root: str, queue: JobQueue | None = None) -> dict:
         }
         for q in queue.quarantined()
     ]
+    # fleet membership (campaign/registry.py, read-only here) + the
+    # per-worker throughput derived from done records — live answers
+    # to "who is working" and "who is pulling their weight" for an
+    # elastic fleet where workers join and leave mid-campaign
+    registry = WorkerRegistry(root)
+    live_workers = [
+        {
+            "worker_id": e.get("worker_id"),
+            "hostname": e.get("hostname"),
+            "pid": e.get("pid"),
+            "jobs_done": e.get("jobs_done", 0),
+            "current_job": e.get("current_job"),
+            "last_beat_s": round(
+                max(0.0, now - (
+                    float(e.get("expires_unix", now)) - registry.lease_s
+                )), 3,
+            ),
+        }
+        for e in registry.live(now)
+    ]
+    per_worker: dict[str, dict] = {}
+    for d in done:
+        wid = d.get("worker_id") or "?"
+        rec = per_worker.setdefault(
+            wid, {"done": 0, "first_unix": None, "last_unix": None}
+        )
+        rec["done"] += 1
+        t = float(d.get("finished_unix", 0) or 0)
+        if t:
+            rec["first_unix"] = min(rec["first_unix"] or t, t)
+            rec["last_unix"] = max(rec["last_unix"] or t, t)
+    for rec in per_worker.values():
+        span = (rec["last_unix"] or 0) - (rec["first_unix"] or 0)
+        rec["jobs_per_h"] = (
+            round((rec["done"] - 1) / span * 3600.0, 3)
+            if rec["done"] > 1 and span > 0 else None
+        )
+    degraded_jobs = sum(1 for d in done if d.get("degraded"))
+    # *.corrupt quarantine accumulation (prune with
+    # `peasoup-campaign prune --corrupt`)
+    corrupt_files = len(
+        glob.glob(
+            os.path.join(os.path.abspath(root), "**", "*.corrupt"),
+            recursive=True,
+        )
+    )
     return {
         "schema": CAMPAIGN_SCHEMA,
         "version": CAMPAIGN_VERSION,
@@ -145,6 +193,15 @@ def build_status(root: str, queue: JobQueue | None = None) -> dict:
         "warm_buckets": warm_buckets,
         # what completed jobs survived (resilience/stats.py deltas)
         "resilience": resilience,
+        # elastic fleet view: live membership + per-worker throughput
+        "fleet": {
+            "live": live_workers,
+            "workers": per_worker,
+        },
+        # jobs that completed on a degradation rung (OOM fall-through,
+        # crashed helper thread) and quarantined *.corrupt artifacts
+        "degraded_jobs": degraded_jobs,
+        "corrupt_artifact_files": corrupt_files,
     }
 
 

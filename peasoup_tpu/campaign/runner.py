@@ -47,6 +47,7 @@ from ..obs.heartbeat import Heartbeat
 from ..obs.telemetry import RunTelemetry
 from .db import DB_FILENAME, CandidateDB
 from .queue import Claim, Job, JobQueue, job_id_for
+from .registry import WorkerRegistry
 from .rollup import write_status
 
 log = get_logger("campaign.runner")
@@ -54,7 +55,7 @@ log = get_logger("campaign.runner")
 CAMPAIGN_CONFIG = "campaign.json"
 CAMPAIGN_CONFIG_SCHEMA = "peasoup_tpu.campaign"
 
-PIPELINES = ("search", "spsearch")
+PIPELINES = ("search", "spsearch", "ffa")
 
 
 # --------------------------------------------------------------------------
@@ -247,8 +248,12 @@ def enqueue_entries(
     entries: list[dict],
     pipeline: str,
     ladder: list[int] | None = None,
+    priority: int = 0,
 ) -> int:
-    """Idempotently enqueue manifest entries; returns how many were new."""
+    """Idempotently enqueue manifest entries; returns how many were
+    new. ``priority`` is the default priority class; a per-entry
+    ``"priority"`` in a manifest JSON line overrides it (higher claims
+    sooner — queue.claim_next ranks priority above bucket affinity)."""
     added = 0
     for e in entries:
         inp = e["input"]
@@ -258,6 +263,7 @@ def enqueue_entries(
             pipeline=e.get("pipeline", pipeline),
             config=e.get("config") or {},
             bucket=bucket_for_input(inp, ladder),
+            priority=int(e.get("priority", priority)),
         )
         if job.pipeline not in PIPELINES:
             raise ValueError(
@@ -338,6 +344,7 @@ def run_observation(
     from ..io.output import (
         CandidateFileWriter,
         OutputFileWriter,
+        write_ffa_candidates,
         write_singlepulse,
     )
     from ..io.sigproc import read_filterbank
@@ -374,7 +381,9 @@ def run_observation(
         )
 
     plan_doc = None
-    if tuning_cache and job.bucket:
+    # the dedispersion planner knows the search/spsearch drivers only;
+    # FFA jobs keep their manual knobs
+    if tuning_cache and job.bucket and job.pipeline != "ffa":
         # resolve AFTER the warmer join: the warmer tuned a cold bucket
         # on its thread and persisted the plan, so this is a pure cache
         # hit (zero measurements) for it and for every later job
@@ -426,6 +435,26 @@ def run_observation(
         stats.add_timing_info(result.timers)
         stats.to_file(os.path.join(outdir, "overview.xml"))
         n_cands = len(cands)
+    elif job.pipeline == "ffa":
+        from ..pipeline.ffa import FFAConfig, FFASearch
+
+        cfg = _build_config(FFAConfig, overrides, outdir=outdir)
+        result = FFASearch(cfg).run(fil)
+        result.timers["reading"] = reading
+        tel.merge_timers(result.timers)
+        tel.set_stage("writing")
+        write_ffa_candidates(
+            os.path.join(outdir, "candidates.ffa"), result.candidates
+        )
+        stats = OutputFileWriter()
+        stats.add_misc_info()
+        stats.add_header(fil.header)
+        stats.add_dm_list(result.dm_list)
+        stats.add_device_info()
+        stats.add_ffa_section(cfg, job.input, result.candidates)
+        stats.add_timing_info(result.timers)
+        stats.to_file(os.path.join(outdir, "overview.xml"))
+        n_cands = len(result.candidates)
     else:  # "search" (validated at enqueue)
         from ..pipeline.search import PeasoupSearch, SearchConfig
 
@@ -518,7 +547,7 @@ class _BucketWarmer(threading.Thread):
 
         bucket, pipeline, overrides, scratch_dir, mode = self._args
         tuning = None
-        if self._tuning_cache:
+        if self._tuning_cache and pipeline != "ffa":
             try:
                 from ..perf.tuning import resolve_plan_for_bucket
 
@@ -556,19 +585,23 @@ class _BucketWarmer(threading.Thread):
 
 
 class _LeaseRenewer(threading.Thread):
-    """Daemon renewing the worker's claim at a third of the lease, so
-    only a dead (or wedged-past-lease) worker ever loses a job. The
-    loop body already tolerates per-renewal failures; the crash guard
-    covers everything else (a bug here silently forfeiting leases is
-    exactly the invisible-thread-death failure mode)."""
+    """Daemon renewing the worker's claim (and its fleet-registry
+    heartbeat) at a third of the lease, so only a dead (or
+    wedged-past-lease) worker ever loses a job or drops out of the
+    fleet view. The loop body already tolerates per-renewal failures;
+    the crash guard covers everything else (a bug here silently
+    forfeiting leases is exactly the invisible-thread-death failure
+    mode)."""
 
     def __init__(
-        self, queue: JobQueue, claim: Claim, telemetry=None
+        self, queue: JobQueue, claim: Claim, telemetry=None,
+        registry: "WorkerRegistry | None" = None,
     ) -> None:
         super().__init__(name="campaign-lease", daemon=True)
         self._queue = queue
         self._claim = claim
         self._telemetry = telemetry
+        self._registry = registry
         # NB: not "_stop" — Thread uses that name internally
         self._halt = threading.Event()
 
@@ -584,6 +617,11 @@ class _LeaseRenewer(threading.Thread):
         while not self._halt.wait(period):
             try:
                 self._queue.renew(self._claim)
+                if self._registry is not None:
+                    self._registry.beat(
+                        self._claim.worker_id,
+                        current_job=self._claim.job.job_id,
+                    )
             except Exception:
                 log.debug("lease renewal failed", exc_info=True)
 
@@ -609,6 +647,13 @@ class CampaignRunner:
             backoff_base_s=self.campaign.backoff_base_s,
         )
         self.worker_id = worker_id or JobQueue.default_worker_id()
+        # fleet membership: workers join and leave at will; the
+        # registry's heartbeat files are what rollup/watch render and
+        # what the fleet soak audits for leaks (campaign/registry.py)
+        self.registry = WorkerRegistry(
+            self.root, lease_s=self.campaign.lease_s
+        )
+        self._jobs_done = 0
         self._last_bucket: tuple | None = None
         self._warmed_buckets: set[tuple] = set()
         self._tuning_cache = (
@@ -643,7 +688,9 @@ class CampaignRunner:
         from ..resilience import STATS as _RES_STATS
 
         res_base = _RES_STATS.snapshot()
-        renewer = _LeaseRenewer(self.queue, claim, telemetry=tel)
+        renewer = _LeaseRenewer(
+            self.queue, claim, telemetry=tel, registry=self.registry
+        )
         renewer.start()
         warmer = None
         if (
@@ -724,6 +771,14 @@ class CampaignRunner:
                     res_delta = _RES_STATS.delta_since(res_base)
                     if res_delta:
                         info["resilience"] = res_delta
+                    # a job that descended a degradation ladder (OOM
+                    # fall-through, thread crash) completed DEGRADED:
+                    # correct results, reduced machinery — surfaced in
+                    # the done record so operators can audit the tail
+                    info["degraded"] = bool(
+                        res_delta.get("degradations")
+                        or res_delta.get("thread_crashes")
+                    )
                     tel.set_stage("done")
                     tel.write(manifest_path)
                 except Exception as exc:
@@ -792,34 +847,85 @@ class CampaignRunner:
         """Claim and process jobs until the campaign drains (every job
         terminal), ``max_jobs`` are processed, or — with
         ``drain=False`` — the queue has nothing immediately claimable.
+        Registers in the fleet registry for the duration (heartbeat
+        renewed alongside the claim lease; clean deregistration on any
+        exit path — only a SIGKILL leaves an entry, which peers reap).
         Returns this worker's tally."""
+        from ..resilience import WorkerKilled
+
         tally = {"done": 0, "failed": 0, "quarantined": 0}
         processed = 0
-        while True:
-            if max_jobs is not None and processed >= max_jobs:
-                break
-            claim = self.queue.claim_next(
-                self.worker_id, prefer_bucket=self._last_bucket,
-                warm_buckets=self._warm_bucket_hint(),
-            )
-            if claim is None:
+        self.registry.register(self.worker_id)
+        try:
+            while True:
+                if max_jobs is not None and processed >= max_jobs:
+                    break
+                self.registry.beat(
+                    self.worker_id, jobs_done=self._jobs_done,
+                    current_job=None,
+                )
+                claim = self.queue.claim_next(
+                    self.worker_id, prefer_bucket=self._last_bucket,
+                    warm_buckets=self._warm_bucket_hint(),
+                )
+                if claim is None:
+                    self.registry.reap()
+                    write_status(self.root, self.queue)
+                    if self.queue.drained() or not drain:
+                        break
+                    counts = self.queue.counts()
+                    if counts["total"] == 0:
+                        break
+                    # others are running, or retries back off: wait
+                    time.sleep(poll_s)
+                    continue
+                state = self.process_claim(claim)
+                processed += 1
+                if state == "done":
+                    tally["done"] += 1
+                    self._jobs_done += 1
+                elif state == "quarantined":
+                    tally["quarantined"] += 1
+                else:
+                    tally["failed"] += 1
+                self.registry.beat(
+                    self.worker_id, jobs_done=self._jobs_done,
+                    current_job=None,
+                    last_bucket=(
+                        list(self._last_bucket)
+                        if self._last_bucket else None
+                    ),
+                )
                 write_status(self.root, self.queue)
-                if self.queue.drained() or not drain:
-                    break
-                counts = self.queue.counts()
-                if counts["total"] == 0:
-                    break
-                # others are running, or retries are backing off: wait
-                time.sleep(poll_s)
-                continue
-            state = self.process_claim(claim)
-            processed += 1
-            if state == "done":
-                tally["done"] += 1
-            elif state == "quarantined":
-                tally["quarantined"] += 1
-            else:
-                tally["failed"] += 1
+            # dead peers' membership entries expire within one lease;
+            # reap them on the way out so a drained campaign leaves a
+            # clean registry (the fleet soak's zero-leak invariant)
+            self.registry.reap()
             write_status(self.root, self.queue)
-        write_status(self.root, self.queue)
+        except WorkerKilled:
+            # the simulated SIGKILL: a real kill runs no cleanup, so
+            # the membership entry must stay behind for peers to reap
+            raise
+        except BaseException:
+            self.registry.deregister(self.worker_id)
+            raise
+        self.registry.deregister(self.worker_id)
         return tally
+
+
+def run_worker(
+    root: str,
+    worker_id: str | None = None,
+    max_jobs: int | None = None,
+    drain: bool = True,
+    poll_s: float = 1.0,
+) -> dict:
+    """THE worker entry point: one call makes this process a campaign
+    worker (fleet registration, warmup-aware claiming, per-job
+    observability, rollup writes) until it leaves. The CLI
+    (``peasoup-campaign run``), the in-process chaos soak, and the
+    fleet soak's real subprocesses all enter through here, so every
+    soak exercises exactly the code a production worker runs."""
+    return CampaignRunner(root, worker_id=worker_id).run(
+        max_jobs=max_jobs, drain=drain, poll_s=poll_s
+    )

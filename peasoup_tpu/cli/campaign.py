@@ -69,8 +69,13 @@ def build_parser() -> argparse.ArgumentParser:
                      help="enqueue every *.fil under this directory "
                      "instead of (or in addition to) --manifest")
     run.add_argument("--pipeline", default="spsearch",
-                     choices=["search", "spsearch"],
+                     choices=["search", "spsearch", "ffa"],
                      help="which pipeline each job runs (default spsearch)")
+    run.add_argument("--priority", type=int, default=0,
+                     help="priority class for the observations enqueued "
+                     "by THIS invocation (higher claims sooner; a "
+                     "per-entry 'priority' in a JSON manifest line "
+                     "overrides; default 0)")
     run.add_argument("--config", default=None,
                      help="pipeline config overrides as inline JSON or "
                      "@file.json (keys = SearchConfig/SinglePulseConfig "
@@ -148,6 +153,22 @@ def build_parser() -> argparse.ArgumentParser:
         "the sqlite candidate database",
     )
     ing.add_argument("-w", "--workdir", required=True)
+
+    pr = sub.add_parser(
+        "prune", help="delete quarantined artifacts (the *.corrupt "
+        "forensics renamed aside by the resilience layer accumulate "
+        "forever otherwise)",
+    )
+    pr.add_argument("-w", "--workdir", required=True)
+    pr.add_argument("--corrupt", action="store_true",
+                    help="prune *.corrupt quarantine files (the only "
+                    "prunable class today; the flag keeps the verb "
+                    "explicit)")
+    pr.add_argument("--older-than-days", type=float, default=0.0,
+                    help="only prune quarantine files older than N "
+                    "days (default 0 = all)")
+    pr.add_argument("--dry-run", action="store_true",
+                    help="list what would be deleted without deleting")
     return p
 
 
@@ -156,9 +177,9 @@ def _cmd_run(args) -> int:
     from ..campaign.rollup import write_status
     from ..campaign.runner import (
         CampaignConfig,
-        CampaignRunner,
         enqueue_entries,
         parse_manifest,
+        run_worker,
         save_campaign_config,
     )
     from ..obs import configure_logging
@@ -203,7 +224,8 @@ def _cmd_run(args) -> int:
             )
         )
     added = enqueue_entries(
-        queue, entries, campaign.pipeline, campaign.bucket_nsamps
+        queue, entries, campaign.pipeline, campaign.bucket_nsamps,
+        priority=args.priority,
     )
     counts = queue.counts()
     print(
@@ -213,8 +235,10 @@ def _cmd_run(args) -> int:
     if counts["total"] == 0:
         print("nothing to do (empty campaign)")
         return 1
-    runner = CampaignRunner(args.workdir, worker_id=args.worker_id)
-    tally = runner.run(
+    worker_id = args.worker_id or JobQueue.default_worker_id()
+    tally = run_worker(
+        args.workdir,
+        worker_id=worker_id,
         max_jobs=args.max_jobs,
         drain=not args.no_drain,
         poll_s=args.poll,
@@ -222,7 +246,7 @@ def _cmd_run(args) -> int:
     status = write_status(args.workdir, queue)
     q = status["queue"]
     print(
-        f"worker {runner.worker_id}: {tally['done']} done, "
+        f"worker {worker_id}: {tally['done']} done, "
         f"{tally['failed']} failed, {tally['quarantined']} quarantined "
         f"(campaign: {q['done']}/{q['total']} done, "
         f"{q['quarantined']} quarantined)"
@@ -320,6 +344,47 @@ def _cmd_ingest(args) -> int:
     return 0
 
 
+def _cmd_prune(args) -> int:
+    import time
+
+    if not args.corrupt:
+        print(
+            "prune: nothing selected (pass --corrupt to prune the "
+            "*.corrupt quarantine files)"
+        )
+        return 1
+    root = os.path.abspath(args.workdir)
+    now_unix = time.time()
+    cutoff = now_unix - args.older_than_days * 86400.0
+    selected = []
+    for path in sorted(
+        glob.glob(os.path.join(root, "**", "*.corrupt"), recursive=True)
+    ):
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            continue  # pruned by a racing invocation
+        if mtime <= cutoff:
+            selected.append(path)
+    verb = "would delete" if args.dry_run else "deleted"
+    for path in selected:
+        if not args.dry_run:
+            try:
+                os.unlink(path)
+            except OSError as exc:
+                print(f"prune: {path}: {exc}")
+                continue
+        print(f"prune: {verb} {path}")
+    print(
+        f"prune: {verb} {len(selected)} quarantined artifact(s)"
+        + (
+            f" older than {args.older_than_days:g} day(s)"
+            if args.older_than_days else ""
+        )
+    )
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     return {
@@ -328,6 +393,7 @@ def main(argv: list[str] | None = None) -> int:
         "retry": _cmd_retry,
         "quarantine-list": _cmd_quarantine_list,
         "ingest": _cmd_ingest,
+        "prune": _cmd_prune,
     }[args.cmd](args)
 
 

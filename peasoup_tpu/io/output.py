@@ -101,6 +101,24 @@ def write_singlepulse(path: str, candidates: Sequence) -> str:
     return path
 
 
+# .ffa column order: the FFACandidate fields, self-describing like the
+# .singlepulse table
+FFA_COLUMNS = ("period", "dm", "snr", "width", "duty_cycle")
+
+
+def write_ffa_candidates(path: str, candidates: Sequence) -> str:
+    """Write FFACandidates as a whitespace-delimited text table (one
+    row per period-collapsed candidate, sorted as given)."""
+    with open(path, "w", encoding="ascii") as f:
+        f.write("# " + " ".join(FFA_COLUMNS) + "\n")
+        for c in candidates:
+            f.write(
+                f"{c.period:.9f} {c.dm:.6f} {c.snr:.4f} {c.width:d} "
+                f"{c.dc:.6f}\n"
+            )
+    return path
+
+
 class OutputFileWriter:
     def __init__(self):
         self.root = Element("peasoup_search")
@@ -237,6 +255,44 @@ class OutputFileWriter:
             e.append(Element("ddm_snr_ratio", float(np.float32(c.ddm_snr_ratio))))
             e.append(Element("nassoc", c.count_assoc()))
             e.append(Element("byte_offset", byte_map.get(ii, 0)))
+            cands.append(e)
+
+    def add_ffa_section(
+        self, cfg, infilename: str, candidates: Sequence
+    ) -> None:
+        """FFA search parameters + candidates. The ``<candidates>``
+        entries carry the periodicity field set (period/opt_period/
+        dm/acc/nh/snr/folded_snr — acc and nh vacuous for an FFA
+        detection) so tools.parsers.OverviewFile and the campaign DB
+        ingest read FFA jobs through the existing periodicity path,
+        plus the FFA-specific width/duty_cycle extras."""
+        s = self.root.append(Element("ffa_search_parameters"))
+        s.append(Element("infilename", infilename))
+        s.append(Element("outdir", cfg.outdir))
+        s.append(Element("killfilename", cfg.killfilename))
+        s.append(Element("dm_start", float(np.float32(cfg.dm_start))))
+        s.append(Element("dm_end", float(np.float32(cfg.dm_end))))
+        s.append(Element("dm_tol", float(np.float32(cfg.dm_tol))))
+        s.append(
+            Element("dm_pulse_width", float(np.float32(cfg.dm_pulse_width)))
+        )
+        s.append(Element("p_start", float(np.float32(cfg.p_start))))
+        s.append(Element("p_end", float(np.float32(cfg.p_end))))
+        s.append(Element("min_dc", float(np.float32(cfg.min_dc))))
+        s.append(Element("min_snr", float(np.float32(cfg.min_snr))))
+        cands = self.root.append(Element("candidates"))
+        for ii, c in enumerate(candidates):
+            e = Element("candidate")
+            e.add_attribute("id", ii)
+            e.append(Element("period", float(c.period)))
+            e.append(Element("opt_period", float(c.period)))
+            e.append(Element("dm", float(np.float32(c.dm))))
+            e.append(Element("acc", 0.0))
+            e.append(Element("nh", 0))
+            e.append(Element("snr", float(np.float32(c.snr))))
+            e.append(Element("folded_snr", 0.0))
+            e.append(Element("width", int(c.width)))
+            e.append(Element("duty_cycle", float(np.float32(c.dc))))
             cands.append(e)
 
     def add_single_pulse_section(

@@ -35,9 +35,44 @@ from jax.sharding import Mesh
 
 from ..obs import get_logger
 from ..obs.telemetry import current as current_telemetry
+from ..resilience import TransientIOError, faults
 from .mesh import make_mesh
 
 log = get_logger("parallel.multihost")
+
+# message fragments that identify a *distributed-runtime* failure (a
+# peer died at the barrier, the coordinator timed out, a DCN link
+# dropped) as opposed to a programming error inside the collective.
+# jaxlib raises one runtime-error type for every status code, so the
+# contract available is the ABSL status text.
+_COLLECTIVE_TRANSIENT_TOKENS = (
+    "DEADLINE_EXCEEDED",
+    "UNAVAILABLE",
+    "ABORTED",
+    "connection",
+    "heartbeat",
+    "barrier",
+    "coordination service",
+    "shutting down",
+)
+
+
+def _classify_collective_error(exc: Exception, context: str) -> None:
+    """Re-raise a collective failure as TRANSIENT when it carries a
+    distributed-runtime signature: a host dying at the allgather
+    barrier must fail the step fast — classified transient so the
+    campaign attempt budget retries it — never hang or read as a
+    programming error. Anything else propagates unchanged."""
+    msg = str(exc)
+    low = msg.lower()
+    if any(t.lower() in low for t in _COLLECTIVE_TRANSIENT_TOKENS):
+        import errno as _errno
+
+        raise TransientIOError(
+            _errno.ECONNRESET,
+            f"multihost collective failed ({context}): {msg:.300}",
+        ) from exc
+    raise exc
 
 
 def initialize(
@@ -107,23 +142,52 @@ def dm_slice_for_process(
     return lo, lo + base + (1 if process_id < extra else 0)
 
 
-def _allgather_pickled(payload: bytes) -> list[bytes]:
+def _allgather_pickled(payload: bytes, context: str = "") -> list[bytes]:
     """Exchange one pickled blob per process; returns every process's
-    blob in process order. Single-process: identity."""
+    blob in process order. Single-process: identity.
+
+    ``multihost.barrier`` is this collective's fault seam: a scheduled
+    injection (or a real peer death surfacing as a distributed-runtime
+    error) raises TRANSIENT here, so the step fails fast into the
+    campaign retry budget instead of hanging at the barrier."""
+    faults.fire("multihost.barrier", context=context)
     if jax.process_count() == 1:
         return [payload]
     import numpy as np
     from jax.experimental import multihost_utils
 
-    # fixed-size exchange: lengths first, then the padded byte arrays
-    n = np.frombuffer(payload, dtype=np.uint8)
-    lens = multihost_utils.process_allgather(
-        np.asarray([n.size], dtype=np.int64)
-    ).reshape(-1)
-    padded = np.zeros(int(lens.max()), dtype=np.uint8)
-    padded[: n.size] = n
-    blobs = multihost_utils.process_allgather(padded)
-    return [bytes(blobs[i, : int(lens[i])]) for i in range(len(lens))]
+    try:
+        # fixed-size exchange: lengths first, then the padded arrays
+        n = np.frombuffer(payload, dtype=np.uint8)
+        lens = multihost_utils.process_allgather(
+            np.asarray([n.size], dtype=np.int64)
+        ).reshape(-1)
+        padded = np.zeros(int(lens.max()), dtype=np.uint8)
+        padded[: n.size] = n
+        blobs = multihost_utils.process_allgather(padded)
+        return [bytes(blobs[i, : int(lens[i])]) for i in range(len(lens))]
+    except TransientIOError:
+        raise
+    except Exception as exc:
+        _classify_collective_error(exc, context or "allgather")
+        raise  # unreachable (classify always raises); keeps mypy honest
+
+
+def _unpickle_all(blobs: list[bytes], context: str = "") -> list:
+    """Deserialise every process's blob — the merge step shared by the
+    search/single-pulse/survey-fold drivers, and the ``multihost.merge``
+    fault seam: a torn or injected failure while combining per-host
+    results classifies TRANSIENT (the step re-runs whole)."""
+    import pickle
+
+    faults.fire("multihost.merge", context=context)
+    try:
+        return [pickle.loads(b) for b in blobs]
+    except TransientIOError:
+        raise
+    except Exception as exc:
+        _classify_collective_error(exc, context or "merge")
+        raise
 
 
 def run_search(fil, config):
@@ -171,11 +235,12 @@ def run_search(fil, config):
     part = search.run(fil, dm_slice=(lo, hi), finalize=False)
 
     blobs = _allgather_pickled(
-        pickle.dumps((part.cands, part.n_accel_trials))
+        pickle.dumps((part.cands, part.n_accel_trials)),
+        context="search:candidates",
     )
     merged_cands, n_trials = [], 0
-    for blob in blobs:  # process order == ascending DM slices
-        cands, n = pickle.loads(blob)
+    # process order == ascending DM slices
+    for cands, n in _unpickle_all(blobs, context="search:candidates"):
         merged_cands.extend(cands)
         n_trials += n
     merged = PartialSearchResult(
@@ -194,8 +259,11 @@ def run_search(fil, config):
 
     def fold_exchange(outcomes: list[dict]) -> list[dict]:
         out = []
-        for blob in _allgather_pickled(pickle.dumps(outcomes)):
-            out.extend(pickle.loads(blob))
+        blobs = _allgather_pickled(
+            pickle.dumps(outcomes), context="search:folds"
+        )
+        for piece in _unpickle_all(blobs, context="search:folds"):
+            out.extend(piece)
         return out
 
     return search.finalize(fil, merged, fold_exchange=fold_exchange)
@@ -253,11 +321,11 @@ def run_single_pulse_search(fil, config):
     import numpy as np
 
     blobs = _allgather_pickled(
-        pickle.dumps((part.events, part.n_overflowed))
+        pickle.dumps((part.events, part.n_overflowed)),
+        context="spsearch:events",
     )
     all_events, n_overflowed = [], 0
-    for blob in blobs:
-        ev, novf = pickle.loads(blob)
+    for ev, novf in _unpickle_all(blobs, context="spsearch:events"):
         all_events.append(ev)
         n_overflowed += int(novf)
     merged = PartialSinglePulseResult(
@@ -301,8 +369,11 @@ def run_survey_fold(observations, folder) -> list[dict]:
     )
     outcomes = folder.fold_outcomes(mine)
     merged: list[dict] = []
-    for blob in _allgather_pickled(pickle.dumps(outcomes)):
-        merged.extend(pickle.loads(blob))
+    blobs = _allgather_pickled(
+        pickle.dumps(outcomes), context="survey_fold:outcomes"
+    )
+    for piece in _unpickle_all(blobs, context="survey_fold:outcomes"):
+        merged.extend(piece)
     return merged
 
 

@@ -194,13 +194,9 @@ def warm_registry(
         fn, args, kwargs = built
         t0 = time.perf_counter()
         with _sink_scope() as sink:
-            try:
-                if not hasattr(fn, "lower"):
-                    fn = jax.jit(fn)
-                fn.lower(*args, **kwargs).compile()
-                err = None
-            except Exception as exc:
-                err = f"{type(exc).__name__}: {exc!s:.300}"
+            err = _compile_with_cache_recovery(
+                jax, fn, args, kwargs, spec.name, cache_dir
+            )
         report.programs.append(
             ProgramWarmup(
                 name=spec.name,
@@ -213,6 +209,43 @@ def warm_registry(
         )
     report.seconds = time.perf_counter() - t_all
     return report
+
+
+def _compile_with_cache_recovery(
+    jax, fn, args, kwargs, name: str, cache_dir: str | None
+) -> str | None:
+    """One program's lower+compile with the ``cache.corrupt`` recovery:
+    a failure classified CORRUPT (an injected garbled entry, or a real
+    torn cache deserialisation) quarantines the persistent cache's
+    entries to ``*.corrupt`` and recompiles once from scratch — warmup
+    degrades to a cold compile, never to a crash. Returns the error
+    string (None on success, including success-after-recovery)."""
+    from ..resilience import CORRUPT, classify, faults
+    from ..utils.cache import quarantine_cache_entries
+
+    for attempt in (1, 2):
+        try:
+            # the cache.corrupt seam: deterministic injection point for
+            # "a garbled persistent-cache entry broke this compile"
+            faults.fire("cache.corrupt", context=f"warmup:{name}")
+            if not hasattr(fn, "lower"):
+                fn = jax.jit(fn)
+            fn.lower(*args, **kwargs).compile()
+            return None
+        except Exception as exc:
+            suspect_cache = classify(exc) == CORRUPT or (
+                "cache" in str(exc).lower() and "deserial" in str(exc).lower()
+            )
+            if attempt == 1 and suspect_cache:
+                quarantined = quarantine_cache_entries(cache_dir)
+                log.warning(
+                    "warmup of %s hit a corrupt compilation-cache entry "
+                    "(%.200s); quarantined %d entries to *.corrupt and "
+                    "recompiling", name, exc, len(quarantined),
+                )
+                continue
+            return f"{type(exc).__name__}: {exc!s:.300}"
+    return None  # unreachable; the loop returns on both paths
 
 
 # --------------------------------------------------------------------------
@@ -241,6 +274,14 @@ def shape_ctx_for_bucket(bucket, pipeline: str, overrides: dict):
         from ..pipeline.search import SearchConfig
 
         base_cls = SearchConfig
+    elif pipeline == "ffa":
+        # FFA shares only the dedispersion front end with the other
+        # pipelines; its ctx carries the DM-plan geometry (the
+        # dedisperse/unpack hooks build from it) and the staircase
+        # programs trace on the dryrun
+        from ..pipeline.ffa import FFAConfig
+
+        base_cls = FFAConfig
     cfg = _filtered_config(base_cls, overrides)
     plan = DMPlan.create(
         nsamps=int(nsamps), nchans=int(nchans), tsamp=float(tsamp),
@@ -453,6 +494,14 @@ def _dryrun_pipeline(pipeline: str, overrides: dict, outdir, fil) -> None:
             checkpoint_file="",
         )
         SinglePulseSearch(cfg).run(fil)
+    elif pipeline == "ffa":
+        from ..pipeline.ffa import FFAConfig, FFASearch
+
+        cfg = _filtered_config(
+            FFAConfig, overrides, outdir=str(outdir),
+            checkpoint_file="",
+        )
+        FFASearch(cfg).run(fil)
     else:  # "search"
         from ..pipeline.search import PeasoupSearch, SearchConfig
 

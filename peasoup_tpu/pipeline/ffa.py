@@ -1,0 +1,149 @@
+"""Host-side FFA search driver: the folding-algorithm workload as a
+campaign-dispatchable pipeline.
+
+The FFA search itself lives in ops/ffa.py (the staircase transform and
+the octave walk); until now its only front end was the ``peasoup-ffa``
+CLI, which meant the campaign layer could not run FFA jobs through the
+bucket/warmup/telemetry machinery the other two pipelines share. This
+driver mirrors the SinglePulseSearch/PeasoupSearch shape — a config
+dataclass the runner's ``_build_config`` validates loudly, a
+``build_dm_plan`` the warmup ctx derivation can call, stage/progress
+telemetry for the heartbeat — so ``pipeline: ffa`` in a job or
+manifest record behaves exactly like ``search``/``spsearch``: same
+claiming, same shape buckets, same done-record accounting, same
+rollup.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..io.masks import read_killfile
+from ..io.sigproc import Filterbank
+from ..obs import get_logger
+from ..obs.telemetry import current as current_telemetry
+from ..ops.dedisperse import dedisperse, fil_to_device, output_scale
+from ..plan.dm_plan import DMPlan
+
+log = get_logger("pipeline.ffa")
+
+
+@dataclass
+class FFAConfig:
+    """FFA search knobs (reference: FFACmdLineOptions,
+    include/utils/cmdline.hpp:211-292, whose implementing pipeline is
+    absent from the reference tree — ops/ffa.py is the real one)."""
+
+    outdir: str = "."
+    killfilename: str = ""
+    limit: int = 1000
+    dm_start: float = 0.0
+    dm_end: float = 100.0
+    dm_tol: float = 1.10
+    dm_pulse_width: float = 64.0
+    p_start: float = 0.8  # shortest folded period (s)
+    p_end: float = 20.0  # longest folded period (s)
+    min_dc: float = 0.001  # minimum duty cycle (fraction)
+    min_snr: float = 8.0
+    verbose: bool = False
+    progress_bar: bool = False
+    # accepted for campaign config symmetry with the other pipelines
+    # (FFA octaves re-fold from scratch; there is no per-trial resume)
+    checkpoint_file: str = ""
+
+
+@dataclass
+class FFAResult:
+    candidates: list  # FFACandidate records, period-collapsed
+    dm_list: np.ndarray
+    timers: dict
+    nsamps: int
+
+
+class FFASearch:
+    """Dedisperse the DM plan, then staircase-FFA every trial."""
+
+    def __init__(self, config: FFAConfig):
+        self.config = config
+
+    def build_dm_plan(self, fil: Filterbank) -> DMPlan:
+        cfg = self.config
+        killmask = None
+        if cfg.killfilename:
+            killmask = read_killfile(cfg.killfilename, fil.nchans)
+        return DMPlan.create(
+            nsamps=fil.nsamps,
+            nchans=fil.nchans,
+            tsamp=fil.tsamp,
+            fch1=fil.fch1,
+            foff=fil.foff,
+            dm_start=cfg.dm_start,
+            dm_end=cfg.dm_end,
+            pulse_width=cfg.dm_pulse_width,
+            tol=cfg.dm_tol,
+            killmask=killmask,
+        )
+
+    def run(self, fil: Filterbank) -> FFAResult:
+        from ..ops.ffa import ffa_search_block
+
+        cfg = self.config
+        tel = current_telemetry()
+        timers: dict[str, float] = {}
+        t_total = time.perf_counter()
+
+        t0 = time.perf_counter()
+        tel.set_stage("plan")
+        dm_plan = self.build_dm_plan(fil)
+        timers["plan"] = time.perf_counter() - t0
+        tel.gauge("ffa.n_dm_trials", int(dm_plan.ndm))
+        tel.event(
+            "ffa_plan", ndm=int(dm_plan.ndm),
+            p_start=float(cfg.p_start), p_end=float(cfg.p_end),
+            min_dc=float(cfg.min_dc),
+        )
+
+        # trials are consumed on the host (one FFA staircase per DM
+        # trial), so use the host-resident dedisperse variant: HBM
+        # holds one block at a time (cli/ffa.py's deployment choice)
+        t0 = time.perf_counter()
+        tel.set_stage("dedispersion")
+        trials = dedisperse(
+            fil_to_device(fil),
+            dm_plan.delay_samples(),
+            dm_plan.killmask,
+            dm_plan.out_nsamps,
+            scale=output_scale(fil.nbits, int(dm_plan.killmask.sum())),
+        )
+        timers["dedispersion"] = time.perf_counter() - t0
+        tel.capture_device_memory("dedispersion")
+
+        t0 = time.perf_counter()
+        tel.set_stage("ffa_search")
+
+        def on_progress(f: float) -> None:
+            tel.set_progress(round(f * 100.0, 3), 100.0, unit="%")
+
+        cands = ffa_search_block(
+            trials, fil.tsamp, cfg.p_start, cfg.p_end, cfg.min_dc,
+            dm_plan.dm_list, snr_min=cfg.min_snr, progress=on_progress,
+        )
+        timers["ffa_search"] = time.perf_counter() - t0
+        tel.capture_device_memory("ffa_search")
+
+        out = cands[: cfg.limit]
+        timers["total"] = time.perf_counter() - t_total
+        tel.gauge("candidates.final", len(out))
+        log.info(
+            "FFA search: %d DM trials -> %d period-collapsed candidates",
+            dm_plan.ndm, len(out),
+        )
+        return FFAResult(
+            candidates=out,
+            dm_list=dm_plan.dm_list,
+            timers=timers,
+            nsamps=fil.nsamps,
+        )

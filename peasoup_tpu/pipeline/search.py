@@ -1035,11 +1035,18 @@ class PeasoupSearch:
         from ..resilience import DegradationLadder, faults
 
         # the memory degradation ladder: halving dm_block is one rung,
-        # stepped repeatedly; falling off the bottom (blocks already at
-        # the device count) is explicit exhaustion, and the error
-        # propagates to the campaign attempt budget
-        ladder = DegradationLadder("search.memory", ("dm_block_shrink",))
+        # stepped repeatedly; at the floor the run falls THROUGH —
+        # first to an exact (max_smear=0, bitwise-equal) subband
+        # dedispersion with host-spilled trials, freeing the
+        # device-resident trial block, then to the CPU backend (host
+        # RAM dwarfs HBM; slow beats dead). Exhaustion below the CPU
+        # rung propagates to the campaign attempt budget.
+        ladder = DegradationLadder(
+            "search.memory", ("dm_block_shrink", "subband", "cpu_backend")
+        )
         shrink = 1
+        cpu_mode = False
+        fell_subband = False
         while True:
             chunks = build_chunks(shrink)
             waves = build_waves(chunks)
@@ -1047,16 +1054,33 @@ class PeasoupSearch:
                 "wave_plan", n_waves=len(waves), n_chunks=len(chunks),
                 shrink=shrink,
                 max_dm_block=max((d for _, d in chunks), default=0),
+                backend="cpu" if cpu_mode else "default",
             )
             try:
-                faults.fire("device.oom", context=f"search:shrink{shrink}")
-                self._run_waves(
-                    waves, len(chunks), per_dm_results, ckpt,
-                    progress, build_search, dispatch_lists,
-                    trials, tim_len, zapmask_dev, windows,
-                    size=size, nsamps_valid=nsamps_valid, pos5=pos5,
-                    pos25=pos25, tsamp=fil.tsamp,
+                faults.fire(
+                    "device.oom",
+                    context=(
+                        "search:cpu" if cpu_mode
+                        else f"search:shrink{shrink}"
+                    ),
                 )
+                if cpu_mode:
+                    with jax.default_device(jax.devices("cpu")[0]):
+                        self._run_waves(
+                            waves, len(chunks), per_dm_results, ckpt,
+                            progress, build_search, dispatch_lists,
+                            trials, tim_len, zapmask_dev, windows,
+                            size=size, nsamps_valid=nsamps_valid,
+                            pos5=pos5, pos25=pos25, tsamp=fil.tsamp,
+                        )
+                else:
+                    self._run_waves(
+                        waves, len(chunks), per_dm_results, ckpt,
+                        progress, build_search, dispatch_lists,
+                        trials, tim_len, zapmask_dev, windows,
+                        size=size, nsamps_valid=nsamps_valid, pos5=pos5,
+                        pos25=pos25, tsamp=fil.tsamp,
+                    )
                 break
             except Exception as exc:
                 # device OOM: the per-cell working-set heuristic is an
@@ -1065,26 +1089,119 @@ class PeasoupSearch:
                 max_blk = max(d for _, d in chunks)
                 if not _is_oom(exc):
                     raise
-                if max_blk <= len(devices):
-                    ladder.exhausted(
-                        dm_block=max_blk, error=f"{exc!s:.200}"
+                if max_blk > (1 if cpu_mode else len(devices)):
+                    shrink *= 2
+                    new_blk = max(d for _, d in build_chunks(shrink))
+                    log.warning(
+                        "device OOM at dm_block=%d; retrying with "
+                        "half-size blocks (dm_block=%d): %.200s",
+                        max_blk, new_blk, exc,
                     )
-                    raise
-                shrink *= 2
-                new_blk = max(d for _, d in build_chunks(shrink))
-                log.warning(
-                    "device OOM at dm_block=%d; retrying with half-size "
-                    "blocks (dm_block=%d): %.200s", max_blk, new_blk, exc,
-                )
-                tel.event(
-                    "oom_shrink_retry", dm_block_old=max_blk,
-                    dm_block_new=new_blk, shrink=shrink,
-                    error=f"{exc!s:.200}",
-                )
-                ladder.step(
-                    "dm_block_shrink", dm_block_old=max_blk,
-                    dm_block_new=new_blk, error=f"{exc!s:.200}",
-                )
+                    tel.event(
+                        "oom_shrink_retry", dm_block_old=max_blk,
+                        dm_block_new=new_blk, shrink=shrink,
+                        error=f"{exc!s:.200}",
+                    )
+                    # in-rung shrinks after a fall-through rung keep
+                    # the event trail but not a ladder step (a ladder
+                    # never climbs back up)
+                    if ladder.current_rung in (None, "dm_block_shrink"):
+                        ladder.step(
+                            "dm_block_shrink", dm_block_old=max_blk,
+                            dm_block_new=new_blk, error=f"{exc!s:.200}",
+                        )
+                    continue
+                if (
+                    not cpu_mode
+                    and not fell_subband
+                    and subbands == 0
+                    and not skip_dedisp
+                    and fil.nchans > 1
+                ):
+                    # subband rung: re-dedisperse two-stage at
+                    # max_smear=0 (BITWISE the direct sum — every group
+                    # shares identical delays) with the trial block
+                    # spilled to host RAM, so HBM holds one chunk at a
+                    # time instead of the whole (ndm, out_nsamps) block.
+                    # Block sizing restarts: the rung changed the
+                    # memory regime, and re-running at the original
+                    # dm_block keeps the successful attempt's chunk
+                    # shapes — and therefore its bits — identical to an
+                    # untroubled run's.
+                    fell_subband = True
+                    shrink = 1
+                    nsub = max(2, int(round(math.sqrt(fil.nchans))))
+                    log.warning(
+                        "device OOM with dm_block at the floor (%d); "
+                        "falling through to exact subband dedispersion "
+                        "(nsub=%d, host-spilled trials): %.200s",
+                        max_blk, nsub, exc,
+                    )
+                    trials = dedisperse_subband(
+                        fil_to_device(fil),
+                        dm_plan.delay_samples(),
+                        dm_plan.killmask,
+                        dm_plan.out_nsamps,
+                        nsub=nsub,
+                        max_smear=0.0,
+                        scale=scale,
+                        to_host=True,
+                    )
+                    spill = True
+                    self._trials_sharded = False
+                    tel.event(
+                        "oom_subband_fallback", nsub=nsub,
+                        dm_block=max_blk, error=f"{exc!s:.200}",
+                    )
+                    ladder.step(
+                        "subband", nsub=nsub, error=f"{exc!s:.200}"
+                    )
+                    continue
+                if not cpu_mode:
+                    # CPU rung: host-resident trials, single-device jnp
+                    # programs (the Pallas kernels and the mesh are
+                    # device-side optimisations, both bitwise-gated);
+                    # block sizing restarts like the subband rung's
+                    cpu_mode = True
+                    shrink = 1
+                    trials = np.asarray(trials)
+                    spill = True
+                    self._trials_sharded = False
+                    self._dm_sharding = None
+                    self._mesh = None
+                    self._cur_pallas_block = 0
+                    self._pallas_peaks = False
+                    self._mega_harm = False
+                    self._fused_interbin = False
+                    self._fused_dft = False
+                    zapmask_dev = np.asarray(zapmask_dev)
+                    windows = np.asarray(windows)
+
+                    def build_search(pb: int, pp: bool = False):
+                        return make_batched_search_fn(
+                            cfg.min_snr, 0, select_smax,
+                            pallas_peaks=False, fused_interbin=False,
+                            mega_harm=False, fused_dft=False,
+                        )
+
+                    self._build_search = build_search
+                    self._active_search_block = build_search(0)
+                    log.warning(
+                        "device OOM after the subband fall-through; "
+                        "retrying the search on the CPU backend: %.200s",
+                        exc,
+                    )
+                    tel.event(
+                        "oom_cpu_fallback", dm_block=max_blk,
+                        error=f"{exc!s:.200}",
+                    )
+                    ladder.step(
+                        "cpu_backend", dm_block=max_blk,
+                        error=f"{exc!s:.200}",
+                    )
+                    continue
+                ladder.exhausted(dm_block=max_blk, error=f"{exc!s:.200}")
+                raise
         if progress:
             progress.stop()
         timers["search_device"] = time.perf_counter() - t0

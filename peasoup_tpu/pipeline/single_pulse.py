@@ -534,8 +534,17 @@ class SinglePulseSearch:
 
         from ..resilience import DegradationLadder, faults
 
-        ladder = DegradationLadder("spsearch.memory", ("dm_block_shrink",))
+        # the memory ladder: halve dm_block (repeatable rung), and when
+        # the blocks are already at the floor fall THROUGH to the CPU
+        # backend (host RAM dwarfs HBM; slow beats dead) instead of
+        # raising — candidates stay bitwise-equal because the per-trial
+        # program is shape-identical and the Pallas kernels are gated on
+        # bitwise equality with their jnp twins
+        ladder = DegradationLadder(
+            "spsearch.memory", ("dm_block_shrink", "cpu_backend")
+        )
         shrink = 1
+        cpu_mode = False
         while True:
             blk = max(
                 n_dev if n_dev > 1 else 1, dm_block // shrink
@@ -548,37 +557,78 @@ class SinglePulseSearch:
             ]
             tel.event(
                 "sp_wave_plan", n_chunks=len(chunks), dm_block=blk,
-                shrink=shrink, pallas_span=pallas_span,
+                shrink=shrink, pallas_span=self._pallas_span,
+                backend="cpu" if cpu_mode else "default",
             )
             try:
                 faults.fire(
-                    "device.oom", context=f"spsearch:shrink{shrink}"
+                    "device.oom",
+                    context=(
+                        "spsearch:cpu" if cpu_mode
+                        else f"spsearch:shrink{shrink}"
+                    ),
                 )
-                self._run_waves(
-                    chunks, blk, trials, per_dm, ckpt, widths,
-                    sharding=sharding, spill=spill,
-                )
+                if cpu_mode:
+                    with jax.default_device(jax.devices("cpu")[0]):
+                        self._run_waves(
+                            chunks, blk, trials, per_dm, ckpt, widths,
+                            sharding=None, spill=True,
+                        )
+                else:
+                    self._run_waves(
+                        chunks, blk, trials, per_dm, ckpt, widths,
+                        sharding=sharding, spill=spill,
+                    )
                 break
             except Exception as exc:
                 if not _is_oom(exc):
                     raise
-                if blk <= max(1, n_dev):
+                if blk > max(1, n_dev):
+                    shrink *= 2
+                    log.warning(
+                        "device OOM at dm_block=%d; retrying with "
+                        "dm_block=%d: %.200s", blk,
+                        max(1, dm_block // shrink), exc,
+                    )
+                    tel.event(
+                        "sp_oom_shrink_retry", dm_block_old=blk,
+                        shrink=shrink, error=f"{exc!s:.200}",
+                    )
+                    # once a later rung stepped, in-rung shrinks keep
+                    # the event trail but not a ladder step (a ladder
+                    # never climbs back up)
+                    if ladder.current_rung in (None, "dm_block_shrink"):
+                        ladder.step(
+                            "dm_block_shrink", dm_block_old=blk,
+                            dm_block_new=max(1, dm_block // shrink),
+                            error=f"{exc!s:.200}",
+                        )
+                    continue
+                if cpu_mode:
+                    # nothing below the CPU rung
                     ladder.exhausted(dm_block=blk, error=f"{exc!s:.200}")
                     raise
-                shrink *= 2
+                # shrink exhausted: fall through to the CPU backend.
+                # The rung is a new memory regime (host RAM), so block
+                # sizing restarts from the top — which also keeps the
+                # successful attempt's per-chunk shapes identical to an
+                # untroubled run's (the bitwise-equality guarantee).
+                cpu_mode = True
+                shrink = 1
+                trials = np.asarray(trials)  # host-resident input
+                n_dev = 1
+                self._pallas_span = 0  # TPU kernel is moot on CPU
                 log.warning(
-                    "device OOM at dm_block=%d; retrying with "
-                    "dm_block=%d: %.200s", blk, max(1, dm_block // shrink),
-                    exc,
+                    "device OOM with dm_block already at the floor "
+                    "(%d); falling through to the CPU backend: %.200s",
+                    blk, exc,
                 )
                 tel.event(
-                    "sp_oom_shrink_retry", dm_block_old=blk,
-                    shrink=shrink, error=f"{exc!s:.200}",
+                    "sp_oom_cpu_fallback", dm_block=blk,
+                    error=f"{exc!s:.200}",
                 )
                 ladder.step(
-                    "dm_block_shrink", dm_block_old=blk,
-                    dm_block_new=max(1, dm_block // shrink),
-                    error=f"{exc!s:.200}",
+                    "cpu_backend", dm_block=blk, error=f"{exc!s:.200}"
                 )
         timers["searching"] = time.perf_counter() - t0
         tel.capture_device_memory("search")

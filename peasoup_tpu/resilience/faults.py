@@ -16,6 +16,7 @@ Grammar (``PEASOUP_FAULTS`` env var or ``--faults``)::
     entry   := "seed=" INT | site (":" key "=" value)*
     site    := fil.read | queue.claim | db.ingest | checkpoint.write
              | device.oom | worker.kill | cache.corrupt | clock.skew
+             | multihost.barrier | multihost.merge
     key     := p     (per-invocation probability, seeded -> replayable)
              | n     (max injections; bare site defaults to n=1,at=1)
              | at    (an integer -> fire on that 1-based invocation of
@@ -51,7 +52,7 @@ import random
 import threading
 
 from ..obs import get_logger
-from .errors import TransientIOError, WorkerKilled
+from .errors import CorruptArtifactError, TransientIOError, WorkerKilled
 from .stats import STATS
 
 log = get_logger("resilience.faults")
@@ -68,6 +69,8 @@ SITES = (
     "worker.kill",
     "cache.corrupt",
     "clock.skew",
+    "multihost.barrier",
+    "multihost.merge",
 )
 
 
@@ -95,8 +98,25 @@ def _make_exception(site: str, tag: str) -> BaseException:
         )
     if site == "worker.kill":
         return WorkerKilled(f"injected worker kill {tag}")
-    # cache.corrupt / clock.skew act through their dedicated helpers;
-    # a direct fire() on them raises the generic transient form
+    if site == "multihost.barrier":
+        # a peer dying at the collective barrier surfaces as a broken
+        # connection — TRANSIENT, so the step fails fast and retries
+        # instead of hanging (parallel/multihost.py)
+        return TransientIOError(
+            _errno.ECONNRESET, f"injected multihost barrier failure {tag}"
+        )
+    if site == "multihost.merge":
+        return TransientIOError(
+            _errno.EIO, f"injected multihost merge failure {tag}"
+        )
+    if site == "cache.corrupt":
+        # direct fire (the warmup seam): a garbled persistent-cache
+        # entry — classified CORRUPT so the quarantine policy answers
+        return CorruptArtifactError(
+            f"injected corrupt compilation-cache entry {tag}"
+        )
+    # clock.skew acts through its dedicated helper; a direct fire()
+    # raises the generic transient form
     return TransientIOError(_errno.EIO, f"injected fault {tag}")
 
 

@@ -53,7 +53,7 @@ from ..obs import get_logger
 log = get_logger("tools.chaos")
 
 REPORT_SCHEMA = "peasoup_tpu.chaos_report"
-REPORT_VERSION = 1
+REPORT_VERSION = 2  # v2: the fleet-mode section (real-process soak)
 
 DEFAULT_CAMPAIGN_FAULTS = (
     "fil.read:p=0.25:n=4,db.ingest:at=1,worker.kill:at=obs0"
@@ -139,26 +139,22 @@ def make_observations(
 # campaign soak
 # --------------------------------------------------------------------------
 
-def _run_campaign(
+def _setup_campaign(
     root: str,
     inputs: list[str],
     config: dict,
     lease_s: float,
     max_attempts: int,
-) -> dict:
-    """Drain one campaign in-process, surviving injected worker kills
-    the way a fleet does: each kill abandons the claim (never released
-    — WorkerKilled models SIGKILL), waits out the lease, and a
-    replacement worker joins and reaps."""
+):
+    """Create the campaign directory + config and enqueue the
+    observations; returns the JobQueue (shared by the in-process and
+    fleet soaks, so both judge identical campaigns)."""
     from ..campaign.queue import Job, JobQueue, job_id_for
     from ..campaign.runner import (
         CampaignConfig,
-        CampaignRunner,
         bucket_for_input,
         save_campaign_config,
     )
-    from ..campaign.rollup import write_status
-    from ..resilience import WorkerKilled
 
     os.makedirs(root, exist_ok=True)
     cfg = CampaignConfig(
@@ -183,14 +179,37 @@ def _run_campaign(
                 bucket=bucket_for_input(p),
             )
         )
+    return queue
+
+
+def _run_campaign(
+    root: str,
+    inputs: list[str],
+    config: dict,
+    lease_s: float,
+    max_attempts: int,
+) -> dict:
+    """Drain one campaign in-process, surviving injected worker kills
+    the way a fleet does: each kill abandons the claim (never released
+    — WorkerKilled models SIGKILL), waits out the lease, and a
+    replacement worker joins and reaps. The workers enter through
+    runner.run_worker — THE production worker entry — so the
+    in-process soak and the fleet soak's real subprocesses exercise
+    identical code."""
+    from ..campaign.rollup import write_status
+    from ..campaign.runner import run_worker
+    from ..resilience import WorkerKilled
+
+    queue = _setup_campaign(root, inputs, config, lease_s, max_attempts)
     kills = 0
     tally = {"done": 0, "failed": 0, "quarantined": 0}
     worker = 0
     t0 = time.perf_counter()
     while True:
-        runner = CampaignRunner(root, worker_id=f"chaos-w{worker}")
         try:
-            t = runner.run(poll_s=0.05)
+            t = run_worker(
+                root, worker_id=f"chaos-w{worker}", poll_s=0.05
+            )
             for k in tally:
                 tally[k] += t.get(k, 0)
             break  # drained
@@ -222,12 +241,41 @@ def _job_candidate_bytes(root: str, job_id: str) -> bytes | None:
 
 
 def _tree_residue(root: str) -> list[str]:
-    """Leaked atomic-write temps / reap tombstones / claim files."""
+    """Leaked atomic-write temps / reap tombstones / claim files /
+    fleet-registry entries (a drained campaign must leave an empty
+    registry: clean leavers deregister, dead workers get reaped)."""
     bad = []
     for pat in ("**/*.tmp", "**/*.reap.*", "**/*.ckpt.tmp"):
         bad.extend(glob.glob(os.path.join(root, pat), recursive=True))
     bad.extend(glob.glob(os.path.join(root, "queue", "claims", "*.json")))
+    bad.extend(glob.glob(os.path.join(root, "queue", "workers", "*.json")))
     return sorted(bad)
+
+
+def _exactly_once_violations(
+    root: str, counts: dict, job_ids: list[str], n_obs: int
+) -> list[str]:
+    """The exactly-once invariant, shared by the in-process and fleet
+    soaks: every job terminal, none lost, none in two states."""
+    violations = []
+    if counts["total"] != n_obs:
+        violations.append(
+            f"jobs lost or duplicated: {counts['total']}/{n_obs} records"
+        )
+    if counts["done"] + counts["quarantined"] != counts["total"]:
+        violations.append(f"campaign not drained exactly-once: {counts}")
+    for j in job_ids:
+        d = os.path.exists(
+            os.path.join(root, "queue", "done", f"{j}.json")
+        )
+        q = os.path.exists(
+            os.path.join(root, "queue", "quarantine", f"{j}.json")
+        )
+        if d == q:  # both (double-terminal) or neither (lost)
+            violations.append(
+                f"job {j}: done={d} quarantined={q} (must be exactly one)"
+            )
+    return violations
 
 
 def run_campaign_soak(
@@ -291,28 +339,13 @@ def run_campaign_soak(
     injection_log = active.to_doc() if active else {}
 
     # --- invariants ---------------------------------------------------
-    violations: list[str] = []
     queue = JobQueue(chaos_root)
     counts = queue.counts()
 
     # exactly-once: every job terminal, none lost, none in two states
-    if counts["total"] != n_obs:
-        violations.append(
-            f"jobs lost or duplicated: {counts['total']}/{n_obs} records"
-        )
-    if counts["done"] + counts["quarantined"] != counts["total"]:
-        violations.append(f"campaign not drained exactly-once: {counts}")
-    for j in job_ids:
-        d = os.path.exists(
-            os.path.join(chaos_root, "queue", "done", f"{j}.json")
-        )
-        q = os.path.exists(
-            os.path.join(chaos_root, "queue", "quarantine", f"{j}.json")
-        )
-        if d == q:  # both (double-terminal) or neither (lost)
-            violations.append(
-                f"job {j}: done={d} quarantined={q} (must be exactly one)"
-            )
+    violations: list[str] = _exactly_once_violations(
+        chaos_root, counts, job_ids, n_obs
+    )
 
     # transient-only schedule: zero quarantine, bitwise-equal products
     if counts["quarantined"]:
@@ -400,6 +433,393 @@ def run_campaign_soak(
         "injections": injection_log,
         "violations": violations,
     }
+
+
+# --------------------------------------------------------------------------
+# fleet soak: real worker PROCESSES under kills, churn and skew
+# --------------------------------------------------------------------------
+
+# the per-worker fault schedule one (non-victim) worker runs under:
+# two deterministic flaky reads, recovered inside the shared IO retry
+# budget — so the rollup's resilience section must show the marks
+DEFAULT_FLEET_WORKER_FAULTS = "fil.read:n=2"
+
+
+def _fleet_roles(
+    seed: int,
+    n_workers: int,
+    kills: int = 1,
+    leavers: int = 1,
+    late_joiners: int = 1,
+    skew_s: float = 10.0,
+    faults_spec: str = DEFAULT_FLEET_WORKER_FAULTS,
+) -> list[dict]:
+    """Deterministic (seeded) role assignment for the fleet: which
+    workers get SIGKILLed mid-job, which leave voluntarily after one
+    job, which join late, and which run per-worker fault schedules
+    (flaky reads on one drainer; a positive clock skew on a leaver —
+    bounded premature reaping, absorbed by the attempt budget). At
+    least one plain drainer always remains so the campaign can drain
+    whatever the churn does."""
+    import random
+
+    if n_workers < kills + late_joiners + 1:
+        raise ValueError(
+            f"fleet of {n_workers} cannot schedule {kills} kill(s) + "
+            f"{late_joiners} late join(s) and still keep a drainer"
+        )
+    rng = random.Random(f"{seed}:fleet-roles")
+    order = list(range(n_workers))
+    rng.shuffle(order)
+    victims = set(order[:kills])
+    rest = [i for i in order if i not in victims]
+    late = set(rest[-late_joiners:]) if late_joiners else set()
+    # leavers drawn from the non-victim, non-late pool (a late joiner
+    # that immediately leaves would be churn theatre, not coverage);
+    # the FIRST of the pool stays a plain drainer
+    pool = [i for i in rest if i not in late]
+    leaver_set = set(pool[1 : 1 + leavers])
+    faulty = pool[0] if pool else rest[0]
+    skewed = next(iter(leaver_set), None)
+    roles = []
+    for i in range(n_workers):
+        env_faults = []
+        if i == faulty and faults_spec:
+            env_faults.append(faults_spec)
+        if i == skewed and skew_s:
+            env_faults.append(f"clock.skew:skew={skew_s}")
+        roles.append(
+            {
+                "index": i,
+                "worker_id": f"fleet-w{i}",
+                "kill": i in victims,
+                "max_jobs": 1 if i in leaver_set else None,
+                "late": i in late,
+                "faults": (
+                    ",".join(env_faults + [f"seed={seed}"])
+                    if env_faults else ""
+                ),
+            }
+        )
+    return roles
+
+
+def run_fleet_soak(
+    workdir: str,
+    faults_spec: str | None,
+    seed: int,
+    n_workers: int = 4,
+    n_obs: int = 6,
+    nsamps: int = 1 << 12,
+    lease_s: float = 2.0,
+    max_attempts: int = 6,
+    kills: int = 1,
+    leavers: int = 1,
+    late_joiners: int = 1,
+    skew_s: float = 10.0,
+    timeout_s: float = 900.0,
+    config: dict | None = None,
+) -> dict:
+    """THE fleet-scale soak: N real ``peasoup-campaign run``
+    subprocesses drain one shared campaign directory while the parent
+    applies a seeded schedule of real SIGKILLs (delivered the moment a
+    victim holds a claim), worker churn (a voluntary single-job
+    leaver, a late joiner), a clock-skewed reaper, and per-worker
+    ``PEASOUP_FAULTS``. Judged by the same invariants as the
+    in-process soak — exactly-once, candidates bitwise-equal to a
+    fault-free reference, zero leaked claims/tombstones/registry
+    entries — plus per-site recovery attribution assembled from the
+    campaign rollup and the workers' own logs."""
+    import signal
+    import subprocess
+    import sys
+
+    from ..campaign.queue import JobQueue, job_id_for
+    from ..campaign.rollup import load_campaign_status, write_status
+    from ..obs.schema import validate_manifest
+    from ..resilience import STATS, faults
+    from ..resilience.faults import parse_faults
+
+    spec = faults_spec or DEFAULT_FLEET_WORKER_FAULTS
+    plan = parse_faults(spec, seed)
+    unknown = set(plan.rules) - TRANSIENT_SITES
+    if unknown:
+        raise ValueError(f"non-transient fault sites: {sorted(unknown)}")
+    if n_obs < n_workers:
+        raise ValueError(
+            f"fleet soak needs >= one job per worker ({n_obs} obs for "
+            f"{n_workers} workers): every victim must get a claim to "
+            "be killed holding it"
+        )
+
+    config = config or {"dm_end": 20.0, "min_snr": 7.0, "n_widths": 6}
+    data_dir = os.path.join(workdir, "data")
+    inputs = make_observations(data_dir, n_obs=n_obs, nsamps=nsamps)
+    job_ids = [job_id_for(p) for p in inputs]
+
+    # --- fault-free reference (in-process; same code path — the
+    # workers enter through runner.run_worker either way) -------------
+    faults.configure(None)
+    STATS.reset()
+    ref_root = os.path.join(workdir, "fleet_ref")
+    log.info("fleet soak: fault-free reference campaign (%d obs)", n_obs)
+    ref = _run_campaign(ref_root, inputs, config, lease_s, max_attempts)
+    ref_cands = {j: _job_candidate_bytes(ref_root, j) for j in job_ids}
+    if ref["tally"]["done"] != n_obs or any(
+        v is None for v in ref_cands.values()
+    ):
+        raise RuntimeError(
+            f"reference campaign did not complete cleanly: {ref}"
+        )
+
+    # --- the fleet ----------------------------------------------------
+    root = os.path.join(workdir, "fleet")
+    queue = _setup_campaign(root, inputs, config, lease_s, max_attempts)
+    roles = _fleet_roles(
+        seed, n_workers, kills=kills, leavers=leavers,
+        late_joiners=late_joiners, skew_s=skew_s, faults_spec=spec,
+    )
+    logs_dir = os.path.join(workdir, "fleet_logs")
+    os.makedirs(logs_dir, exist_ok=True)
+    # one shared persistent compilation cache: the first worker pays
+    # the compiles, every later worker (and the late joiner) cold-starts
+    # warm — fleet wall time stays minutes, not hours
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+        workdir, "xla_cache"
+    )
+
+    procs: dict[str, dict] = {}
+
+    def spawn(role: dict) -> None:
+        env = dict(os.environ)
+        env.pop("PEASOUP_FAULTS", None)
+        if role["faults"]:
+            env["PEASOUP_FAULTS"] = role["faults"]
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+        cmd = [
+            sys.executable, "-m", "peasoup_tpu.cli.campaign", "run",
+            "-w", root, "--worker-id", role["worker_id"],
+            "--pipeline", "spsearch",
+            "--config", json.dumps(config),
+            "--lease", str(lease_s),
+            "--max-attempts", str(max_attempts),
+            "--backoff", "0.05",
+            "--no-warmup",
+            "--poll", "0.05",
+        ]
+        if role["max_jobs"]:
+            cmd += ["--max-jobs", str(role["max_jobs"])]
+        logf = open(
+            os.path.join(logs_dir, role["worker_id"] + ".log"), "wb"
+        )
+        proc = subprocess.Popen(
+            cmd, stdout=logf, stderr=subprocess.STDOUT, env=env
+        )
+        procs[role["worker_id"]] = {
+            "proc": proc, "logf": logf,
+            "log": logf.name, "role": role, "killed": False,
+        }
+        log.info(
+            "fleet: spawned %s (pid %d)%s%s%s",
+            role["worker_id"], proc.pid,
+            " [victim]" if role["kill"] else "",
+            f" [leaves after {role['max_jobs']}]" if role["max_jobs"]
+            else "",
+            f" [faults {role['faults']}]" if role["faults"] else "",
+        )
+
+    t0 = time.perf_counter()
+    for role in roles:
+        if not role["late"]:
+            spawn(role)
+    late_pending = [r for r in roles if r["late"]]
+    pending_victims = {r["worker_id"] for r in roles if r["kill"]}
+    kills_done: list[dict] = []
+    joins: list[str] = []
+    claims_dir = os.path.join(root, "queue", "claims")
+    done_dir = os.path.join(root, "queue", "done")
+    timed_out = False
+    while True:
+        if time.perf_counter() - t0 > timeout_s:
+            timed_out = True
+            break
+        # churn: the late joiners arrive once the fleet has made first
+        # progress (a done record) — they must claim from the warm
+        # bucket tier, not reopen cold ones
+        if late_pending and os.listdir(done_dir):
+            for role in late_pending:
+                spawn(role)
+                joins.append(role["worker_id"])
+            late_pending = []
+        # kills: a victim dies by REAL SIGKILL the moment it holds a
+        # claim (plus a beat so the job is genuinely under way) — the
+        # worst case for exactly-once, recovered only by lease reaping
+        if pending_victims and os.path.isdir(claims_dir):
+            for name in sorted(os.listdir(claims_dir)):
+                if not name.endswith(".json"):
+                    continue
+                try:
+                    with open(os.path.join(claims_dir, name)) as f:
+                        doc = json.load(f)
+                except (OSError, json.JSONDecodeError):
+                    continue
+                wid = doc.get("worker_id")
+                if wid in pending_victims:
+                    ent = procs.get(wid)
+                    pending_victims.discard(wid)
+                    if ent and ent["proc"].poll() is None:
+                        time.sleep(0.2)
+                        try:
+                            os.kill(ent["proc"].pid, signal.SIGKILL)
+                        except ProcessLookupError:
+                            continue
+                        ent["killed"] = True
+                        kills_done.append(
+                            {
+                                "worker_id": wid,
+                                "pid": ent["proc"].pid,
+                                "job_id": doc.get("job_id"),
+                            }
+                        )
+                        log.warning(
+                            "fleet: SIGKILLed %s (pid %d) mid-job %s",
+                            wid, ent["proc"].pid, doc.get("job_id"),
+                        )
+        alive = [e for e in procs.values() if e["proc"].poll() is None]
+        if not late_pending and not alive and queue.drained():
+            break
+        time.sleep(0.05)
+
+    # settle: every spawned process must be gone (drained workers exit
+    # on their own; a timeout kills the stragglers and is a violation)
+    for ent in procs.values():
+        if ent["proc"].poll() is None and timed_out:
+            ent["proc"].kill()
+        try:
+            ent["proc"].wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            ent["proc"].kill()
+            ent["proc"].wait(timeout=10)
+        ent["logf"].close()
+    wall_s = round(time.perf_counter() - t0, 3)
+    write_status(root, queue)  # final rollup over the settled tree
+
+    # --- invariants ---------------------------------------------------
+    counts = queue.counts()
+    violations = _exactly_once_violations(root, counts, job_ids, n_obs)
+    if timed_out:
+        violations.append(
+            f"fleet did not drain within {timeout_s:.0f}s"
+        )
+    if pending_victims and not timed_out:
+        violations.append(
+            f"kill schedule unapplied: {sorted(pending_victims)} never "
+            "held a claim"
+        )
+    if counts["quarantined"]:
+        violations.append(
+            f"{counts['quarantined']} job(s) quarantined under a "
+            "transient-only schedule"
+        )
+    for j in job_ids:
+        got = _job_candidate_bytes(root, j)
+        if got is None:
+            violations.append(f"job {j}: no candidate file after soak")
+        elif got != ref_cands[j]:
+            violations.append(
+                f"job {j}: candidates differ from the fault-free run"
+            )
+    residue = _tree_residue(root)
+    if residue:
+        violations.append(f"leaked files: {residue[:8]}")
+    for j in job_ids:
+        man_path = os.path.join(root, "jobs", j, "telemetry.json")
+        try:
+            with open(man_path) as f:
+                validate_manifest(json.load(f))
+        except Exception as exc:
+            violations.append(
+                f"job {j}: telemetry manifest invalid: {exc!s:.200}"
+            )
+
+    # --- per-site recovery attribution --------------------------------
+    # injections counted from the workers' own logs (each subprocess
+    # owns its STATS); recoveries from the rollup's resilience section
+    # (aggregated per-job deltas) and the queue's attempt accounting
+    injected: dict[str, int] = {}
+    for ent in procs.values():
+        try:
+            with open(ent["log"], "rb") as f:
+                text = f.read().decode("utf-8", "replace")
+        except OSError:
+            continue
+        for site in SITES_IN_LOGS:
+            n = text.count(f"injecting fault at {site}")
+            if n:
+                injected[site] = injected.get(site, 0) + n
+    try:
+        rollup = load_campaign_status(
+            os.path.join(root, "campaign_status.json")
+        )
+    except Exception as exc:
+        rollup = {}
+        violations.append(f"campaign rollup unreadable: {exc!s:.200}")
+    res = rollup.get("resilience") or {}
+    if "fleet" not in rollup:
+        violations.append("rollup lacks the fleet section")
+    recovery: dict[str, dict] = {}
+    for site, n in injected.items():
+        if site in ("clock.skew",):
+            recovery[site] = {"injected": n}
+            continue
+        marks = {
+            t: v
+            for t in ("retries", "recoveries", "giveups")
+            for k, v in (res.get(t) or {}).items()
+            if k.startswith(site)
+        }
+        recovery[site] = {"injected": n, **marks}
+        if n and not marks:
+            violations.append(
+                f"fault {site} fired {n}x across the fleet but the "
+                "rollup shows no recovery marks"
+            )
+    if kills_done:
+        done = queue.done_records()
+        reaped = [d for d in done if int(d.get("attempts", 1)) > 1]
+        recovery["worker.kill"] = {
+            "sigkills": len(kills_done),
+            "reaped_retries": len(reaped),
+        }
+        if not reaped:
+            violations.append(
+                "SIGKILL(s) delivered but no done record shows a "
+                "reaped retry (attempts > 1)"
+            )
+
+    return {
+        "n_obs": n_obs,
+        "n_workers": n_workers,
+        "faults": spec,
+        "seed": seed,
+        "roles": [
+            {k: v for k, v in r.items() if k != "index"} for r in roles
+        ],
+        "kills": kills_done,
+        "late_joins": joins,
+        "reference": ref,
+        "wall_s": wall_s,
+        "queue": counts,
+        "worker_logs": sorted(e["log"] for e in procs.values()),
+        "recovery": recovery,
+        "violations": violations,
+    }
+
+
+# sites whose injections are counted from worker logs in the fleet
+# soak (the log line is faults.py's "injecting fault at <site>")
+SITES_IN_LOGS = ("fil.read", "queue.claim", "db.ingest", "clock.skew")
 
 
 # --------------------------------------------------------------------------
@@ -520,7 +940,12 @@ def build_parser() -> argparse.ArgumentParser:
         "telemetry, bounded + attributed recovery).",
     )
     p.add_argument(
-        "--mode", choices=("campaign", "stream", "both"), default="both",
+        "--mode", choices=("campaign", "stream", "both", "fleet"),
+        default="both",
+        help="campaign/stream soak in-process workers; fleet spawns N "
+        "REAL `peasoup-campaign run` subprocesses and applies a seeded "
+        "schedule of SIGKILLs, churn (late join, voluntary leave), "
+        "clock skew and per-worker PEASOUP_FAULTS",
     )
     p.add_argument(
         "--faults", default=None,
@@ -545,6 +970,33 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--report", default=None,
         help="chaos_report.json path (default: <workdir>/chaos_report.json)",
+    )
+    fleet = p.add_argument_group("fleet mode")
+    fleet.add_argument(
+        "--workers", type=int, default=4,
+        help="fleet worker subprocesses (default 4)",
+    )
+    fleet.add_argument(
+        "--kills", type=int, default=1,
+        help="workers SIGKILLed mid-job (default 1)",
+    )
+    fleet.add_argument(
+        "--leavers", type=int, default=1,
+        help="workers leaving voluntarily after one job (default 1)",
+    )
+    fleet.add_argument(
+        "--late-joiners", type=int, default=1,
+        help="workers joining after first progress (default 1)",
+    )
+    fleet.add_argument(
+        "--skew", type=float, default=10.0,
+        help="clock skew (s) injected into one leaver's reaper "
+        "(default 10)",
+    )
+    fleet.add_argument(
+        "--fleet-timeout", type=float, default=900.0,
+        help="seconds before an undrained fleet is a violation "
+        "(default 900)",
     )
     return p
 
@@ -582,6 +1034,23 @@ def main(argv=None) -> int:
             )
             report["stream"] = sec
             violations += [f"stream: {v}" for v in sec["violations"]]
+        if args.mode == "fleet":
+            sec = run_fleet_soak(
+                workdir,
+                args.faults,
+                args.seed,
+                n_workers=args.workers,
+                n_obs=args.n_obs,
+                nsamps=args.nsamps,
+                lease_s=args.lease,
+                kills=args.kills,
+                leavers=args.leavers,
+                late_joiners=args.late_joiners,
+                skew_s=args.skew,
+                timeout_s=args.fleet_timeout,
+            )
+            report["fleet"] = sec
+            violations += [f"fleet: {v}" for v in sec["violations"]]
         report["violations"] = violations
         report["ok"] = not violations
     except Exception as exc:

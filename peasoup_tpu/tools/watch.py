@@ -242,6 +242,33 @@ def render_campaign_status(st: dict, stale_after: float = 0.0) -> str:
         )
     if st.get("candidates_total"):
         lines.append(f"  candidates so far: {st['candidates_total']}")
+    fleet = st.get("fleet") or {}
+    live = fleet.get("live") or []
+    if live:
+        lines.append(f"  fleet: {len(live)} worker(s) live")
+        per_worker = fleet.get("workers") or {}
+        for w in live:
+            wid = w.get("worker_id", "?")
+            rate = (per_worker.get(wid) or {}).get("jobs_per_h")
+            bits = [
+                f"    {wid}  host={w.get('hostname', '?')}"
+                f"  done={w.get('jobs_done', 0)}"
+            ]
+            if rate is not None:
+                bits.append(f"{rate:.3g} jobs/h")
+            if w.get("current_job"):
+                bits.append(f"on {w['current_job']}")
+            lines.append("  ".join(bits))
+    if st.get("degraded_jobs"):
+        lines.append(
+            f"  *** {st['degraded_jobs']} job(s) completed DEGRADED "
+            "(OOM fall-through / crashed helper thread) ***"
+        )
+    if st.get("corrupt_artifact_files"):
+        lines.append(
+            f"  {st['corrupt_artifact_files']} quarantined *.corrupt "
+            "artifact(s) (prune: peasoup-campaign prune --corrupt)"
+        )
     if st.get("warmup_total_s") or st.get("tuning_total_s"):
         lines.append(
             f"  warmup {st.get('warmup_total_s', 0.0):.1f}s over "

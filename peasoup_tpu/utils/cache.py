@@ -42,3 +42,52 @@ def enable_compilation_cache() -> str | None:
         return cache
     except Exception:  # read-only home etc.: run without the cache
         return None
+
+
+def cache_entry_paths(cache_dir: str | None = None) -> list[str]:
+    """The persistent cache's entry files (quarantined ``*.corrupt``
+    forensics excluded). Empty when the cache dir is absent."""
+    d = cache_dir or default_cache_dir()
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return []
+    return sorted(
+        p
+        for n in names
+        if not n.endswith(".corrupt")
+        for p in (os.path.join(d, n),)
+        if os.path.isfile(p)
+    )
+
+
+def quarantine_cache_entries(cache_dir: str | None = None) -> list[str]:
+    """Move every persistent-cache entry aside to ``*.corrupt`` (rename,
+    never delete — the torn bytes are the post-mortem) so the next
+    compile repopulates the cache from scratch instead of crashing on a
+    garbled deserialisation. The cache is a pure optimisation: losing
+    all of it costs recompiles, never correctness — which is why a
+    single suspect entry quarantines the lot (XLA's entry filenames are
+    opaque hashes; the damaged one cannot be singled out from outside).
+    Returns the quarantine paths."""
+    from ..resilience import STATS, quarantine_artifact
+
+    out = []
+    entries = cache_entry_paths(cache_dir)
+    for path in entries:
+        q = quarantine_artifact(path)
+        if q:
+            out.append(q)
+    if entries:
+        STATS.corrupt_artifact("xla cache")
+        try:
+            from ..obs.telemetry import current
+
+            current().event(
+                "corrupt_artifact", artifact="xla cache",
+                path=cache_dir or default_cache_dir(),
+                quarantined_to=f"{len(out)} entries",
+            )
+        except Exception:
+            pass  # telemetry must never mask the recovery itself
+    return out

@@ -521,3 +521,158 @@ class TestCampaignEndToEnd:
             assert n1 >= 1
             db.ingest_job(jid, os.path.join(root, "jobs", jid), path)
             assert len(db.candidates_for(jid)) == n1
+
+
+# --------------------------------------------------------------------------
+# the ffa campaign pipeline (satellite) + quarantine pruning (satellite)
+# --------------------------------------------------------------------------
+
+def make_periodic_obs(path, nsamps=1 << 14, nchans=8, tsamp=0.008, P=2.51):
+    """Observation with a strong slow pulsar (no dispersion) for the
+    FFA pipeline: ~50 pulses of period P over nsamps*tsamp seconds."""
+    rng = np.random.default_rng(7)
+    t = np.arange(nsamps) * tsamp
+    pulse = 40.0 * ((t % P) / P < 0.03)
+    data = np.clip(
+        rng.normal(100, 6, size=(nsamps, nchans)) + pulse[:, None],
+        0, 255,
+    ).astype(np.uint8)
+    hdr = SigprocHeader(
+        source_name="FFAOBS", tsamp=tsamp, tstart=55000.0, fch1=1500.0,
+        foff=-1.0, nchans=nchans, nbits=8, nifs=1, data_type=1,
+    )
+    write_filterbank(path, Filterbank(header=hdr, data=data))
+    return path
+
+
+class TestFFACampaignPipeline:
+    def test_ffa_job_end_to_end(self, tmp_path):
+        """Satellite: pipeline 'ffa' dispatches the FFA driver through
+        the same bucket/telemetry/done-record path as the other
+        pipelines — the injected pulsar comes back in candidates.ffa,
+        the overview.xml parses through the existing periodicity
+        reader, and the candidates ingest into the campaign DB."""
+        from peasoup_tpu.campaign.db import CandidateDB
+        from peasoup_tpu.campaign.runner import run_worker
+        from peasoup_tpu.obs.schema import validate_manifest
+        from peasoup_tpu.tools.parsers import OverviewFile
+
+        P = 2.51
+        root = str(tmp_path / "camp")
+        obs = make_periodic_obs(str(tmp_path / "ffa.fil"))
+        save_campaign_config(
+            root,
+            CampaignConfig(
+                pipeline="ffa",
+                warmup=False,
+                config={
+                    "dm_end": 5.0, "p_start": 1.0, "p_end": 6.0,
+                    "min_dc": 0.01, "min_snr": 8.0,
+                },
+            ),
+        )
+        q = JobQueue(root)
+        enqueue_entries(q, [{"input": obs}], "ffa")
+        tally = run_worker(root, worker_id="w1", poll_s=0.05)
+        assert tally == {"done": 1, "failed": 0, "quarantined": 0}
+        jid = q.job_ids()[0]
+        [done] = q.done_records()
+        assert done["pipeline"] == "ffa"
+        assert done["bucket"] is not None  # same shape-bucket path
+        assert done["n_candidates"] >= 1
+        job_dir = os.path.join(root, "jobs", jid)
+        # the text table holds the injected period
+        with open(os.path.join(job_dir, "candidates.ffa")) as f:
+            rows = [
+                ln.split() for ln in f if not ln.startswith("#")
+            ]
+        periods = [float(r[0]) for r in rows]
+        assert any(abs(p - P) / P < 2e-3 for p in periods), periods
+        # overview.xml parses through the existing periodicity reader
+        ov = OverviewFile(os.path.join(job_dir, "overview.xml"))
+        assert len(ov.candidates) == len(rows)
+        assert any(
+            abs(float(c["period"]) - P) / P < 2e-3 for c in ov.candidates
+        )
+        assert ov.dm_list.size >= 1
+        # telemetry manifest valid, with the ffa stage timers
+        with open(os.path.join(job_dir, "telemetry.json")) as f:
+            man = json.load(f)
+        validate_manifest(man)
+        assert "ffa_search" in man["timers"]
+        # ... and the DB ingested the rows as periodicity candidates
+        with CandidateDB(os.path.join(root, "candidates.sqlite")) as db:
+            cands = db.candidates_for(jid)
+        assert len(cands) == len(rows)
+        assert all(c["kind"] == "periodicity" for c in cands)
+
+    def test_manifest_accepts_ffa_and_priority(self, tmp_path):
+        obs = make_obs(str(tmp_path / "a.fil"))
+        q = JobQueue(str(tmp_path / "c"))
+        n = enqueue_entries(
+            q,
+            [{"input": obs, "pipeline": "ffa", "priority": 4}],
+            "spsearch",
+        )
+        assert n == 1
+        job = q.get_job(q.job_ids()[0])
+        assert job.pipeline == "ffa"
+        assert job.priority == 4
+
+    def test_unknown_pipeline_still_rejected(self, tmp_path):
+        obs = make_obs(str(tmp_path / "a.fil"))
+        q = JobQueue(str(tmp_path / "c"))
+        with pytest.raises(ValueError, match="unknown pipeline"):
+            enqueue_entries(q, [{"input": obs, "pipeline": "nope"}], "nope")
+
+
+class TestPruneCorrupt:
+    def _plant(self, root, age_days=0.0):
+        jobs = os.path.join(root, "jobs", "j1")
+        os.makedirs(jobs, exist_ok=True)
+        path = os.path.join(jobs, "search.ckpt.npz.corrupt")
+        with open(path, "w") as f:
+            f.write("torn bytes")
+        if age_days:
+            old = time.time() - age_days * 86400
+            os.utime(path, (old, old))
+        return path
+
+    def test_prune_dry_run_keeps_files(self, tmp_path, capsys):
+        from peasoup_tpu.cli.campaign import main
+
+        root = str(tmp_path)
+        path = self._plant(root, age_days=3)
+        rc = main(
+            ["prune", "-w", root, "--corrupt", "--dry-run"]
+        )
+        assert rc == 0
+        assert os.path.exists(path)
+        out = capsys.readouterr().out
+        assert "would delete 1" in out
+
+    def test_prune_respects_age_filter(self, tmp_path, capsys):
+        from peasoup_tpu.cli.campaign import main
+
+        root = str(tmp_path)
+        old = self._plant(root, age_days=10)
+        fresh = os.path.join(root, "tuning_cache.json.corrupt")
+        with open(fresh, "w") as f:
+            f.write("{torn")
+        rc = main(
+            ["prune", "-w", root, "--corrupt", "--older-than-days", "7"]
+        )
+        assert rc == 0
+        assert not os.path.exists(old)
+        assert os.path.exists(fresh)  # younger than the cutoff
+        # the rollup counts what remains
+        q = JobQueue(root)
+        q.add_job(Job(job_id="j", input="x.fil"))
+        st = build_status(root, q)
+        assert st["corrupt_artifact_files"] == 1
+
+    def test_prune_requires_a_selector(self, tmp_path, capsys):
+        from peasoup_tpu.cli.campaign import main
+
+        assert main(["prune", "-w", str(tmp_path)]) == 1
+        assert "--corrupt" in capsys.readouterr().out

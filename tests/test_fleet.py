@@ -1,0 +1,361 @@
+"""Elastic-fleet tests: the worker registry (join/beat/leave/reap),
+priority-class claiming, clean voluntary release, elastic-membership
+scenarios (late joiners preferring warm buckets; a SIGKILLed worker's
+registry entry reaped and its job re-queued exactly once — with a REAL
+subprocess), the fleet soak's seeded role schedule, and the rollup's
+fleet section. The full real-process fleet soak is the slow-marked
+acceptance test here and the ``peasoup-chaos --mode fleet`` gate in
+scripts/check.sh.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from peasoup_tpu.campaign.queue import Job, JobQueue
+from peasoup_tpu.campaign.registry import WorkerRegistry
+from peasoup_tpu.resilience import faults
+from peasoup_tpu.resilience.stats import STATS
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.configure(None)
+    STATS.reset()
+    yield
+    faults.configure(None)
+    STATS.reset()
+
+
+# --------------------------------------------------------------------------
+# worker registry
+# --------------------------------------------------------------------------
+
+class TestWorkerRegistry:
+    def test_register_beat_live_deregister(self, tmp_path):
+        reg = WorkerRegistry(str(tmp_path), lease_s=30.0)
+        reg.register("w1")
+        reg.register("w2")
+        live = reg.live()
+        assert sorted(e["worker_id"] for e in live) == ["w1", "w2"]
+        assert all(e["pid"] == os.getpid() for e in live)
+        reg.beat("w1", jobs_done=3, current_job="jobX")
+        [w1] = [e for e in reg.live() if e["worker_id"] == "w1"]
+        assert w1["jobs_done"] == 3 and w1["current_job"] == "jobX"
+        reg.deregister("w1")
+        assert [e["worker_id"] for e in reg.live()] == ["w2"]
+        reg.deregister("w2")
+        reg.deregister("w2")  # idempotent
+        assert reg.entries() == []
+
+    def test_expired_entry_not_live_and_reaped(self, tmp_path):
+        reg = WorkerRegistry(str(tmp_path), lease_s=0.05)
+        reg.register("dead")
+        time.sleep(0.1)
+        assert reg.live() == []
+        assert reg.entries()  # still on disk until reaped
+        assert reg.reap() == ["dead"]
+        assert reg.entries() == []
+        assert reg.reap() == []  # second reap: nothing left
+
+    def test_beat_recreates_a_reaped_entry(self, tmp_path):
+        """A worker that beats IS alive, whatever a skewed reaper
+        concluded — the beat re-registers."""
+        reg = WorkerRegistry(str(tmp_path), lease_s=30.0)
+        reg.register("w1")
+        os.unlink(reg._path("w1"))  # reaped from under it
+        reg.beat("w1", jobs_done=1)
+        [e] = reg.live()
+        assert e["worker_id"] == "w1"
+
+    def test_takeover_of_stale_same_id(self, tmp_path):
+        reg = WorkerRegistry(str(tmp_path), lease_s=0.05)
+        reg.register("w1", jobs_done=7)
+        time.sleep(0.1)
+        doc = reg.register("w1")  # restart reusing the id
+        assert doc["jobs_done"] == 0
+        [e] = reg.live()
+        assert e["worker_id"] == "w1"
+
+
+# --------------------------------------------------------------------------
+# priority classes + clean release
+# --------------------------------------------------------------------------
+
+class TestPriorityClaiming:
+    def test_priority_outranks_fifo(self, tmp_path):
+        q = JobQueue(str(tmp_path))
+        q.add_job(Job(job_id="a-first", input="a.fil", priority=0))
+        q.add_job(Job(job_id="b-urgent", input="b.fil", priority=5))
+        claim = q.claim_next("w1")
+        assert claim.job.job_id == "b-urgent"
+
+    def test_priority_outranks_bucket_affinity(self, tmp_path):
+        """The documented ranking: priority > prefer-bucket >
+        warm-bucket > FIFO. An urgent job in a COLD bucket must beat a
+        plain job in the worker's own warm streak bucket."""
+        q = JobQueue(str(tmp_path))
+        warm = (8, 8, 4096)
+        cold = (16, 8, 8192)
+        q.add_job(Job(job_id="a-streak", input="a.fil", bucket=warm))
+        q.add_job(
+            Job(job_id="b-urgent", input="b.fil", bucket=cold, priority=1)
+        )
+        claim = q.claim_next(
+            "w1", prefer_bucket=warm, warm_buckets={warm}
+        )
+        assert claim.job.job_id == "b-urgent"
+        # equal priority: the streak bucket wins again
+        q.complete(claim)
+        q.add_job(
+            Job(job_id="c-urgent2", input="c.fil", bucket=cold, priority=0)
+        )
+        claim2 = q.claim_next(
+            "w1", prefer_bucket=warm, warm_buckets={warm}
+        )
+        assert claim2.job.job_id == "a-streak"
+
+    def test_priority_round_trips_job_record(self, tmp_path):
+        q = JobQueue(str(tmp_path))
+        q.add_job(Job(job_id="p", input="p.fil", priority=3))
+        assert q.get_job("p").priority == 3
+
+    def test_clean_release_consumes_zero_attempts(self, tmp_path):
+        """Satellite: a worker leaving cleanly hands its claim back
+        with ZERO attempts consumed; the job is immediately claimable
+        by anyone."""
+        q = JobQueue(str(tmp_path))
+        q.add_job(Job(job_id="j", input="x.fil"))
+        claim = q.claim_next("leaver")
+        assert claim is not None
+        q.release(claim)
+        assert q.state("j") == "pending"
+        assert q.get_job("j").attempts == 0
+        claim2 = q.claim_next("successor")
+        assert claim2 is not None and claim2.worker_id == "successor"
+        q.complete(claim2)
+        [done] = q.done_records()
+        assert done["attempts"] == 1  # the successor's only
+
+
+# --------------------------------------------------------------------------
+# elastic membership scenarios
+# --------------------------------------------------------------------------
+
+class TestElasticMembership:
+    def test_late_joiner_prefers_warm_bucket(self, tmp_path):
+        """Satellite: a worker joining mid-campaign claims warm-bucket
+        jobs first — the done records other workers left behind carry
+        the warm hint, and the joiner's claim ranking uses it."""
+        from peasoup_tpu.campaign.runner import (
+            CampaignConfig,
+            CampaignRunner,
+            save_campaign_config,
+        )
+
+        root = str(tmp_path)
+        save_campaign_config(root, CampaignConfig(warmup=False))
+        q = JobQueue(root)
+        warm = (8, 8, 4096)
+        cold = (16, 8, 8192)
+        # FIFO would pick the cold job (earlier id); the warm hint
+        # from a finished peer's done record must override
+        q.add_job(Job(job_id="a-cold", input="a.fil", bucket=cold))
+        q.add_job(Job(job_id="b-warm", input="b.fil", bucket=warm))
+        q.add_job(Job(job_id="c-done", input="c.fil", bucket=warm))
+        peer = q.try_claim("c-done", "old-worker")
+        q.complete(peer, bucket=list(warm), warmup_s=1.25)
+
+        joiner = CampaignRunner(root, worker_id="late-joiner")
+        assert tuple(warm) in joiner._warm_bucket_hint()
+        claim = q.claim_next(
+            "late-joiner", warm_buckets=joiner._warm_bucket_hint()
+        )
+        assert claim.job.job_id == "b-warm"
+
+    def test_sigkilled_worker_reaped_and_requeued_exactly_once(
+        self, tmp_path
+    ):
+        """Satellite: a REAL subprocess registers, claims a job, and
+        is SIGKILLed holding it. The lease expires, the claim reap
+        consumes exactly one attempt, and the registry reap removes
+        the corpse's membership entry."""
+        root = str(tmp_path)
+        q = JobQueue(root, lease_s=0.5)
+        q.add_job(Job(job_id="j", input="x.fil"))
+        script = (
+            "import sys, time\n"
+            "from peasoup_tpu.campaign.queue import JobQueue\n"
+            "from peasoup_tpu.campaign.registry import WorkerRegistry\n"
+            "root = sys.argv[1]\n"
+            "q = JobQueue(root, lease_s=0.5)\n"
+            "WorkerRegistry(root, lease_s=0.5).register('victim')\n"
+            "claim = q.claim_next('victim')\n"
+            "assert claim is not None\n"
+            "print('CLAIMED', flush=True)\n"
+            "time.sleep(60)\n"
+        )
+        proc = subprocess.Popen(
+            [sys.executable, "-c", script, root],
+            stdout=subprocess.PIPE,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        try:
+            line = proc.stdout.readline().decode()
+            assert "CLAIMED" in line, line
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=10)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        assert q.state("j") in ("running", "stale")  # corpse holds it
+        time.sleep(0.6)  # lease expires
+        assert q.reap_stale() == ["j"]
+        assert q.reap_stale() == []  # exactly once
+        job = q.get_job("j")
+        assert job.attempts == 1
+        assert q.state("j") in ("pending", "backoff")
+        reg = WorkerRegistry(root, lease_s=0.5)
+        assert reg.reap() == ["victim"]
+        assert reg.entries() == []
+
+    def test_worker_kill_leaves_registry_entry_for_peers(self, tmp_path):
+        """The in-process SIGKILL model (WorkerKilled) must leave the
+        membership entry behind like a real kill — peers reap it."""
+        from peasoup_tpu.campaign.runner import (
+            CampaignConfig,
+            run_worker,
+            save_campaign_config,
+        )
+        from peasoup_tpu.resilience import WorkerKilled
+
+        root = str(tmp_path)
+        save_campaign_config(
+            root, CampaignConfig(warmup=False, lease_s=0.2)
+        )
+        q = JobQueue(root, lease_s=0.2)
+        q.add_job(Job(job_id="j", input="/nonexistent/x.fil"))
+        faults.configure("worker.kill:at=1")
+        with pytest.raises(WorkerKilled):
+            run_worker(root, worker_id="victim", poll_s=0.05)
+        faults.configure(None)
+        reg = WorkerRegistry(root, lease_s=0.2)
+        assert [e["worker_id"] for e in reg.entries()] == ["victim"]
+        time.sleep(0.25)
+        assert reg.reap() == ["victim"]
+
+
+# --------------------------------------------------------------------------
+# fleet soak schedule + rollup fleet section
+# --------------------------------------------------------------------------
+
+class TestFleetRoles:
+    def test_roles_deterministic_and_complete(self):
+        from peasoup_tpu.tools.chaos import _fleet_roles
+
+        a = _fleet_roles(11, 4)
+        b = _fleet_roles(11, 4)
+        c = _fleet_roles(12, 4)
+        assert a == b
+        assert a != c
+        assert sum(r["kill"] for r in a) == 1
+        assert sum(bool(r["max_jobs"]) for r in a) == 1
+        assert sum(r["late"] for r in a) == 1
+        # a victim is never also the late joiner, and at least one
+        # plain drainer remains
+        for r in a:
+            assert not (r["kill"] and r["late"])
+        assert any(
+            not r["kill"] and not r["max_jobs"] and not r["late"]
+            for r in a
+        )
+        # exactly one worker carries the flaky-read schedule, one
+        # carries the skew, and both embed the seed
+        flaky = [r for r in a if "fil.read" in r["faults"]]
+        skewed = [r for r in a if "clock.skew" in r["faults"]]
+        assert len(flaky) == 1 and len(skewed) == 1
+        assert all("seed=11" in r["faults"] for r in flaky + skewed)
+        assert not flaky[0]["kill"] and not skewed[0]["kill"]
+
+    def test_roles_reject_fleet_without_a_drainer(self):
+        from peasoup_tpu.tools.chaos import _fleet_roles
+
+        with pytest.raises(ValueError, match="drainer"):
+            _fleet_roles(1, 2, kills=1, late_joiners=1)
+
+    def test_fleet_soak_rejects_too_few_jobs(self, tmp_path):
+        from peasoup_tpu.tools.chaos import run_fleet_soak
+
+        with pytest.raises(ValueError, match="one job per worker"):
+            run_fleet_soak(str(tmp_path), None, 1, n_workers=4, n_obs=2)
+
+
+class TestRollupFleetSection:
+    def test_fleet_membership_and_throughput_in_rollup(self, tmp_path):
+        from peasoup_tpu.campaign.rollup import build_status
+
+        root = str(tmp_path)
+        q = JobQueue(root)
+        reg = WorkerRegistry(root, lease_s=30.0)
+        reg.register("w1")
+        reg.beat("w1", jobs_done=2, current_job="j2")
+        for i, t in enumerate((100.0, 200.0)):
+            q.add_job(Job(job_id=f"j{i}", input=f"{i}.fil"))
+            c = q.try_claim(f"j{i}", "w1")
+            q.complete(c)
+            # pin finished_unix for a deterministic rate
+            path = q._p("done", f"j{i}")
+            with open(path) as f:
+                doc = json.load(f)
+            doc["finished_unix"] = t
+            doc["worker_id"] = "w1"
+            with open(path, "w") as f:
+                json.dump(doc, f)
+        st = build_status(root, q)
+        [live] = st["fleet"]["live"]
+        assert live["worker_id"] == "w1"
+        assert live["jobs_done"] == 2 and live["current_job"] == "j2"
+        w1 = st["fleet"]["workers"]["w1"]
+        assert w1["done"] == 2
+        assert w1["jobs_per_h"] == 36.0  # 1 interval over 100 s
+        assert st["degraded_jobs"] == 0
+        assert st["corrupt_artifact_files"] == 0
+
+    def test_degraded_and_corrupt_tallies(self, tmp_path):
+        from peasoup_tpu.campaign.rollup import build_status
+
+        root = str(tmp_path)
+        q = JobQueue(root)
+        q.add_job(Job(job_id="j", input="x.fil"))
+        c = q.try_claim("j", "w1")
+        q.complete(c, degraded=True)
+        (tmp_path / "jobs").mkdir()
+        (tmp_path / "jobs" / "a.ckpt.corrupt").write_text("torn")
+        st = build_status(root, q)
+        assert st["degraded_jobs"] == 1
+        assert st["corrupt_artifact_files"] == 1
+
+
+# --------------------------------------------------------------------------
+# the real thing (slow): 4 worker processes, kill + churn + skew
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestFleetSoakEndToEnd:
+    def test_fleet_soak_survives(self, tmp_path):
+        from peasoup_tpu.tools.chaos import run_fleet_soak
+
+        sec = run_fleet_soak(
+            str(tmp_path), None, seed=11, n_workers=4, n_obs=6,
+            lease_s=1.0,
+        )
+        assert sec["violations"] == []
+        assert sec["queue"]["done"] == 6
+        assert sec["kills"] and sec["late_joins"]
+        assert sec["recovery"]["worker.kill"]["reaped_retries"] >= 1
+        assert sec["recovery"]["fil.read"]["injected"] == 2

@@ -692,3 +692,310 @@ class TestThreadCrashGuard:
         assert q.state("j1") in ("pending", "backoff")
         assert q.try_claim("j1", "w2") is not None  # still claimable
         assert STATS.snapshot()["faults_injected"]["clock.skew"] == 1
+
+
+# --------------------------------------------------------------------------
+# multihost fault sites (barrier / merge)
+# --------------------------------------------------------------------------
+
+class TestMultihostFaultSites:
+    def test_barrier_injection_is_transient(self):
+        """A host dying at the allgather barrier must fail the step
+        classified TRANSIENT (fast, retryable) — never hang."""
+        from peasoup_tpu.parallel.multihost import _allgather_pickled
+
+        faults.configure("multihost.barrier:n=1")
+        with pytest.raises(R.TransientIOError) as ei:
+            _allgather_pickled(b"payload", context="search:candidates")
+        assert R.classify(ei.value) == R.TRANSIENT
+        assert "[injected:multihost.barrier#1]" in str(ei.value)
+        # budget spent: the single-process identity path works again
+        assert _allgather_pickled(b"payload", context="x") == [b"payload"]
+        assert STATS.snapshot()["faults_injected"]["multihost.barrier"] == 1
+
+    def test_merge_injection_is_transient(self):
+        import pickle
+
+        from peasoup_tpu.parallel.multihost import _unpickle_all
+
+        blob = pickle.dumps({"cands": [1, 2]})
+        faults.configure("multihost.merge:n=1")
+        with pytest.raises(R.TransientIOError) as ei:
+            _unpickle_all([blob], context="spsearch:events")
+        assert R.classify(ei.value) == R.TRANSIENT
+        assert _unpickle_all([blob], context="x") == [{"cands": [1, 2]}]
+        assert STATS.snapshot()["faults_injected"]["multihost.merge"] == 1
+
+    def test_real_collective_error_reclassified_transient(self):
+        """A distributed-runtime failure signature (coordinator
+        deadline, dropped connection) re-raises as TransientIOError;
+        a programming error propagates unchanged."""
+        from peasoup_tpu.parallel.multihost import (
+            _classify_collective_error,
+        )
+
+        with pytest.raises(R.TransientIOError):
+            _classify_collective_error(
+                RuntimeError("DEADLINE_EXCEEDED: barrier timed out"),
+                "search:candidates",
+            )
+        with pytest.raises(ValueError, match="bad shape"):
+            _classify_collective_error(ValueError("bad shape"), "x")
+
+    def test_sites_zero_cost_when_off(self):
+        faults.configure(None)
+        t0 = time.perf_counter()
+        for _ in range(10000):
+            faults.fire("multihost.barrier", "hot")
+            faults.fire("multihost.merge", "hot")
+        assert time.perf_counter() - t0 < 0.5
+        assert STATS.snapshot()["faults_injected"] == {}
+
+
+# --------------------------------------------------------------------------
+# cache.corrupt through the persistent XLA compilation cache
+# --------------------------------------------------------------------------
+
+class TestCacheCorruptWarmup:
+    @pytest.fixture()
+    def scratch_cache(self, tmp_path, monkeypatch):
+        """Point the persistent compilation cache at a scratch dir for
+        the duration (resetting jax's lazily-initialised cache object
+        so the dir change takes effect mid-process), restoring the
+        suite's shared cache after."""
+        import jax
+
+        def _reset():
+            try:
+                from jax._src import compilation_cache as _cc
+
+                _cc.reset_cache()
+            except Exception:
+                pass
+
+        cache = str(tmp_path / "xla_cache")
+        old = jax.config.jax_compilation_cache_dir
+        monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", cache)
+        _reset()
+        yield cache
+        jax.config.update("jax_compilation_cache_dir", old)
+        _reset()
+
+    def test_garbled_entry_quarantines_and_recompiles(self, scratch_cache):
+        """The acceptance drill: a cache.corrupt injection during
+        warmup quarantines the persistent cache's entries to
+        ``*.corrupt`` and the program recompiles — warmup reports NO
+        error, and the quarantine is attributable."""
+        import glob as _glob
+
+        from peasoup_tpu.ops.registry import registered_programs
+        from peasoup_tpu.perf.warmup import warm_registry
+        from peasoup_tpu.utils.cache import cache_entry_paths
+
+        name = registered_programs()[0].name
+        cold = warm_registry(programs=[name])
+        assert cold.programs[0].error is None
+        entries = cache_entry_paths(scratch_cache)
+        assert entries  # the cold compile populated the cache
+        # garble a real entry's bytes, then schedule the injection
+        faults.configure("cache.corrupt:n=1")
+        faults.maybe_corrupt_file(entries[0], context="xla-cache-entry")
+        faults.configure("cache.corrupt:n=1")  # re-arm for the seam
+        rep = warm_registry(programs=[name])
+        assert rep.programs[0].error is None  # recovered, not crashed
+        corrupt = _glob.glob(os.path.join(scratch_cache, "*.corrupt"))
+        assert corrupt  # forensics kept aside
+        assert cache_entry_paths(scratch_cache) == []  # all quarantined
+        snap = STATS.snapshot()
+        assert snap["corrupt_artifacts"]["xla cache"] >= 1
+        assert snap["faults_injected"]["cache.corrupt"] >= 1
+        # and a clean pass repopulates the cache from scratch
+        faults.configure(None)
+        again = warm_registry(programs=[name])
+        assert again.programs[0].error is None
+
+    def test_quarantine_helper_renames_not_deletes(self, tmp_path):
+        from peasoup_tpu.utils.cache import (
+            cache_entry_paths,
+            quarantine_cache_entries,
+        )
+
+        d = tmp_path / "cache"
+        d.mkdir()
+        (d / "entry1").write_bytes(b"\x00CHAOS-CORRUPT\x00")
+        (d / "entry2").write_bytes(b"fine")
+        q = quarantine_cache_entries(str(d))
+        assert len(q) == 2
+        assert (d / "entry1.corrupt").read_bytes().startswith(b"\x00CHAOS")
+        assert cache_entry_paths(str(d)) == []
+        assert STATS.snapshot()["corrupt_artifacts"]["xla cache"] == 1
+
+    def test_non_corrupt_compile_error_still_reported(self, scratch_cache):
+        """A genuine trace/compile failure must NOT trigger the cache
+        quarantine — it is a finding, not a torn artifact."""
+        from peasoup_tpu.perf.warmup import _compile_with_cache_recovery
+
+        import jax
+
+        def broken(x):
+            raise ValueError("genuine trace bug")
+
+        err = _compile_with_cache_recovery(
+            jax, broken, (jax.ShapeDtypeStruct((4,), "float32"),), {},
+            "broken", scratch_cache,
+        )
+        assert err is not None and "genuine trace bug" in err
+        assert STATS.snapshot()["corrupt_artifacts"] == {}
+
+
+# --------------------------------------------------------------------------
+# device.oom fall-through: shrink -> (subband ->) CPU instead of raising
+# --------------------------------------------------------------------------
+
+class TestOOMFallThrough:
+    def test_sp_exhaustion_falls_through_to_cpu_bitwise(self, tmp_path):
+        """Single-pulse driver: exhausting the shrink rung
+        (dm_block=4 -> 2 -> 1, three injections) steps the cpu_backend
+        rung instead of raising, and the candidates are bitwise-equal
+        to the fault-free run."""
+        from test_campaign import make_obs
+
+        from peasoup_tpu.io.sigproc import read_filterbank
+        from peasoup_tpu.pipeline.single_pulse import (
+            SinglePulseConfig,
+            SinglePulseSearch,
+        )
+
+        path = make_obs(str(tmp_path / "o.fil"))
+        fil = read_filterbank(path)
+        cfg = SinglePulseConfig(
+            dm_end=20.0, min_snr=7.0, n_widths=6, dm_block=4,
+            outdir=str(tmp_path),
+        )
+        want = SinglePulseSearch(cfg).run(fil)
+        faults.configure("device.oom:n=3")
+        tel = RunTelemetry()
+        with tel.activate():
+            got = SinglePulseSearch(cfg).run(fil)
+        rungs = [
+            (e["ladder"], e["rung"]) for e in tel.events
+            if e["kind"] == "degradation"
+        ]
+        assert rungs == [
+            ("spsearch.memory", "dm_block_shrink"),
+            ("spsearch.memory", "dm_block_shrink"),
+            ("spsearch.memory", "cpu_backend"),
+        ]
+        assert not any(
+            e["kind"] == "degradation_exhausted" for e in tel.events
+        )
+        assert len(got.candidates) == len(want.candidates) > 0
+        for a, b in zip(want.candidates, got.candidates):
+            assert (a.dm_idx, a.sample, a.width) == (
+                b.dm_idx, b.sample, b.width
+            )
+            assert a.snr == b.snr  # bitwise
+        assert STATS.snapshot()["degradations"][
+            "spsearch.memory:cpu_backend"
+        ] == 1
+
+    def test_search_falls_through_subband_then_cpu_bitwise(self, tmp_path):
+        """Periodicity driver: three injections exhaust the shrink
+        rung into the exact-subband rung; a fourth OOMs the subband
+        attempt into the CPU rung. Both paths must produce candidates
+        bitwise-equal to the fault-free run (max_smear=0 subbanding is
+        the direct sum; the CPU rung re-runs the identical programs)."""
+        import numpy as np
+
+        from peasoup_tpu.io.sigproc import read_filterbank
+        from peasoup_tpu.perf.warmup import synthetic_bucket_observation
+        from peasoup_tpu.pipeline.search import PeasoupSearch, SearchConfig
+
+        bucket = (8, 8, 4096, 0.000256, 1400.0, -16.0)
+        fil = synthetic_bucket_observation(
+            bucket, str(tmp_path / "o.fil")
+        )
+        cfg = SearchConfig(
+            dm_end=20.0, min_snr=7.0, dm_block=4, outdir=str(tmp_path),
+            limit=50,
+        )
+        want = PeasoupSearch(cfg).run(fil)
+        assert len(want.candidates) > 0  # the pulse train is periodic
+
+        def sig(res):
+            return [
+                (c.dm_idx, c.nh, c.acc, c.freq, c.snr)
+                for c in res.candidates
+            ]
+
+        # n=3: shrink x2 -> subband rung runs clean
+        faults.configure("device.oom:n=3")
+        tel = RunTelemetry()
+        with tel.activate():
+            got = PeasoupSearch(cfg).run(fil)
+        rungs = [
+            e["rung"] for e in tel.events if e["kind"] == "degradation"
+        ]
+        assert rungs == ["dm_block_shrink", "dm_block_shrink", "subband"]
+        assert sig(got) == sig(want)
+
+        # n=6: the subband rung's own shrink sequence (restarted at
+        # the full block) OOMs to the floor too -> CPU rung
+        faults.configure("device.oom:n=6")
+        tel = RunTelemetry()
+        with tel.activate():
+            got2 = PeasoupSearch(cfg).run(fil)
+        rungs2 = [
+            e["rung"] for e in tel.events if e["kind"] == "degradation"
+        ]
+        # in-rung shrinks after the subband step are events, not
+        # ladder steps (a ladder never climbs back up)
+        assert rungs2 == [
+            "dm_block_shrink", "dm_block_shrink", "subband", "cpu_backend",
+        ]
+        assert sum(
+            1 for e in tel.events if e["kind"] == "oom_shrink_retry"
+        ) == 4
+        assert sig(got2) == sig(want)
+        assert np.isfinite([c.snr for c in got2.candidates]).all()
+
+    def test_degraded_flag_lands_in_done_record(self, tmp_path):
+        """A campaign job that descended a ladder completes with
+        degraded=true in its done record (and the rollup tallies it)."""
+        from test_campaign import make_obs
+
+        from peasoup_tpu.campaign.queue import JobQueue, job_id_for
+        from peasoup_tpu.campaign.rollup import build_status
+        from peasoup_tpu.campaign.runner import (
+            CampaignConfig,
+            bucket_for_input,
+            enqueue_entries,
+            run_worker,
+            save_campaign_config,
+        )
+
+        root = str(tmp_path / "camp")
+        obs = make_obs(str(tmp_path / "o.fil"))
+        save_campaign_config(
+            root,
+            CampaignConfig(
+                warmup=False,
+                config={
+                    "dm_end": 20.0, "min_snr": 7.0, "n_widths": 6,
+                    "dm_block": 4,
+                },
+            ),
+        )
+        q = JobQueue(root)
+        enqueue_entries(q, [{"input": obs}], "spsearch")
+        faults.configure("device.oom:n=3")  # exhausts into the cpu rung
+        tally = run_worker(root, worker_id="w1", poll_s=0.05)
+        faults.configure(None)
+        assert tally["done"] == 1
+        [done] = q.done_records()
+        assert done["degraded"] is True
+        assert done["resilience"]["degradations"][
+            "spsearch.memory:cpu_backend"
+        ] == 1
+        st = build_status(root, q)
+        assert st["degraded_jobs"] == 1
