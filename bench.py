@@ -227,15 +227,32 @@ def bench_survey() -> int:
     # only collects device events, the dominant wall terms are still
     # upload + dispatch + compile)
     phase_dev: dict = {}
+    survey_stages: dict = {}
     res = None
     t0 = time.time()
     try:
+        import jax as _jax
+
+        from peasoup_tpu.perf.roofline import stage_roofline
         from peasoup_tpu.tools.scope_trace import scope_trace
 
         with scope_trace() as tr:
             res = search.run(fil)
         phase_dev = tr.phase_seconds()
         phase_dev["total"] = tr.device_s
+        # per-stage device-busy + roofline attribution from the SAME
+        # trace (perf/roofline.py; fold FLOPs left null — the survey
+        # roofline attributes the search phases)
+        from peasoup_tpu.plan.fft_plan import choose_fft_size as _cfs
+
+        survey_stages = stage_roofline(
+            tr.stage_profile(),
+            _search_stage_flops(
+                ndm, fil.nchans, search.build_dm_plan(fil).out_nsamps,
+                _cfs(fil.nsamps, 0), ndm, 4,
+            ),
+            str(_jax.local_devices()[0].device_kind),
+        )
     except Exception as exc:  # tracing is best-effort
         print(f"survey device trace failed: {exc!r}", file=sys.stderr)
         if res is None:  # the SEARCH failed, not the trace parse:
@@ -321,6 +338,10 @@ def bench_survey() -> int:
                             ("fold", t_fold),
                         )
                     ),
+                    # per-stage device-busy + roofline attribution
+                    # (perf/roofline.py taxonomy, shared with
+                    # peasoup-perf bench's stage totals)
+                    "stages": survey_stages,
                 },
             }
         )
@@ -432,6 +453,49 @@ from peasoup_tpu.perf.measure import (  # noqa: E402
     device_busy_seconds as _device_busy_seconds,
     median as _median,
 )
+
+
+def _search_stage_flops(ndm, nchans, out_nsamps, size, n_accel, nharms):
+    """Analytic per-stage FLOP estimates for one search run (the
+    roofline numerator; device seconds and bytes are MEASURED from the
+    trace). Conventions: one MAC = 2 FLOPs; the rfft counted at the
+    familiar 2.5 N log2 N; harmonics as one add per level-bin; peaks
+    as ~4 ops per bin per level (threshold, compare, select, count)."""
+    import math as _math
+
+    nbins = size // 2 + 1
+    lg = _math.log2(max(2, size))
+    return {
+        "unpack": float(ndm and nchans * out_nsamps),  # shifts+masks
+        "dedisperse": 2.0 * ndm * nchans * out_nsamps,
+        "spectrum_chain": ndm * (2.5 * size * lg + 12.0 * nbins),
+        "resample": 2.0 * n_accel * size + ndm * 2.5 * size * lg,
+        "harmonics": float(nharms) * n_accel * nbins,
+        "peaks": 4.0 * (nharms + 1) * n_accel * nbins,
+    }
+
+
+def _stage_record(run_fn, stage_flops) -> dict:
+    """One traced run -> the BENCH ``stages`` section: per-stage
+    device-busy seconds + measured bytes from the profiler trace,
+    joined with analytic FLOPs into roofline fields
+    (peasoup_tpu/perf/roofline.py). {} when tracing fails — absent
+    attribution is visible, never faked."""
+    try:
+        import jax
+
+        from peasoup_tpu.perf.roofline import stage_roofline
+        from peasoup_tpu.tools.scope_trace import scope_trace
+
+        with scope_trace() as tr:
+            run_fn()
+        if not tr.events:
+            return {}
+        kind = str(jax.local_devices()[0].device_kind)
+        return stage_roofline(tr.stage_profile(), stage_flops, kind)
+    except Exception as exc:  # tracing is best-effort
+        print(f"stage roofline trace failed: {exc!r}", file=sys.stderr)
+        return {}
 
 
 def main() -> int:
@@ -600,6 +664,22 @@ def main() -> int:
     except Exception as exc:
         print(f"dedisp-plan tuning failed: {exc!r}", file=sys.stderr)
 
+    # per-stage device-busy + roofline attribution (one extra traced
+    # steady-state run; the same stage taxonomy as peasoup-perf bench,
+    # perf/roofline.py — best-effort, {} when tracing fails)
+    stages: dict = {}
+    if not force_wall:
+        from peasoup_tpu.plan.fft_plan import choose_fft_size
+
+        dm_plan_b = search.build_dm_plan(fil)
+        stages = _stage_record(
+            lambda: search.run(fil),
+            _search_stage_flops(
+                dm_plan_b.ndm, fil.nchans, dm_plan_b.out_nsamps,
+                choose_fft_size(fil.nsamps, 0), n_trials, 4,
+            ),
+        )
+
     # weather-proof primary (BASELINE.md "Official benchmark
     # definition, round 4"): the chip's brute-force rate by device-busy
     # time; min-wall fallback if the trace failed
@@ -657,6 +737,7 @@ def main() -> int:
                     if dedupe_device_s
                     else 0.0
                 ),
+                "stages": stages,
                 **plan_fields,
                 **big,
             }

@@ -323,8 +323,18 @@ def tuned_overrides(
         if plan_doc.get("engine") == "subband":
             out["subbands"] = int(plan_doc["subbands"])
             out["subband_smear"] = float(plan_doc.get("subband_smear", 1.0))
+            if plan_doc.get("subband_matmul"):
+                out["subband_matmul"] = True
+        elif plan_doc.get("engine") == "matmul" and not overrides.get(
+            "dedisp_engine"
+        ):
+            out["dedisp_engine"] = "matmul"
     if "dedisp_block" not in overrides and plan_doc.get("dedisp_block"):
         out["dedisp_block"] = int(plan_doc["dedisp_block"])
+    if "dm_block" not in overrides and plan_doc.get("dm_block"):
+        out["dm_block"] = int(plan_doc["dm_block"])
+    if "accel_bucket" not in overrides and plan_doc.get("accel_bucket"):
+        out["accel_bucket"] = int(plan_doc["accel_bucket"])
     out["tune"] = False
     return out
 

@@ -75,6 +75,13 @@ def build_parser() -> argparse.ArgumentParser:
         "when --subbands is set (0 = exact)",
     )
     p.add_argument(
+        "--dedisp_engine", default="", choices=("", "exact", "matmul"),
+        help="force one dedispersion engine: the gather channel scan "
+        "(exact) or the MXU banded matmul (matmul) — bitwise-equal "
+        "outputs; default lets the plan/tuner decide (subband is "
+        "forced via --subbands)",
+    )
+    p.add_argument(
         "--tune", action=argparse.BooleanOptionalAction, default=False,
         help="auto-select exact-vs-subband dedispersion and load "
         "per-device tuned shape knobs from the tuning cache "
@@ -183,6 +190,7 @@ def main(argv: list[str] | None = None) -> int:
         dedupe_accel=not args.no_accel_dedupe,
         subbands=args.subbands,
         subband_smear=args.subband_smear,
+        dedisp_engine=args.dedisp_engine,
         tune=args.tune,
         tuning_cache=args.tuning_cache,
     )

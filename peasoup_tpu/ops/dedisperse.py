@@ -331,18 +331,187 @@ def _dedisperse_device_once(
     return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
 
 
+# ---------------------------------------------------------------------------
+# MXU banded-matmul engine (ISSUE 12): the shift-and-sum recast as a
+# one-hot banded contraction so the inner loop runs on the MXU.
+#
+# For a block of adjacent DM trials the per-channel delays decompose as
+# delay[d, c] = base[c] + resid[d, c] with base[c] the block minimum and
+# resid small (adjacent trials' delays differ slowly). With the one-hot
+# operand W[d, c, v] = (resid[d, c] == v) the shift-and-sum becomes
+#
+#     out[d, t] = sum_{c, v} W[d, c, v] * x[t + base[c] + v, c]
+#
+# — a VALID cross-correlation of the base-aligned channel windows with a
+# (D, C, band) selection kernel, i.e. exactly the (trials x band) @
+# (band x samples) banded matmul of arXiv:1201.5380's factorisation
+# once XLA im2col-unfolds it, which on TPU lowers to MXU convolutions.
+# MACs grow from D*C*T to D*C*band*T, but each MAC runs at matrix-unit
+# rather than gather/add throughput; the planner's cost model
+# (plan/dedisp_plan.py) and the per-device tuner (perf/tuning.py)
+# arbitrate. Products are x*1 or x*0 and channel sums of <=8-bit
+# samples are exact integers in f32, so the result is BITWISE equal to
+# the gather engines for integer inputs regardless of summation order;
+# pure-f32 filterbanks may differ by association (pinned ULP tolerance
+# in tests/test_matmul_dedisp.py).
+# ---------------------------------------------------------------------------
+
+MATMUL_BAND_QUANT = 8  # resid band rounds up to this (bounds compile count)
+MATMUL_BLOCK = 64  # DM trials per banded-matmul dispatch
+
+
+def matmul_band(delays_block: np.ndarray, quant: int = MATMUL_BAND_QUANT) -> int:
+    """The padded one-hot band of one DM-trial block: the largest
+    per-channel delay spread across the block plus one, rounded up to
+    ``quant`` so nearby blocks share a compiled shape."""
+    d = np.asarray(delays_block)
+    spread = int((d.max(axis=0) - d.min(axis=0)).max()) + 1
+    return -(-spread // quant) * quant
+
+
+def banded_onehot(
+    delays_block: np.ndarray, band: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """(base (C,) i32, onehot (D, C, band) f32) for one trial block:
+    the sparse shift-selection operand of the banded matmul."""
+    d = np.asarray(delays_block, dtype=np.int64)
+    base = d.min(axis=0)
+    resid = d - base[None, :]
+    onehot = (
+        resid[:, :, None] == np.arange(band, dtype=np.int64)[None, None, :]
+    ).astype(np.float32)
+    return base.astype(np.int32), onehot
+
+
+def _banded_conv(xb: jax.Array, onehot: jax.Array) -> jax.Array:
+    """out[d, t] = sum_{c, v} onehot[d, c, v] * xb[c, t + v] as a VALID
+    1-D correlation (XLA lowers this to the MXU on TPU backends)."""
+    return jax.lax.conv_general_dilated(
+        xb[None],
+        onehot,
+        window_strides=(1,),
+        padding="VALID",
+        dimension_numbers=("NCH", "OIH", "NCH"),
+        preferred_element_type=jnp.float32,
+    )[0]
+
+
+@partial(jax.jit, static_argnames=("out_nsamps", "quantize", "scale"))
+def dedisperse_matmul_block(
+    fil_tc: jax.Array,  # (T, C) u8/f32 filterbank (zero-padded so that
+    # base[c] + out_nsamps + band - 1 <= T for every channel)
+    base: jax.Array,  # (C,) i32 per-channel block-minimum delay
+    onehot: jax.Array,  # (D, C, band) f32 one-hot shift selection
+    killmask: jax.Array,  # (C,) 1 = keep
+    *,
+    out_nsamps: int,
+    quantize: bool = True,
+    scale: float = 1.0,
+) -> jax.Array:
+    """One DM-trial block on the MXU: slice each channel's base-aligned
+    window, then contract against the one-hot band. Returns
+    (D, out_nsamps) u8 (quantize) or f32, bitwise equal to
+    :func:`dedisperse_block` for integer inputs."""
+    band = onehot.shape[-1]
+    win = out_nsamps + band - 1
+    x_ct = fil_tc.T  # stays in the upload dtype until after the slice
+    xb = jax.vmap(
+        lambda row, b: jax.lax.dynamic_slice(row, (b,), (win,))
+    )(x_ct, base)
+    xb = xb.astype(jnp.float32) * killmask.astype(jnp.float32)[:, None]
+    out = _banded_conv(xb, onehot)
+    if scale != 1.0:
+        out = out * jnp.float32(scale)
+    if quantize:
+        out = jnp.clip(jnp.rint(out), 0, 255).astype(jnp.uint8)
+    return out
+
+
+def dedisperse_matmul(
+    fil_tc,  # (T, C) u8/f32 filterbank (numpy or device array)
+    delays: np.ndarray,  # (D, C) int32
+    killmask: np.ndarray,
+    out_nsamps: int,
+    *,
+    quantize: bool = True,
+    scale: float = 1.0,
+    block: int = MATMUL_BLOCK,
+    band_quant: int = MATMUL_BAND_QUANT,
+    chunk_bytes: int = 3_000_000_000,
+) -> jax.Array:
+    """All DM trials through the banded-matmul engine, ``block`` trials
+    per dispatch. Per block, the one-hot band adapts to the real delay
+    spread (rounded to ``band_quant`` so a survey's blocks share a few
+    compiled shapes). Channels chunk when a block's f32 window copy
+    (C * (out + band) * 4 bytes) would exceed ``chunk_bytes``, with f32
+    partials accumulated channel-ascending exactly like
+    :func:`dedisperse_device` (bitwise-identical for integer inputs)."""
+    delays = np.asarray(delays, dtype=np.int32)
+    d, c = delays.shape
+    # per-block bands first: the input pad must cover the largest window
+    blocks = []
+    for lo in range(0, d, block):
+        blk = delays[lo : lo + block]
+        blocks.append((lo, lo + len(blk), matmul_band(blk, band_quant)))
+    band_max = max(b for _, _, b in blocks)
+    win_max = out_nsamps + band_max - 1
+    cc = max(1, int(chunk_bytes // max(1, 4 * win_max)))
+    if cc < c:
+        # channel-chunk recursion: unquantized partials, one final tail
+        acc = None
+        for c0 in range(0, c, cc):
+            part = dedisperse_matmul(
+                fil_tc[:, c0 : c0 + cc], delays[:, c0 : c0 + cc],
+                np.asarray(killmask)[c0 : c0 + cc], out_nsamps,
+                quantize=False, scale=1.0, block=block,
+                band_quant=band_quant, chunk_bytes=chunk_bytes,
+            )
+            acc = part if acc is None else acc + part
+        if scale != 1.0:
+            acc = acc * jnp.float32(scale)
+        if quantize:
+            acc = jnp.clip(jnp.rint(acc), 0, 255).astype(jnp.uint8)
+        return acc
+    t_in = fil_tc.shape[0]
+    t_need = int(delays.max()) + out_nsamps + band_max
+    x_dev = jnp.asarray(fil_tc)
+    if t_need > t_in:  # zero tail: only ever multiplied by onehot zeros
+        x_dev = jnp.pad(x_dev, ((0, t_need - t_in), (0, 0)))
+    kill_dev = jnp.asarray(np.asarray(killmask))
+    outs = []
+    for lo, hi, band in blocks:
+        blk = delays[lo:hi]
+        pad = 0
+        if hi - lo < block:  # repeat the last trial: one shape per band
+            pad = block - (hi - lo)
+            blk = np.concatenate([blk, np.repeat(blk[-1:], pad, axis=0)])
+        base, onehot = banded_onehot(blk, band)
+        res = dedisperse_matmul_block(
+            x_dev, jnp.asarray(base), jnp.asarray(onehot), kill_dev,
+            out_nsamps=out_nsamps, quantize=quantize, scale=scale,
+        )
+        outs.append(res[: block - pad] if pad else res)
+    return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
+
+
 def subband_groups(
     delay_table: np.ndarray,  # (D, C) int32 per-trial per-channel delays
     nsub: int,
     max_smear: float,
+    budgets: np.ndarray | None = None,
 ) -> list[tuple[int, int]]:
     """Greedy grouping of adjacent DM trials sharing one nominal DM for
     two-stage subband dedispersion (the scheme of the dedisp library
     the reference links, dedisperser.hpp:25-31 — there hidden inside
     `dedisp_execute`). Trials join the group opened by trial ``lo``
     while the worst-case intra-subband smear of substituting trial lo's
-    channel shape stays <= ``max_smear`` samples. ``max_smear=0`` gives
-    singleton groups (exact direct equality). Returns [lo, hi) spans.
+    channel shape stays <= the joining trial's budget — ``max_smear``
+    samples for every trial, or ``budgets[hi]`` when the caller passes
+    the DM-scaled per-trial budgets (plan/dedisp_plan.py:
+    dm_smear_budgets, so high-DM trials whose intrinsic smearing
+    already dwarfs a sample stop forcing conservative plans).
+    ``max_smear=0`` gives singleton groups (exact direct equality).
+    Returns [lo, hi) spans.
     """
     D, C = delay_table.shape
     w = -(-C // nsub)
@@ -351,6 +520,7 @@ def subband_groups(
     while lo < D:
         hi = lo + 1
         while hi < D:
+            cap = max_smear if budgets is None else float(budgets[hi])
             # smear of trial hi under trial lo's intra-band shape:
             # max_c |(d[hi,c]-d[hi,ref]) - (d[lo,c]-d[lo,ref])|
             err = 0
@@ -362,9 +532,9 @@ def subband_groups(
                 err = max(
                     err, int(np.abs((dh - dh.min()) - (dl - dl.min())).max())
                 )
-                if err > max_smear:
+                if err > cap:
                     break
-            if err > max_smear:
+            if err > cap:
                 break
             hi += 1
         groups.append((lo, hi))
@@ -429,6 +599,66 @@ def _stage2_batched(out_nsamps: int, quantize: bool, scale: float):
     )
 
 
+@lru_cache(maxsize=None)
+def _stage1_matmul_batched(out_len: int, band: int):
+    """Jitted group-batched stage 1 as a banded matmul: the grouped
+    filterbank's per-band rows correlate against a per-(group, band)
+    one-hot shift selection, vmapped over subbands — the stage-1 twin
+    of :func:`dedisperse_matmul_block` (groups play the trial role:
+    adjacent nominal DMs have slowly-varying intra-band shapes, so the
+    one-hot band stays narrow). fn(x_swt (S, w, T) u8/f32,
+    kill_sw (S, w), base_sw (S, w) i32, onehot (G, S, w, band)) ->
+    (G, S, out_len/128, 128) f32, bitwise the scan stage's output for
+    integer inputs."""
+
+    def per_band(x_wt, kill_w, base_w, onehot_gwb):
+        rows = x_wt.astype(jnp.float32) * kill_w[:, None]
+        # static tail pad keeps every base-aligned window in range; the
+        # pad region is only ever multiplied by one-hot zeros
+        rows = jnp.pad(rows, ((0, 0), (0, band)))
+        win = out_len + band - 1
+        xb = jax.vmap(
+            lambda r, b: jax.lax.dynamic_slice(r, (b,), (win,))
+        )(rows, base_w)
+        return _banded_conv(xb, onehot_gwb)  # (G, out_len)
+
+    def run(x_swt, kill_sw, base_sw, onehot_gswb):
+        out = jax.vmap(per_band, in_axes=(0, 0, 0, 1))(
+            x_swt, kill_sw, base_sw, onehot_gswb
+        )  # (S, G, out_len)
+        g = out.shape[1]
+        return jnp.swapaxes(out, 0, 1).reshape(g, out.shape[0], -1, 128)
+
+    return jax.jit(run)
+
+
+@lru_cache(maxsize=None)
+def _stage2_matmul_batched(
+    out_nsamps: int, quantize: bool, scale: float, band: int
+):
+    """Jitted group-batched stage 2 as a banded matmul over subband
+    partial series (subbands play the channel role). fn(s1
+    (G, S, nb1, 128) f32, base (G, S) i32, onehot (G, g_pad, S, band))
+    -> (G, g_pad, out_nsamps), bitwise the scan stage's output for
+    integer-valued stage-1 sums."""
+
+    def per_group(x_blk, base_s, onehot_dsb):
+        rows = x_blk.reshape(x_blk.shape[0], -1)
+        rows = jnp.pad(rows, ((0, 0), (0, band)))
+        win = out_nsamps + band - 1
+        xb = jax.vmap(
+            lambda r, b: jax.lax.dynamic_slice(r, (b,), (win,))
+        )(rows, base_s)
+        out = _banded_conv(xb, onehot_dsb)
+        if scale != 1.0:
+            out = out * jnp.float32(scale)
+        if quantize:
+            out = jnp.clip(jnp.rint(out), 0, 255).astype(jnp.uint8)
+        return out
+
+    return jax.jit(jax.vmap(per_group))
+
+
 def dedisperse_subband(
     fil_tc,  # (T, C) u8/f32 filterbank (numpy or device)
     delay_table: np.ndarray,  # (D, C) int32 from DMPlan.delay_samples()
@@ -440,6 +670,8 @@ def dedisperse_subband(
     quantize: bool = True,
     scale: float = 1.0,
     to_host: bool = False,
+    use_matmul: bool = False,
+    budgets: np.ndarray | None = None,
 ):
     """Two-stage subband dedispersion of ALL trials.
 
@@ -452,7 +684,11 @@ def dedisperse_subband(
     g*C*T — ~sqrt(C)-fold less at survey channel counts when
     g ~ C/S ~ S. The approximation replaces each trial's intra-band
     delay shape by its nominal's; grouping bounds that error to
-    ``max_smear`` samples (0 => bitwise equal to the direct path).
+    ``max_smear`` samples (0 => bitwise equal to the direct path), or
+    to the per-trial ``budgets`` when given (the DM-scaled smear
+    budget, plan/dedisp_plan.py). With ``use_matmul`` both stages run
+    as banded matmuls on the MXU (bitwise-identical for integer
+    inputs; see the banded-matmul engine block above).
 
     Returns (D, out_nsamps), device-resident (or numpy with
     ``to_host``, for surveys whose trial block spills to host RAM).
@@ -464,7 +700,7 @@ def dedisperse_subband(
     w = -(-C // max(1, min(nsub, C)))
     nsub = -(-C // w)
     cpad = w * nsub - C
-    groups = subband_groups(delay_table, nsub, max_smear)
+    groups = subband_groups(delay_table, nsub, max_smear, budgets)
 
     # per-band reference = the band's MINIMUM delay (robust to either
     # frequency ordering and to rint non-monotonicity): d1 >= 0 always
@@ -512,11 +748,23 @@ def dedisperse_subband(
     # (ADVICE r1: the output term dominates for tall groups) — stays
     # ~1 GB without one tall low-DM bucket collapsing the batching of
     # the small-group tail. Compiled shapes: one per (gb, g_pad) bucket.
-    stage1_b = _stage1_batched(nb1)
-    stage2_b = _stage2_batched(out_nsamps, quantize, scale)
+    stage1_b = None if use_matmul else _stage1_batched(nb1)
+    stage2_b = (
+        None if use_matmul else _stage2_batched(out_nsamps, quantize, scale)
+    )
 
     def g_pad_of(lo, hi):
         return 1 << (hi - lo - 1).bit_length() if hi - lo > 1 else 1
+
+    def band_of(resid) -> int:
+        return -(
+            -(int(resid.max()) + 1) // MATMUL_BAND_QUANT
+        ) * MATMUL_BAND_QUANT
+
+    def onehot_of(resid, band):
+        return (
+            resid[..., None] == np.arange(band, dtype=resid.dtype)
+        ).astype(np.float32)
 
     outs = []
     i = 0
@@ -537,14 +785,51 @@ def dedisperse_subband(
                     for lo, _ in batch
                 ]
             )
-            rd = np.stack(
-                [
-                    np.pad(refdel[lo:hi], ((0, g_pad - (hi - lo)), (0, 0)))
-                    for lo, hi in batch
-                ]
-            )
-            s1 = stage1_b(x_swt, kill_sw, jnp.asarray(d1))  # (gb,S,nb1,128)
-            res = stage2_b(s1, jnp.asarray(rd, dtype=np.int32))
+            if use_matmul:
+                # both stages as banded matmuls: groups play the trial
+                # role in stage 1 (adjacent nominals' intra-band shapes
+                # vary slowly), trials within a group in stage 2; pad
+                # trials repeat the last row so the band stays narrow
+                # (zero-delay pad rows would blow it open)
+                base1 = d1.min(axis=0)
+                r1 = d1 - base1[None]
+                band1 = band_of(r1)
+                rd = np.stack(
+                    [
+                        np.pad(
+                            refdel[lo:hi],
+                            ((0, g_pad - (hi - lo)), (0, 0)),
+                            mode="edge",
+                        )
+                        for lo, hi in batch
+                    ]
+                )
+                base2 = rd.min(axis=1)
+                r2 = rd - base2[:, None, :]
+                band2 = band_of(r2)
+                s1 = _stage1_matmul_batched(nb1 * 128, band1)(
+                    x_swt, kill_sw,
+                    jnp.asarray(base1.astype(np.int32)),
+                    jnp.asarray(onehot_of(r1, band1)),
+                )
+                res = _stage2_matmul_batched(
+                    out_nsamps, quantize, scale, band2
+                )(
+                    s1,
+                    jnp.asarray(base2.astype(np.int32)),
+                    jnp.asarray(onehot_of(r2, band2)),
+                )
+            else:
+                rd = np.stack(
+                    [
+                        np.pad(
+                            refdel[lo:hi], ((0, g_pad - (hi - lo)), (0, 0))
+                        )
+                        for lo, hi in batch
+                    ]
+                )
+                s1 = stage1_b(x_swt, kill_sw, jnp.asarray(d1))
+                res = stage2_b(s1, jnp.asarray(rd, dtype=np.int32))
             if to_host:
                 res = np.asarray(res)  # ONE transfer per batch
             for bi, (lo, hi) in enumerate(batch[: min(b0 + gb, j) - b0]):
@@ -699,4 +984,122 @@ register_program(
         ),
         {},
     ),
+)
+
+
+def _param_dedisperse_matmul(ctx):
+    # the banded-matmul engine's unit of work (the planner's third
+    # alternative): one MATMUL_BLOCK trial chunk at the bucket's padded
+    # window geometry. Declines ctxs whose resolved plan names another
+    # engine — warmup compiles what the driver will dispatch.
+    if ctx.dedisp_engine not in ("", "matmul"):
+        return None
+    d = max(1, min(MATMUL_BLOCK, ctx.ndm))
+    band = MATMUL_BAND_QUANT
+    return (
+        dedisperse_matmul_block,
+        (
+            sds((ctx.nsamps + band, ctx.nchans), "uint8"),
+            sds((ctx.nchans,), "int32"),
+            sds((d, ctx.nchans, band), "float32"),
+            sds((ctx.nchans,), "float32"),
+        ),
+        {
+            "out_nsamps": ctx.out_nsamps,
+            "scale": output_scale(ctx.nbits, ctx.nchans),
+        },
+    )
+
+
+register_program(
+    "ops.dedisperse.dedisperse_matmul_block",
+    lambda: (
+        dedisperse_matmul_block,
+        (
+            sds((256, 8), "uint8"),
+            sds((8,), "int32"),
+            sds((4, 8, 8), "float32"),
+            sds((8,), "float32"),
+        ),
+        {"out_nsamps": 192},
+    ),
+    param=_param_dedisperse_matmul,
+)
+
+
+def _param_subband_matmul(ctx):
+    """Shared geometry for the subband matmul-stage hooks: the tuned
+    plan must have selected the matmul-staged subband engine."""
+    if ctx.subbands <= 0 or not ctx.subband_matmul:
+        return None
+    c = ctx.nchans
+    w = -(-c // max(1, min(ctx.subbands, c)))
+    nsub = -(-c // w)
+    nb1 = -(-ctx.out_nsamps // 128) + 2
+    tpad = (-(-ctx.nsamps // 128) + 3) * 128
+    return nsub, w, nb1, tpad
+
+
+def _param_stage1_matmul(ctx):
+    geo = _param_subband_matmul(ctx)
+    if geo is None:
+        return None
+    nsub, w, nb1, tpad = geo
+    return (
+        _stage1_matmul_batched(nb1 * 128, MATMUL_BAND_QUANT),
+        (
+            sds((nsub, w, tpad), "uint8"),
+            sds((nsub, w), "float32"),
+            sds((nsub, w), "int32"),
+            sds((4, nsub, w, MATMUL_BAND_QUANT), "float32"),
+        ),
+        {},
+    )
+
+
+def _param_stage2_matmul(ctx):
+    geo = _param_subband_matmul(ctx)
+    if geo is None:
+        return None
+    nsub, w, nb1, tpad = geo
+    return (
+        _stage2_matmul_batched(
+            ctx.out_nsamps, True, output_scale(ctx.nbits, ctx.nchans),
+            MATMUL_BAND_QUANT,
+        ),
+        (
+            sds((4, nsub, nb1, 128), "float32"),
+            sds((4, nsub), "int32"),
+            sds((4, 8, nsub, MATMUL_BAND_QUANT), "float32"),
+        ),
+        {},
+    )
+
+
+register_program(
+    "ops.dedisperse.subband_stage1_matmul",
+    lambda: (
+        _stage1_matmul_batched(256, 8),
+        (
+            sds((2, 4, 512), "uint8"),  # (S, w, T) grouped filterbank
+            sds((2, 4), "float32"),  # (S, w) killmask
+            sds((2, 4), "int32"),  # (S, w) batch-min intra-band delays
+            sds((3, 2, 4, 8), "float32"),  # (G, S, w, band) one-hot
+        ),
+        {},
+    ),
+    param=_param_stage1_matmul,
+)
+register_program(
+    "ops.dedisperse.subband_stage2_matmul",
+    lambda: (
+        _stage2_matmul_batched(192, True, 1.0, 8),
+        (
+            sds((2, 4, 4, 128), "float32"),  # (G, S, nb1, 128) stage-1 sums
+            sds((2, 4), "int32"),  # (G, S) group-min stage-2 delays
+            sds((2, 3, 4, 8), "float32"),  # (G, D, S, band) one-hot
+        ),
+        {},
+    ),
+    param=_param_stage2_matmul,
 )

@@ -459,6 +459,140 @@ def probe_pallas_boxcar(n_widths: int, span: int) -> bool:
         return False
 
 
+@lru_cache(maxsize=None)
+def probe_pallas_spchain(n_widths: int, span: int, dec: int) -> bool:
+    """REAL compile+run probe of the fused single-pulse chain tail
+    (ops/pallas/spchain.py: boxcar sweep + dec-fold in one VMEM pass)
+    at the production width count, tile span and decimation, gated on
+    BITWISE equality with the jnp twin
+    (ops.singlepulse.boxcar_dec_best_twin). Beyond the boxcar kernel's
+    feature set this needs the (span/dec, dec) retile of the sweep
+    tile, whose Mosaic support varies by toolchain — exactly what the
+    probe arbitrates before the driver may route to the kernel."""
+    if not backend_supports_pallas() or span <= 0 or dec <= 0:
+        return False
+    if span % dec:
+        return False
+    try:
+        import numpy as np
+        import jax.numpy as jnp
+
+        from .spchain import boxcar_dec_best_pallas
+        from ..singlepulse import (
+            boxcar_dec_best_twin,
+            default_widths,
+            prefix_sum_padded,
+            width_extent,
+            width_scales,
+        )
+
+        widths = default_widths(n_widths)
+        scales = width_scales(widths)
+        tpad = 2 * span
+        wext = width_extent(widths)
+        rng = np.random.default_rng(0)
+        nvalid = tpad - span // 2  # exercise the validity tail mask
+        norm = rng.normal(size=(3, nvalid)).astype(np.float32)
+        # a planted bright pulse makes argmax/width data-sensitive; a
+        # duplicated value exercises the first-max tie rule
+        norm[1, nvalid // 3 : nvalid // 3 + 16] += 25.0
+        norm[2, 100] = norm[2, 100 + dec // 2] = 30.0
+        csum = prefix_sum_padded(jnp.asarray(norm), tpad, wext)
+        got = boxcar_dec_best_pallas(
+            csum, widths, scales, nvalid, tpad, dec, span=span
+        )
+        ref = boxcar_dec_best_twin(csum, widths, scales, nvalid, tpad, dec)
+        ok = all(
+            np.array_equal(np.asarray(g), np.asarray(r))
+            for g, r in zip(got, ref)
+        )
+        if not ok:
+            import warnings
+
+            warnings.warn(
+                f"Pallas single-pulse chain kernel FAILED the bitwise "
+                f"oracle check at n_widths={n_widths}, span={span}, "
+                f"dec={dec}; using the unfused path"
+            )
+        return ok
+    except Exception as exc:  # any Mosaic/compile failure -> unfused path
+        import warnings
+
+        warnings.warn(
+            f"Pallas single-pulse chain kernel unavailable at "
+            f"n_widths={n_widths}, span={span}, dec={dec}; using the "
+            f"unfused path: {type(exc).__name__}: {exc}"
+        )
+        return False
+
+
+@lru_cache(maxsize=None)
+def probe_pallas_specchain() -> bool:
+    """REAL compile+run probe of the fused deredden+zap+interbin kernel
+    (ops/pallas/specchain.py) at a small shape, gated on BITWISE
+    equality with the jnp twin (ops.spectrum.interp_deredden_zap): the
+    kernel replays the same f32 divide/select/square/max/sqrt chain,
+    so any difference means a broken lowering (carry off by a tile,
+    roll off by a lane, bad mask). The varying features (static
+    pltpu.roll, VMEM carry scratch, scalar-prefetch bins count) are
+    shape-independent, so one probe at the production SPEC_BLOCK
+    gates every production shape."""
+    if not backend_supports_pallas():
+        return False
+    try:
+        import numpy as np
+        import jax.numpy as jnp
+
+        from .specchain import SPEC_BLOCK, interp_deredden_zap_pallas
+        from ..spectrum import interp_deredden_zap
+
+        rng = np.random.default_rng(0)
+        nbins = SPEC_BLOCK + SPEC_BLOCK // 2 + 1  # odd, forces the pad
+        d = 9  # forces the row pad
+        re = jnp.asarray(rng.normal(size=(d, nbins)).astype(np.float32))
+        im = jnp.asarray(rng.normal(size=(d, nbins)).astype(np.float32))
+        med = jnp.asarray(
+            (0.5 + rng.random((d, nbins))).astype(np.float32)
+        )
+        zap = np.zeros(nbins, dtype=bool)
+        zap[40:44] = True
+        zap[2] = True  # a birdie inside the zeroed low bins
+        zap[SPEC_BLOCK - 1 : SPEC_BLOCK + 1] = True  # tile boundary
+        zapj = jnp.asarray(zap)
+        got = interp_deredden_zap_pallas(re, im, med, zapj)
+        ref = interp_deredden_zap(re, im, med, zapj)
+        # parts are pure select/divide chains: BITWISE. The amplitude
+        # carries the mul+add sums whose only legitimate deviation is
+        # FMA-contraction codegen: per-bin envelope (s0_envelope), the
+        # dftspec/interbin discipline — a structural fault (bad carry,
+        # shifted lane) perturbs bins by O(rms), orders above it
+        from .specchain import s0_envelope
+
+        s0_got, s0_ref = np.asarray(got[2]), np.asarray(ref[2])
+        ok = all(
+            np.array_equal(np.asarray(g), np.asarray(r))
+            for g, r in zip(got[:2], ref[:2])
+        ) and bool(
+            (np.abs(s0_got - s0_ref) <= s0_envelope(s0_ref)).all()
+        )
+        if not ok:
+            import warnings
+
+            warnings.warn(
+                "Pallas spectrum chain kernel FAILED the bitwise oracle "
+                "check; using the unfused path"
+            )
+        return ok
+    except Exception as exc:  # any Mosaic/compile failure -> unfused path
+        import warnings
+
+        warnings.warn(
+            f"Pallas spectrum chain kernel unavailable; using the "
+            f"unfused path: {type(exc).__name__}: {exc}"
+        )
+        return False
+
+
 from .resample import resample_block_pallas, resample_block  # noqa: E402
 
 

@@ -1,0 +1,181 @@
+"""Pallas TPU kernel for the fused single-pulse chain tail:
+boxcar width sweep + dec-fold best-plane decimation in one VMEM pass.
+
+The unfused pair (ops/pallas/boxcar.py, then the jnp reshape/max/argmax
+decimation in ops.singlepulse.make_single_pulse_search_fn) writes the
+full (D, tpad) best-S/N and best-width planes to HBM only for the very
+next op to re-read and crush them ``dec``-fold. This kernel keeps the
+whole tail resident: per (dm, tile) grid step one dynamic-offset DMA
+brings in the prefix-sum window, the width sweep runs as lane-rolls of
+that window exactly like the boxcar kernel, and the dec-fold
+(block max, in-block argmax, width at the argmax) happens on the VMEM
+tile before anything touches HBM — the planes that leave the chip are
+``dec``x smaller.
+
+Index math is the identical f32/i32 chain as the jnp twin
+(ops.singlepulse.boxcar_dec_best_twin): subtract, scale, mask,
+strict-> running max, then first-max argmax via a lane-iota min — so
+outputs are BITWISE equal to it; the probe
+(ops.pallas.probe_pallas_spchain) gates on exactly that. The dec-fold
+retile of the (1, span) sweep into (span/dec, dec) sublane x lane form
+is the one feature beyond ops/pallas/boxcar.py's set, and Mosaic
+support for it varies by toolchain — which is precisely why the probe
+compiles and runs the real kernel before the driver may route to it.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_QUANT = 1024
+
+
+def _kernel(
+    widths_ref,  # (W,) i32 SMEM (scalar prefetch)
+    scales_ref,  # (W,) f32 SMEM (scalar prefetch)
+    nvalid_ref,  # (1,) i32 SMEM (scalar prefetch)
+    csum_ref,  # flat (D * row_stride,) f32 HBM
+    bmax_ref,  # (1, span // dec) f32 VMEM out tile
+    barg_ref,  # (1, span // dec) i32 VMEM out tile (in-block argmax)
+    bw_ref,  # (1, span // dec) i32 VMEM out tile (width at argmax)
+    win_ref,  # (span + wext,) f32 VMEM scratch
+    sem,
+    *,
+    span: int,
+    wext: int,
+    dec: int,
+    row_stride: int,
+    n_widths: int,
+    interpret: bool,
+):
+    d = pl.program_id(0)
+    g = pl.program_id(1)
+    clen = span + wext
+    u = d * row_stride + g * span  # 1024-aligned: both terms are
+    copy = pltpu.make_async_copy(
+        csum_ref.at[pl.ds(pl.multiple_of(u, _QUANT), clen)], win_ref, sem
+    )
+    copy.start()
+    j = g * span + jax.lax.broadcasted_iota(jnp.int32, (1, span), 1)
+    nvalid = nvalid_ref[0]
+    neg_inf = jnp.float32(-jnp.inf)
+    copy.wait()
+    chunk = win_ref[...].reshape(1, clen)
+    lo = chunk[:, :span]
+    best = jnp.full((1, span), neg_inf, jnp.float32)
+    bw = jnp.zeros((1, span), jnp.int32)
+    for k in range(n_widths):
+        w = widths_ref[k]
+        scale = scales_ref[k]
+        if interpret:
+            hi = jax.lax.dynamic_slice(chunk, (0, w), (1, span))
+        else:
+            hi = pltpu.roll(chunk, clen - w, axis=1)[:, :span]
+        snr = jnp.where(j + w <= nvalid, (hi - lo) * scale, neg_inf)
+        better = snr > best
+        best = jnp.where(better, snr, best)
+        bw = jnp.where(better, jnp.int32(k), bw)
+    # dec-fold on the resident tile: block max, FIRST-max argmax (the
+    # jnp twin's jnp.argmax semantics) via a lane-iota min, and the
+    # width index at that argmax via a one-hot sum
+    nbd = span // dec
+    blk = best.reshape(nbd, dec)
+    bw_blk = bw.reshape(nbd, dec)
+    bmax = jnp.max(blk, axis=1, keepdims=True)  # (nbd, 1)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (nbd, dec), 1)
+    barg = jnp.min(
+        jnp.where(blk == bmax, lane, jnp.int32(dec)), axis=1, keepdims=True
+    )
+    wsel = jnp.sum(
+        jnp.where(lane == barg, bw_blk, jnp.int32(0)), axis=1, keepdims=True
+    )
+    bmax_ref[:] = bmax.reshape(-1)
+    barg_ref[:] = barg.reshape(-1)
+    bw_ref[:] = wsel.reshape(-1)
+
+
+@lru_cache(maxsize=None)
+def _build(
+    d: int, tpad: int, span: int, wext: int, dec: int, n_widths: int,
+    interpret: bool,
+):
+    row_stride = tpad + wext  # a _QUANT multiple (plan_pad/width_extent)
+    kernel = partial(
+        _kernel,
+        span=span,
+        wext=wext,
+        dec=dec,
+        row_stride=row_stride,
+        n_widths=n_widths,
+        interpret=interpret,
+    )
+    nbd = span // dec
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(d, tpad // span),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=[
+            pl.BlockSpec(
+                (None, nbd), lambda dd, gg, *_: (dd, gg),
+                memory_space=pltpu.VMEM,
+            )
+            for _ in range(3)
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((span + wext,), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((d, tpad // dec), jnp.float32),
+            jax.ShapeDtypeStruct((d, tpad // dec), jnp.int32),
+            jax.ShapeDtypeStruct((d, tpad // dec), jnp.int32),
+        ],
+        interpret=interpret,
+    )
+
+
+def boxcar_dec_best_pallas(
+    csum_pad: jnp.ndarray,  # (D, tpad + wext) from prefix_sum_padded
+    widths: tuple[int, ...],
+    scales: np.ndarray,
+    nvalid: int,
+    tpad: int,
+    dec: int,
+    *,
+    span: int,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Fused width sweep + dec-fold; bitwise equal to
+    ops.singlepulse.boxcar_dec_best_twin. Returns (block max S/N
+    (D, tpad/dec) f32, in-block argmax (D, tpad/dec) i32, width index
+    at the argmax (D, tpad/dec) i32). ``span`` must divide ``tpad``
+    and ``dec`` must divide ``span``."""
+    d, row = csum_pad.shape
+    wext = row - tpad
+    if (
+        tpad % span
+        or span % dec
+        or row % _QUANT
+        or wext <= int(max(widths))
+    ):
+        raise ValueError(
+            f"boxcar_dec_best_pallas: incompatible geometry tpad={tpad} "
+            f"span={span} dec={dec} wext={wext} widths<={max(widths)}"
+        )
+    fn = _build(d, tpad, span, wext, dec, len(widths), interpret)
+    return fn(
+        jnp.asarray(widths, dtype=jnp.int32),
+        jnp.asarray(scales, dtype=jnp.float32),
+        jnp.asarray([nvalid], dtype=jnp.int32),
+        csum_pad.reshape(-1),
+    )

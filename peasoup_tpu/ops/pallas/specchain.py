@@ -1,0 +1,178 @@
+"""Pallas TPU kernel for the fused spectrum-chain tail:
+deredden -> zap -> interbin in one pass over the spectrum.
+
+The unfused once-per-DM-trial stanza (pipeline/accel_search.py
+_preprocess_trial) walks the (D, nbins) spectrum batch once per op:
+deredden reads and rewrites the complex parts, zap reads and rewrites
+them again, and the interbin amplitude pass reads them a third time.
+This kernel streams each (row-block, column-tile) once through VMEM
+and emits all three results — the dereddened+zapped parts (the irfft
+input) and the interbinned amplitude (the stats input) — with the
+interbin's left-neighbour dependency carried across column tiles in a
+VMEM scratch (the column grid axis iterates sequentially per row
+block, like ops/pallas/interbin.py's carry).
+
+The arithmetic is the identical f32 chain as the jnp twin
+(ops.spectrum.interp_deredden_zap): divide, select, square, max, sqrt
+— so outputs are BITWISE equal to it, and the probe
+(ops.pallas.probe_pallas_specchain) gates on exactly that. Columns at
+or past ``nbins`` (the pad to the tile quantum) emit zeros.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+SPEC_BLOCK = 512  # column tile (lanes); rows tile in sublane groups
+_ROWS = 8
+
+
+def s0_envelope(twin: np.ndarray) -> np.ndarray:
+    """Per-bin deviation bound for interpret-mode s0 comparisons: the
+    kernel replays the twin's exact term grouping, so the only
+    legitimate deviation is FMA-contraction codegen in the
+    ``re*re + im*im`` / ``0.5*((dre)^2 + (dim)^2)`` sums — a few ULP of
+    the bin magnitude (the dereddened+zapped parts carry no mul+add
+    adjacency and stay bitwise). Mirrors ops/pallas/dftspec.py's
+    twin_envelope discipline; the on-TPU probe stays bitwise."""
+    t = np.asarray(twin)
+    rms = np.sqrt(np.mean(t * t, axis=-1, keepdims=True))
+    return 1e-6 * (np.abs(t) + rms)
+
+
+def _kernel(
+    nbins_ref,  # (1,) i32 SMEM (scalar prefetch)
+    re_ref,  # (ROWS, BLK) f32 VMEM in tile
+    im_ref,
+    med_ref,
+    zap_ref,  # (1, BLK) i32 in tile (birdie mask as 0/1)
+    reo_ref,  # (ROWS, BLK) f32 VMEM out tiles
+    imo_ref,
+    s0_ref,
+    carry_ref,  # (ROWS, 2) f32 VMEM scratch: last column's (re_d, im_d)
+    *,
+    blk: int,
+    interpret: bool,
+):
+    c = pl.program_id(1)
+    nbins = nbins_ref[0]
+    rows = re_ref.shape[0]
+    j = c * blk + jax.lax.broadcasted_iota(jnp.int32, (rows, blk), 1)
+    valid = j < nbins
+    re = re_ref[...]
+    im = im_ref[...]
+    med = med_ref[...]
+    zap = zap_ref[...] != 0  # (1, BLK) broadcasts over rows
+    low5 = j < 5
+    re_d = jnp.where(low5, jnp.float32(0.0), re / med)
+    im_d = jnp.where(low5, jnp.float32(0.0), im / med)
+    re_d = jnp.where(zap, jnp.float32(1.0), re_d)
+    im_d = jnp.where(zap, jnp.float32(0.0), im_d)
+    re_d = jnp.where(valid, re_d, jnp.float32(0.0))
+    im_d = jnp.where(valid, im_d, jnp.float32(0.0))
+
+    def roll(x, shift):
+        if interpret:
+            return jnp.roll(x, shift, axis=1)
+        return pltpu.roll(x, shift, axis=1)
+
+    # left neighbour: lane roll within the tile, tile-boundary lane
+    # from the carry (zero at the first tile — the twin's k=0 zero)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (rows, blk), 1)
+    first = lane == 0
+    re_l = jnp.where(
+        first,
+        jnp.where(c == 0, jnp.float32(0.0), carry_ref[:, 0:1]),
+        roll(re_d, 1),
+    )
+    im_l = jnp.where(
+        first,
+        jnp.where(c == 0, jnp.float32(0.0), carry_ref[:, 1:2]),
+        roll(im_d, 1),
+    )
+    ampsq = re_d * re_d + im_d * im_d
+    ampsq_diff = 0.5 * ((re_d - re_l) ** 2 + (im_d - im_l) ** 2)
+    s0 = jnp.sqrt(jnp.maximum(ampsq, ampsq_diff))
+    carry_ref[:, 0:1] = re_d[:, blk - 1 :]
+    carry_ref[:, 1:2] = im_d[:, blk - 1 :]
+    reo_ref[...] = re_d
+    imo_ref[...] = im_d
+    s0_ref[...] = jnp.where(valid, s0, jnp.float32(0.0))
+
+
+@lru_cache(maxsize=None)
+def _build(d: int, npad: int, blk: int, interpret: bool):
+    kernel = partial(_kernel, blk=blk, interpret=interpret)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        # rows outer, columns inner: the carry walks each row block's
+        # columns in order
+        grid=(d // _ROWS, npad // blk),
+        in_specs=[
+            pl.BlockSpec(
+                (_ROWS, blk), lambda dd, cc, *_: (dd, cc),
+                memory_space=pltpu.VMEM,
+            )
+            for _ in range(3)
+        ]
+        + [
+            pl.BlockSpec(
+                (None, blk), lambda dd, cc, *_: (0, cc),
+                memory_space=pltpu.VMEM,
+            )
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (_ROWS, blk), lambda dd, cc, *_: (dd, cc),
+                memory_space=pltpu.VMEM,
+            )
+            for _ in range(3)
+        ],
+        scratch_shapes=[pltpu.VMEM((_ROWS, 2), jnp.float32)],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((d, npad), jnp.float32) for _ in range(3)
+        ],
+        interpret=interpret,
+    )
+
+
+def interp_deredden_zap_pallas(
+    re: jnp.ndarray,  # (D, nbins) f32 raw spectrum parts
+    im: jnp.ndarray,
+    med: jnp.ndarray,  # (D, nbins) f32 running median
+    zapmask,  # (nbins,) bool
+    *,
+    block: int = SPEC_BLOCK,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Fused deredden+zap+interbin over a trial batch; bitwise equal to
+    ops.spectrum.interp_deredden_zap. Rows pad to the sublane group and
+    columns to the tile quantum (median pads with ones so the pad
+    division is finite; every pad output is zeroed)."""
+    d, nbins = re.shape
+    dpad = -(-d // _ROWS) * _ROWS
+    npad = -(-nbins // block) * block
+    if dpad > d or npad > nbins:
+        re = jnp.pad(re, ((0, dpad - d), (0, npad - nbins)))
+        im = jnp.pad(im, ((0, dpad - d), (0, npad - nbins)))
+        med = jnp.pad(
+            med, ((0, dpad - d), (0, npad - nbins)), constant_values=1.0
+        )
+    zap = jnp.pad(
+        jnp.asarray(zapmask).astype(jnp.int32), (0, npad - nbins)
+    ).reshape(1, npad)
+    fn = _build(dpad, npad, block, interpret)
+    reo, imo, s0 = fn(
+        jnp.asarray(np.asarray([nbins], dtype=np.int32)), re, im, med, zap
+    )
+    return reo[:d, :nbins], imo[:d, :nbins], s0[:d, :nbins]

@@ -86,6 +86,7 @@ class ShapeCtx:
     max_events: int = 256
     decimate: int = 32
     pallas_span: int = 0
+    sp_fused_span: int = 0  # fused sweep+dec-fold kernel tile (0 = off)
     # streaming geometry (peasoup_tpu/stream/): dedispersed samples per
     # chunk and carried-tail length; 0 = not a streaming ctx (batch
     # campaign buckets), so streaming-only hooks skip it
@@ -95,6 +96,13 @@ class ShapeCtx:
     # plan/dedisp_plan.py): 0 = the direct engine
     subbands: int = 0
     subband_smear: float = 1.0
+    # resolved dedispersion engine ("" = unknown/any; "exact" |
+    # "subband" | "matmul") and whether the subband stages run as
+    # banded matmuls — the matmul-program hooks decline ctxs whose
+    # tuned plan names another engine, so warmup compiles only what
+    # the driver will dispatch
+    dedisp_engine: str = ""
+    subband_matmul: bool = False
     # periodicity-chain geometry (pipeline "search" buckets, derived
     # via plan/accel_plan.py + plan/fft_plan.py in
     # perf.warmup.shape_ctx_for_bucket): 0 fft_size = not a
@@ -171,6 +179,12 @@ REGISTRY_ALIASES = {
         "ops.dedisperse.subband_stage1_batched"
     ),
     "ops.dedisperse._stage2_batched": "ops.dedisperse.subband_stage2",
+    "ops.dedisperse._stage1_matmul_batched": (
+        "ops.dedisperse.subband_stage1_matmul"
+    ),
+    "ops.dedisperse._stage2_matmul_batched": (
+        "ops.dedisperse.subband_stage2_matmul"
+    ),
 }
 
 

@@ -171,6 +171,78 @@ def boxcar_best_twin(
     return best, bw
 
 
+def boxcar_dec_best_twin(
+    csum_pad: jnp.ndarray,  # (D, tpad + wext) from prefix_sum_padded
+    widths: tuple[int, ...],
+    scales: np.ndarray,
+    nvalid: int,
+    tpad: int,
+    dec: int,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Pure-jnp twin of the fused sweep + dec-fold chain tail
+    (ops/pallas/spchain.py): the boxcar width sweep followed by the
+    ``dec``-fold best-plane decimation — (block max S/N (D, tpad/dec),
+    in-block argmax (D, tpad/dec) i32, width index at the argmax).
+    Composes :func:`boxcar_best_twin` with exactly the reshape/max/
+    argmax/take chain the search program historically ran, so the
+    fused routing is bitwise-invisible to candidates."""
+    best, bw = boxcar_best_twin(csum_pad, widths, scales, nvalid, tpad)
+    d = best.shape[0]
+    nbd = tpad // dec
+    blocks = best.reshape(d, nbd, dec)
+    bmax = jnp.max(blocks, axis=-1)
+    barg = jnp.argmax(blocks, axis=-1).astype(jnp.int32)
+    bwidx = jnp.take_along_axis(
+        bw.reshape(d, nbd, dec), barg[..., None], axis=-1
+    )[..., 0]
+    return bmax, barg, bwidx
+
+
+def boxcar_dec_best(
+    norm: jnp.ndarray,  # (D, nsamps) normalised trials
+    widths: tuple[int, ...],
+    dec: int,
+    *,
+    pallas_span: int = 0,  # >0: Pallas BOXCAR kernel for the sweep
+    fused_span: int = 0,  # >0: fused sweep+dec-fold Pallas kernel
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Dispatch the fused chain tail: the sweep+dec-fold mega-kernel
+    when the caller resolved ``fused_span`` (probe passed), else the
+    plain sweep (Pallas boxcar kernel or jnp twin) followed by the jnp
+    decimation — all three routes bitwise identical."""
+    n = norm.shape[-1]
+    tpad, _ = plan_pad(n)
+    wext = width_extent(widths)
+    scales = width_scales(widths)
+    csum_pad = prefix_sum_padded(norm, tpad, wext)
+    if fused_span:
+        from .pallas.spchain import boxcar_dec_best_pallas
+
+        return boxcar_dec_best_pallas(
+            csum_pad, widths, scales, n, tpad, dec, span=fused_span,
+            interpret=interpret,
+        )
+    if pallas_span:
+        from .pallas.boxcar import boxcar_best_pallas
+
+        best, bw = boxcar_best_pallas(
+            csum_pad, widths, scales, n, tpad, span=pallas_span,
+            interpret=interpret,
+        )
+    else:
+        best, bw = boxcar_best_twin(csum_pad, widths, scales, n, tpad)
+    d = best.shape[0]
+    nbd = tpad // dec
+    blocks = best.reshape(d, nbd, dec)
+    bmax = jnp.max(blocks, axis=-1)
+    barg = jnp.argmax(blocks, axis=-1).astype(jnp.int32)
+    bwidx = jnp.take_along_axis(
+        bw.reshape(d, nbd, dec), barg[..., None], axis=-1
+    )[..., 0]
+    return bmax, barg, bwidx
+
+
 def boxcar_best(
     norm: jnp.ndarray,  # (D, nsamps) normalised trials
     widths: tuple[int, ...],
@@ -203,6 +275,7 @@ def make_single_pulse_search_fn(
     max_events: int,
     dec: int,
     pallas_span: int,
+    fused_span: int = 0,
 ):
     """One jitted program: u8/f32 trial block -> per-trial single-pulse
     events. Returns fn(trials (D, nsamps)) ->
@@ -211,10 +284,12 @@ def make_single_pulse_search_fn(
     (overflow — the driver logs and keeps the first K, which arrive in
     ascending time order). Events are ``dec``-fold max-decimated block
     peaks of the best-width plane; the sample index is exact (argmax
-    within the block)."""
+    within the block). The normalise -> boxcar -> dec-fold -> compact
+    chain is ONE jitted program; with ``fused_span`` (probe-gated) the
+    sweep + dec-fold middle runs as the single Pallas mega-kernel
+    (ops/pallas/spchain.py) — bitwise-identical events either way."""
 
     def run(trials: jnp.ndarray):
-        d = trials.shape[0]
         n = trials.shape[-1]
         tpad, _ = plan_pad(n)
         if tpad % dec:
@@ -223,13 +298,11 @@ def make_single_pulse_search_fn(
                 f"{tpad} (use a power of two <= {_QUANT})"
             )
         norm = normalise_trials(trials)
-        best, bw = boxcar_best(
-            norm, widths, pallas_span=pallas_span
+        bmax, barg, bwidx = boxcar_dec_best(
+            norm, widths, dec, pallas_span=pallas_span,
+            fused_span=fused_span,
         )
         nbd = tpad // dec
-        blocks = best.reshape(d, nbd, dec)
-        bmax = jnp.max(blocks, axis=-1)
-        barg = jnp.argmax(blocks, axis=-1).astype(jnp.int32)
         pidx, psnr, pcount = find_peaks_device(
             bmax, jnp.float32(threshold), jnp.int32(0), jnp.int32(nbd),
             max_peaks=max_events,
@@ -237,9 +310,7 @@ def make_single_pulse_search_fn(
         valid = pidx < nbd
         safe = jnp.minimum(pidx, nbd - 1)
         samples = safe * dec + jnp.take_along_axis(barg, safe, axis=-1)
-        widx = jnp.take_along_axis(
-            bw, jnp.clip(samples, 0, tpad - 1), axis=-1
-        )
+        widx = jnp.take_along_axis(bwidx, safe, axis=-1)
         samples = jnp.where(valid, samples, -1)
         widx = jnp.where(valid, widx, 0)
         return samples, widx, psnr, pcount
@@ -270,6 +341,7 @@ def _param_search(ctx):
         make_single_pulse_search_fn(
             tuple(int(w) for w in ctx.widths), float(ctx.min_snr),
             int(ctx.max_events), int(ctx.decimate), int(ctx.pallas_span),
+            int(ctx.sp_fused_span),
         ),
         (sds((ctx.dm_block, ctx.out_nsamps), "uint8"),),
         {},

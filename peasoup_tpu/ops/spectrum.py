@@ -47,6 +47,33 @@ def form_interpolated(fseries: jnp.ndarray) -> jnp.ndarray:
     )
 
 
+def interp_deredden_zap(
+    re: jnp.ndarray,  # (..., nbins) f32 real part of the raw spectrum
+    im: jnp.ndarray,  # (..., nbins) f32 imaginary part
+    med: jnp.ndarray,  # (..., nbins) f32 running median (rednoise)
+    zapmask: jnp.ndarray,  # (nbins,) bool birdie mask
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """The fused spectrum-chain tail as ONE elementwise pass over
+    explicit f32 parts: deredden (divide by the running median, zero
+    bins 0-4, rednoise.deredden), zap birdies to 1+0j (zap.zap_birdies)
+    and Fourier-interpolate the amplitude (form_interpolated_parts) —
+    the jnp twin of the Pallas kernel (ops/pallas/specchain.py), which
+    replays these exact f32 formulas so the probe can gate on bitwise
+    equality. Returns (re_d, im_d, s0): the dereddened+zapped parts
+    (the irfft input) and the interbinned amplitude (the stats input).
+
+    The unfused chain walks the spectrum once per op; this is the
+    pipeline's hot once-per-DM-trial stanza, so one pass matters at
+    survey DM counts."""
+    idx = jnp.arange(re.shape[-1])
+    low5 = idx < 5
+    re_d = jnp.where(low5, 0.0, re / med)
+    im_d = jnp.where(low5, 0.0, im / med)
+    re_d = jnp.where(zapmask, 1.0, re_d)
+    im_d = jnp.where(zapmask, 0.0, im_d)
+    return re_d, im_d, form_interpolated_parts(re_d, im_d)
+
+
 def spectrum_stats(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """(mean, rms, std) over the last axis; std = sqrt(rms^2 - mean^2)
     (stats.hpp:20-23)."""
@@ -137,6 +164,35 @@ register_program(
         {},
     ),
     param=_param_form_interpolated_parts,
+)
+def _param_interp_deredden_zap(ctx):
+    # the once-per-DM-trial fused chain runs over the (dm_block, nbins)
+    # batch BEFORE the accel axis exists
+    if ctx.fft_size <= 0:
+        return None
+    nbins = ctx.fft_size // 2 + 1
+    t = (ctx.dm_block, nbins)
+    return (
+        interp_deredden_zap,
+        (
+            sds(t, "float32"), sds(t, "float32"), sds(t, "float32"),
+            sds((nbins,), "bool"),
+        ),
+        {},
+    )
+
+
+register_program(
+    "ops.spectrum.interp_deredden_zap",
+    lambda: (
+        interp_deredden_zap,
+        (
+            sds((4, 128), "float32"), sds((4, 128), "float32"),
+            sds((4, 128), "float32"), sds((128,), "bool"),
+        ),
+        {},
+    ),
+    param=_param_interp_deredden_zap,
 )
 register_program(
     "ops.spectrum.spectrum_stats",

@@ -20,7 +20,10 @@ import time
 from .warmup import _sink_scope
 
 PERF_SCHEMA = "peasoup_tpu.perf"
-PERF_VERSION = 1
+# v2: per-program "stage" + top-level "stages" totals (the roofline
+# taxonomy shared with BENCH, perf/roofline.py) and the resolved
+# "dedisp" alternative record
+PERF_VERSION = 2
 
 DEFAULT_REPS = 5
 
@@ -134,11 +137,29 @@ def run_microbench(
     if programs:
         wanted = set(programs)
         specs = [s for s in specs if s.name in wanted]
+    from .roofline import stage_for_program
+
     cache_dir = enable_compilation_cache()
     devs = jax.local_devices()
     t0 = time.perf_counter()
-    recs = {spec.name: bench_program(spec, reps=reps, ctx=ctx) for spec in specs}
+    recs = {}
+    for spec in specs:
+        rec = bench_program(spec, reps=reps, ctx=ctx)
+        rec["stage"] = stage_for_program(spec.name)
+        recs[spec.name] = rec
     ok = [r for r in recs.values() if not r["error"]]
+    # per-stage execute totals: the same taxonomy BENCH's device trace
+    # uses (perf/roofline.py STAGES), so a ratchet regression and a
+    # BENCH round name the same bucket
+    stages: dict = {}
+    for r in ok:
+        st = stages.setdefault(
+            r["stage"], {"programs": 0, "execute_s": 0.0}
+        )
+        st["programs"] += 1
+        st["execute_s"] += r["execute_median_s"]
+    for st in stages.values():
+        st["execute_s"] = round(st["execute_s"], 6)
     doc = {
         "schema": PERF_SCHEMA,
         "version": PERF_VERSION,
@@ -149,6 +170,15 @@ def run_microbench(
         "cache_dir": cache_dir,
         "reps": reps,
         "programs": recs,
+        "stages": stages,
+        # the selected dedispersion alternative this bench's ctx (if
+        # any) implies — BENCH records the same field from its tuned
+        # plan, so the two reports stay comparable
+        "dedisp": {
+            "engine": (ctx.dedisp_engine or "exact") if ctx else "exact",
+            "subbands": int(ctx.subbands) if ctx else 0,
+            "subband_matmul": bool(ctx.subband_matmul) if ctx else False,
+        },
         "totals": {
             "programs": len(recs),
             "errors": len(recs) - len(ok),

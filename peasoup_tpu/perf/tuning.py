@@ -48,6 +48,12 @@ DEFAULT_REPS = 3
 PROBE_SAMPLE_BUDGET = 1 << 22
 PROBE_MAX_TRIALS = 64
 BLOCK_CANDIDATES = (8, 16, 32, 64)
+# search-side knob grids (ISSUE 12 satellite): the wave-loop DM-block
+# height, the accel-column padding bucket, and the Pallas resample
+# tile — all ShapeCtx knobs the drivers consume
+DM_BLOCK_CANDIDATES = (16, 32, 64)
+ACCEL_BUCKET_CANDIDATES = (8, 16, 32)
+PALLAS_BLOCK_CANDIDATES = (256, 512)
 
 
 def measurement_count() -> int:
@@ -295,18 +301,28 @@ def tune_plan(
     nbits: int,
     reps: int = DEFAULT_REPS,
     block_candidates: tuple[int, ...] = BLOCK_CANDIDATES,
+    pipeline: str = "search",
 ) -> DedispPlan:
     """Empirically refine ``plan``'s shape knobs on THIS device by
     timing a candidate grid over a scaled probe of the bucket's real
-    delay table. Measures ``dedisp_block`` for the exact engine and
-    the subband count around the analytic winner for the subband
-    engine. Never raises: a failed measurement keeps the analytic
-    knobs (source stays "analytic") — tuning is an optimisation, not
-    a correctness dependency."""
+    delay table. Measures ``dedisp_block`` for the exact engine, the
+    subband count around the analytic winner for the subband engine,
+    and — for search-pipeline plans — RACES the parity-safe engine
+    alternatives (exact / gate-approved subband, with and without
+    matmul stages / banded matmul) over the same probe workload: the
+    measured winner becomes ``plan.engine``, so the matmul engine is
+    selected exactly when it is faster on THIS device (arXiv:1601.01165
+    — the MXU advantage is a device property no model captures).
+    Search-side knobs (``dm_block``, ``accel_bucket``, the Pallas
+    resample tile) tune on the same pass. Never raises: a failed
+    measurement keeps the analytic knobs (source stays "analytic") —
+    tuning is an optimisation, not a correctness dependency."""
     import jax
 
     from ..ops.dedisperse import (
         dedisperse_block,
+        dedisperse_device,
+        dedisperse_matmul,
         dedisperse_subband,
         output_scale,
     )
@@ -329,6 +345,8 @@ def tune_plan(
     try:
         fil_dev = jax.numpy.asarray(fil_probe)
         kill_dev = jax.numpy.asarray(kill)
+        # medians per engine variant for the race below
+        engine_meds: dict[str, float] = {}
         if plan.engine == "subband":
             cands = sorted(
                 {
@@ -359,6 +377,7 @@ def tune_plan(
             if best is not None:
                 plan.subbands = int(best[0])
                 plan.source = "tuned"
+                engine_meds["subband"] = best[1]
         # dedisp_block ranks by per-trial throughput of the direct
         # block program (the exact engine's unit of work; the subband
         # path also dispatches it for its registry/bench twin)
@@ -385,6 +404,15 @@ def tune_plan(
         if best_b is not None:
             plan.dedisp_block = int(best_b[0])
             plan.source = "tuned"
+        if pipeline == "search":
+            _race_engines(
+                plan, trials, engine_meds, fil_dev, delays, kill,
+                probe_out, scale, reps,
+                dedisperse_device, dedisperse_matmul, dedisperse_subband,
+            )
+            _tune_search_knobs(plan, trials, probe_out, reps)
+        elif pipeline == "spsearch":
+            _tune_dm_block_knob(plan, trials, probe_out, reps)
     except Exception as exc:
         log.warning(
             "dedispersion tuner failed (%s: %.200s); keeping analytic "
@@ -393,6 +421,190 @@ def tune_plan(
     plan.trials = trials
     plan.tuning_s = round(time.perf_counter() - t0, 3)
     return plan
+
+
+def _race_engines(
+    plan, trials, engine_meds, fil_dev, delays, kill,
+    probe_out, scale, reps, dedisperse_device, dedisperse_matmul,
+    dedisperse_subband,
+) -> None:
+    """The three-way engine race over the probe workload: exact always,
+    the banded matmul when the analytic model flagged it a candidate,
+    matmul-staged subband when the parity gate approved subband. Every
+    variant is parity-safe (matmul is bitwise-equal; subband was
+    gate-approved by select()), so the fastest MEASURED median wins —
+    the acceptance contract that matmul is chosen only when measured
+    faster. Medians land in ``trials`` with engine provenance."""
+    import jax
+
+    def _timed(name: str, fn) -> None:
+        fn()  # untimed compile/warm pass
+        med = _measure(fn, reps)
+        engine_meds[name] = med
+        trials.append(
+            {"params": {"engine": name}, "median_s": round(med, 6)}
+        )
+
+    _timed(
+        "exact",
+        lambda: jax.block_until_ready(
+            dedisperse_device(
+                fil_dev, delays, kill, probe_out,
+                scale=scale, block=plan.dedisp_block,
+            )
+        ),
+    )
+    if plan.matmul_candidate or plan.engine == "matmul":
+        _timed(
+            "matmul",
+            lambda: jax.block_until_ready(
+                dedisperse_matmul(
+                    fil_dev, delays, kill, probe_out, scale=scale
+                )
+            ),
+        )
+    if plan.engine == "subband" and plan.subbands:
+        _timed(
+            "subband_matmul",
+            lambda: jax.block_until_ready(
+                dedisperse_subband(
+                    fil_dev, delays, kill, probe_out,
+                    nsub=plan.subbands, max_smear=plan.subband_smear,
+                    scale=scale, use_matmul=True,
+                )
+            ),
+        )
+    if not engine_meds:
+        return
+    current = plan.engine if plan.engine in engine_meds else "exact"
+    winner = min(engine_meds, key=lambda k: engine_meds[k])
+    if winner != current and engine_meds[winner] < engine_meds.get(
+        current, float("inf")
+    ):
+        if winner == "subband_matmul":
+            plan.engine = "subband"
+            plan.subband_matmul = True
+        else:
+            plan.engine = winner
+            plan.subband_matmul = False
+        plan.source = "tuned"
+    log.info(
+        "dedispersion engine race: %s (measured %s)",
+        plan.engine
+        + (" [matmul stages]" if plan.subband_matmul else ""),
+        {k: round(v, 5) for k, v in engine_meds.items()},
+    )
+
+
+def _tune_dm_block_knob(plan, trials, probe_out, reps) -> None:
+    """Rank wave-loop DM-block heights by per-trial throughput of the
+    per-trial normaliser (the chain head every wave dispatches) over a
+    probe row block."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.singlepulse import normalise_trials
+
+    rng = np.random.default_rng(1)
+    n = int(min(probe_out, 1 << 16))
+    best = None
+    for b in DM_BLOCK_CANDIDATES:
+        x = jnp.asarray(rng.normal(size=(b, n)).astype(np.float32))
+
+        def run(x=x):
+            jax.block_until_ready(normalise_trials(x))
+
+        run()  # untimed compile/warm pass
+        med = _measure(run, reps)
+        trials.append(
+            {"params": {"dm_block": int(b)}, "median_s": round(med, 6)}
+        )
+        per_trial = med / b
+        if best is None or per_trial < best[1]:
+            best = (b, per_trial)
+    if best is not None:
+        plan.dm_block = int(best[0])
+        plan.source = "tuned"
+
+
+def _tune_search_knobs(plan, trials, probe_out, reps) -> None:
+    """The search-side knob grid: ``dm_block`` (per-trial normaliser
+    throughput), ``accel_bucket`` (per-column resample throughput at
+    the padded column counts the bucket implies), and — on Pallas
+    backends only — the resample kernel's block size. Every timed
+    candidate lands in ``trials`` with its knob provenance."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.resample import resample_accel
+
+    _tune_dm_block_knob(plan, trials, probe_out, reps)
+    rng = np.random.default_rng(2)
+    n = int(min(max(1024, probe_out), 1 << 15))
+    x = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    best = None
+    for b in ACCEL_BUCKET_CANDIDATES:
+        af = 0.5 / (n * 64)
+        afs = jnp.asarray(
+            np.linspace(-af, af, b).astype(np.float32)
+        )
+
+        def run(afs=afs):
+            jax.block_until_ready(resample_accel(x, afs))
+
+        run()  # untimed compile/warm pass
+        med = _measure(run, reps)
+        trials.append(
+            {"params": {"accel_bucket": int(b)}, "median_s": round(med, 6)}
+        )
+        per_col = med / b
+        if best is None or per_col < best[1]:
+            best = (b, per_col)
+    if best is not None:
+        plan.accel_bucket = int(best[0])
+        plan.source = "tuned"
+    _tune_pallas_block(plan, trials, x, reps)
+
+
+def _tune_pallas_block(plan, trials, x, reps) -> None:
+    """Pallas resample tile candidates — TPU backends only (the knob
+    is meaningless elsewhere and the kernel will not compile); a
+    failed candidate is skipped, never fatal."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.pallas import backend_supports_pallas
+
+    if not backend_supports_pallas():
+        return
+    from ..ops.pallas import probe_pallas_resample
+    from ..ops.pallas.resample import resample_block_pallas
+
+    n = x.shape[-1]
+    best = None
+    for blk in PALLAS_BLOCK_CANDIDATES:
+        if not probe_pallas_resample(n, blk):
+            continue
+        af = 0.5 / (n * blk)
+        afs = jnp.asarray(np.asarray([[af, -af]], dtype=np.float32))
+        xr = x.reshape(1, -1)
+
+        def run(afs=afs, xr=xr, blk=blk):
+            jax.block_until_ready(
+                resample_block_pallas(xr, afs, block=blk)
+            )
+
+        run()  # untimed compile/warm pass
+        med = _measure(run, reps)
+        trials.append(
+            {"params": {"pallas_block": int(blk)},
+             "median_s": round(med, 6)}
+        )
+        if best is None or med < best[1]:
+            best = (blk, med)
+    if best is not None:
+        plan.pallas_block = int(best[0])
+        plan.source = "tuned"
 
 
 # --------------------------------------------------------------------------
@@ -473,7 +685,9 @@ def resolve_plan_for_bucket(
             * max(1, dm_plan.out_nsamps),
         )
     if tune:
-        plan = tune_plan(plan, dm_plan, nbits=nbits, reps=reps)
+        plan = tune_plan(
+            plan, dm_plan, nbits=nbits, reps=reps, pipeline=pipeline
+        )
     cache_store(doc, fp, key, plan.to_doc())
     try:
         save_cache(cache_path, doc)

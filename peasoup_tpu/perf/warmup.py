@@ -291,6 +291,7 @@ def shape_ctx_for_bucket(bucket, pipeline: str, overrides: dict):
     widths: tuple[int, ...] = ()
     dm_block = 1
     pallas_span = 0
+    sp_fused_span = 0
     fft_size = 0
     nharms = 4
     accel_pad = 0
@@ -308,13 +309,22 @@ def shape_ctx_for_bucket(bucket, pipeline: str, overrides: dict):
                 max(1, min(256, (search.TOTAL_HBM // 4) // max(1, per_trial)))
             )
         if cfg.use_pallas:
+            # same preference order as the driver: fused chain tail
+            # first, plain boxcar kernel second, jnp twin last
             try:
-                from ..ops.pallas import probe_pallas_boxcar
+                from ..ops.pallas import (
+                    probe_pallas_boxcar,
+                    probe_pallas_spchain,
+                )
 
-                if probe_pallas_boxcar(len(widths), span):
+                if span % cfg.decimate == 0 and probe_pallas_spchain(
+                    len(widths), span, cfg.decimate
+                ):
+                    sp_fused_span = span
+                elif probe_pallas_boxcar(len(widths), span):
                     pallas_span = span
             except Exception:
-                pallas_span = 0
+                pallas_span = sp_fused_span = 0
     elif pipeline == "search":
         import numpy as np
 
@@ -364,8 +374,11 @@ def shape_ctx_for_bucket(bucket, pipeline: str, overrides: dict):
         max_events=int(getattr(cfg, "max_events", 256)),
         decimate=int(getattr(cfg, "decimate", 32)),
         pallas_span=int(pallas_span),
+        sp_fused_span=int(sp_fused_span),
         subbands=int(getattr(cfg, "subbands", 0)),
         subband_smear=float(getattr(cfg, "subband_smear", 1.0)),
+        dedisp_engine=str(getattr(cfg, "dedisp_engine", "")),
+        subband_matmul=bool(getattr(cfg, "subband_matmul", False)),
         fft_size=int(fft_size),
         nharms=int(nharms),
         accel_pad=int(accel_pad),

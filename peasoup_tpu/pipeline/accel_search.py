@@ -50,22 +50,67 @@ class AccelSearchPeaks(NamedTuple):
     ccounts: jax.Array
 
 
-def _preprocess_trial(tim, zapmask, *, size, nsamps_valid, pos5, pos25):
-    """Once-per-DM-trial stage: pad, whiten, zap, stats, back to time
-    domain (pipeline_multi.cu:160-204). Returns (xd, mean, std)."""
+def _pad_trial(tim, *, size, nsamps_valid):
+    """Pad/truncate one trial to ``size`` with the reference's
+    mean-padded tail (pipeline_multi.cu:160-163)."""
     x = tim[:size].astype(jnp.float32)
     if nsamps_valid < size:
-        # mean-pad the tail like the reference (pipeline_multi.cu:160-163);
         # the input trial may be shorter than size, so pad to shape first
         x = jnp.pad(x, (0, size - x.shape[0]))
         mean_head = jnp.mean(x[:nsamps_valid])
         idx = jnp.arange(size)
         x = jnp.where(idx < nsamps_valid, x, mean_head)
+    return x
+
+
+def _preprocess_trial(tim, zapmask, *, size, nsamps_valid, pos5, pos25):
+    """Once-per-DM-trial stage: pad, whiten, zap, stats, back to time
+    domain (pipeline_multi.cu:160-204). Returns (xd, mean, std)."""
+    x = _pad_trial(tim, size=size, nsamps_valid=nsamps_valid)
     fser = whiten_fseries(x, pos5=pos5, pos25=pos25)
     fser = zap_birdies(fser, zapmask)
     s0 = form_interpolated(fser)
     mean, _, std = spectrum_stats(s0)
     xd = jnp.fft.irfft(fser, n=size)
+    return xd, mean, std
+
+
+def _pre_spectrum_parts(tim, *, size, nsamps_valid, pos5, pos25):
+    """The fused-chain front half for one trial: pad, rfft, running
+    median — returning the raw spectrum PARTS the fused
+    deredden+zap+interbin pass consumes (vmapped over the block)."""
+    from ..ops.rednoise import running_median
+    from ..ops.spectrum import form_power
+
+    x = _pad_trial(tim, size=size, nsamps_valid=nsamps_valid)
+    fser = jnp.fft.rfft(x)
+    med = running_median(form_power(fser), pos5=pos5, pos25=pos25)
+    return (
+        jnp.real(fser).astype(jnp.float32),
+        jnp.imag(fser).astype(jnp.float32),
+        med,
+    )
+
+
+def _preprocess_block_fused(
+    tims, zapmask, *, size, nsamps_valid, pos5, pos25
+):
+    """Block-batched once-per-DM-trial stage with the spectrum-chain
+    tail (deredden -> zap -> interbin) FUSED into one Pallas pass over
+    the whole (D, nbins) batch (ops/pallas/specchain.py; callers gate
+    on probe_pallas_specchain). Returns (xd, mean, std) like the
+    vmapped :func:`_preprocess_trial`."""
+    from ..ops.pallas.specchain import interp_deredden_zap_pallas
+
+    re, im, med = jax.vmap(
+        lambda tim: _pre_spectrum_parts(
+            tim, size=size, nsamps_valid=nsamps_valid, pos5=pos5,
+            pos25=pos25,
+        )
+    )(tims)
+    re_d, im_d, s0 = interp_deredden_zap_pallas(re, im, med, zapmask)
+    mean, _, std = spectrum_stats(s0)
+    xd = jnp.fft.irfft(jax.lax.complex(re_d, im_d), n=size)
     return xd, mean, std
 
 
@@ -219,10 +264,11 @@ def _spectra_and_peaks(
         # machine together (ops/pallas/peaks.py:find_cluster_peaks_multi)
         from ..ops.pallas.peaks import find_cluster_peaks_multi
 
-        i_, s_, c_, cc_ = find_cluster_peaks_multi(
-            levels, windows, threshold=threshold, max_peaks=max_peaks,
-            scales=lvl_scales, nbins=nbins,
-        )
+        with jax.named_scope("Peaks"):
+            i_, s_, c_, cc_ = find_cluster_peaks_multi(
+                levels, windows, threshold=threshold, max_peaks=max_peaks,
+                scales=lvl_scales, nbins=nbins,
+            )
         # kernel emits (..., nlev, ...); the NamedTuple wants the level
         # axis at stack_axis
         nb = len(levels[0].shape) - 1  # batch rank
@@ -234,22 +280,25 @@ def _spectra_and_peaks(
         )
 
     idxs, snrs, counts, ccounts = [], [], [], []
-    for lvl, spec in enumerate(levels):
-        i_, s_, c_ = find_peaks_device(
-            spec,
-            jnp.float32(threshold),
-            windows[lvl, 0],
-            windows[lvl, 1],
-            max_peaks=max_peaks,
-        )
-        if cluster:
-            i_, s_, cc_ = cluster_peaks_device(i_, s_, jnp.int32(nbins))
-        else:
-            cc_ = c_
-        idxs.append(i_)
-        snrs.append(s_)
-        counts.append(c_)
-        ccounts.append(cc_)
+    with jax.named_scope("Peaks"):
+        for lvl, spec in enumerate(levels):
+            i_, s_, c_ = find_peaks_device(
+                spec,
+                jnp.float32(threshold),
+                windows[lvl, 0],
+                windows[lvl, 1],
+                max_peaks=max_peaks,
+            )
+            if cluster:
+                i_, s_, cc_ = cluster_peaks_device(
+                    i_, s_, jnp.int32(nbins)
+                )
+            else:
+                cc_ = c_
+            idxs.append(i_)
+            snrs.append(s_)
+            counts.append(c_)
+            ccounts.append(cc_)
     return AccelSearchPeaks(
         idxs=jnp.stack(idxs, axis=stack_axis),
         snrs=jnp.stack(snrs, axis=stack_axis),
@@ -331,6 +380,7 @@ def search_block_core(
     fused_interbin: bool = False,
     mega_harm: bool = False,
     fused_dft: bool = False,
+    fused_spec: bool = False,
 ) -> AccelSearchPeaks:
     """Block-batched search: all per-DM preprocessing vmapped, then the
     (D, A) accel grid processed as single batched array programs. With
@@ -338,47 +388,63 @@ def search_block_core(
     windowed-select kernel (ops/pallas/resample.py); with
     ``select_smax`` > 0 as the gather-free jnp select
     (ops/resample.py:resample_select); otherwise the jnp gather twin.
-    Results are bitwise identical in all three modes.
+    Results are bitwise identical in all three modes. ``fused_spec``
+    routes the once-per-trial deredden -> zap -> interbin tail through
+    the fused Pallas pass (probe-gated by the caller).
     """
-    xd, mean, std = jax.vmap(
-        lambda tim: _preprocess_trial(
-            tim, zapmask, size=size, nsamps_valid=nsamps_valid,
-            pos5=pos5, pos25=pos25,
-        )
-    )(tims)  # (D, size), (D,), (D,)
-
-    if pallas_block > 0:
-        from ..ops.pallas.resample import resample_block_pallas
-
-        xr = resample_block_pallas(
-            xd, afs, block=pallas_block, interpret=pallas_interpret
-        )
-    elif select_smax > 0:
-        if fused_interbin and cluster and pallas_peaks:
-            # the packed-DFT consumer wants even/odd planes: selecting
-            # straight into them skips the stride-2 deinterleave
-            # relayout (bitwise-equal elements, ops/resample.py). The
-            # fused-DFT kernel additionally wants them PRE-SHAPED
-            # (.., n1, n2) so the select writes its tile layout with
-            # no relayout pass (resample_select_packed_planes)
-            if fused_dft:
-                from ..ops.pallas.dftspec import plane_factors
-                from ..ops.resample import resample_select_packed_planes
-
-                n1, n2 = plane_factors(size // 2)
-                xr = resample_select_packed_planes(
-                    xd, afs, smax=select_smax, n1=n1, n2=n2
-                )
-            else:
-                from ..ops.resample import resample_select_packed
-
-                xr = resample_select_packed(xd, afs, smax=select_smax)
+    # named scopes mirror the roofline stage taxonomy
+    # (tools/scope_trace STAGE_RULES), so profiler traces attribute
+    # this one jitted program's device time per stage
+    with jax.named_scope("Spectrum-Chain"):
+        if fused_spec:
+            xd, mean, std = _preprocess_block_fused(
+                tims, zapmask, size=size, nsamps_valid=nsamps_valid,
+                pos5=pos5, pos25=pos25,
+            )
         else:
-            from ..ops.resample import resample_select
+            xd, mean, std = jax.vmap(
+                lambda tim: _preprocess_trial(
+                    tim, zapmask, size=size, nsamps_valid=nsamps_valid,
+                    pos5=pos5, pos25=pos25,
+                )
+            )(tims)  # (D, size), (D,), (D,)
 
-            xr = resample_select(xd, afs, smax=select_smax)  # (D, A, size)
-    else:
-        xr = jax.vmap(resample_accel)(xd, afs)  # (D, A, size)
+    with jax.named_scope("Resample"):
+        if pallas_block > 0:
+            from ..ops.pallas.resample import resample_block_pallas
+
+            xr = resample_block_pallas(
+                xd, afs, block=pallas_block, interpret=pallas_interpret
+            )
+        elif select_smax > 0:
+            if fused_interbin and cluster and pallas_peaks:
+                # the packed-DFT consumer wants even/odd planes:
+                # selecting straight into them skips the stride-2
+                # deinterleave relayout (bitwise-equal elements,
+                # ops/resample.py). The fused-DFT kernel additionally
+                # wants them PRE-SHAPED (.., n1, n2) so the select
+                # writes its tile layout with no relayout pass
+                # (resample_select_packed_planes)
+                if fused_dft:
+                    from ..ops.pallas.dftspec import plane_factors
+                    from ..ops.resample import (
+                        resample_select_packed_planes,
+                    )
+
+                    n1, n2 = plane_factors(size // 2)
+                    xr = resample_select_packed_planes(
+                        xd, afs, smax=select_smax, n1=n1, n2=n2
+                    )
+                else:
+                    from ..ops.resample import resample_select_packed
+
+                    xr = resample_select_packed(xd, afs, smax=select_smax)
+            else:
+                from ..ops.resample import resample_select
+
+                xr = resample_select(xd, afs, smax=select_smax)
+        else:
+            xr = jax.vmap(resample_accel)(xd, afs)  # (D, A, size)
 
     # stack levels at axis 1 -> (D, nharms+1, A, ...) to match
     # vmap(search_trial_core)'s layout
@@ -396,6 +462,7 @@ def make_batched_search_fn(
     threshold: float, pallas_block: int = 0, select_smax: int = 0,
     pallas_peaks: bool = False, fused_interbin: bool = False,
     mega_harm: bool = False, fused_dft: bool = False,
+    fused_spec: bool = False,
 ):
     """Jitted (D, ...) -> (D, ...) search over a block of DM trials.
 
@@ -420,7 +487,7 @@ def make_batched_search_fn(
             pallas_block=pallas_block, select_smax=select_smax,
             cluster=cluster, pallas_peaks=pallas_peaks,
             fused_interbin=fused_interbin, mega_harm=mega_harm,
-            fused_dft=fused_dft,
+            fused_dft=fused_dft, fused_spec=fused_spec,
         )
 
     return search_dm_block

@@ -92,9 +92,16 @@ class SearchConfig:
     subband_snr_loss: float = 0.1  # parity gate for the auto planner
     # (plan/dedisp_plan.py): max fractional matched-filter S/N loss a
     # subband plan may predict before exact is forced
-    tune: bool = False  # auto-select exact-vs-subband + per-device
-    # tuned shape knobs via the tuning cache (perf/tuning.py); an
-    # explicit --subbands overrides the planner
+    tune: bool = False  # auto-select exact-vs-subband-vs-matmul +
+    # per-device tuned shape knobs via the tuning cache
+    # (perf/tuning.py); an explicit --subbands overrides the planner
+    dedisp_engine: str = ""  # force one dedispersion engine: "exact"
+    # (gather scan) or "matmul" (MXU banded matmul) — "" lets the
+    # plan/tuner decide ("subband" is forced via --subbands, whose
+    # smear knob it needs). The CI three-way smoke pins candidate
+    # parity across all of them
+    subband_matmul: bool = False  # run the subband stages as banded
+    # matmuls (bitwise-identical; normally set by the tuned plan)
     tuning_cache: str = ""  # tuning_cache.json path ("" = the
     # per-user default, PEASOUP_TUNING_CACHE overrides)
     accel_bucket: int = 16  # accel batch padded to a multiple of this
@@ -540,7 +547,12 @@ class PeasoupSearch:
         subbands = cfg.subbands
         subband_smear = cfg.subband_smear
         dedisp_block = cfg.dedisp_block
-        if cfg.tune and cfg.subbands == 0:
+        dedisp_engine = cfg.dedisp_engine  # "" = plan/tuner decides
+        subband_matmul = cfg.subband_matmul
+        smear_budgets = None
+        self._tuned_dm_block = 0
+        self._tuned_accel_bucket = 0
+        if cfg.tune and cfg.subbands == 0 and not cfg.dedisp_engine:
             try:
                 from ..perf.tuning import resolve_plan_for_filterbank
 
@@ -554,7 +566,34 @@ class PeasoupSearch:
                 if dplan.engine == "subband":
                     subbands = dplan.subbands
                     subband_smear = dplan.subband_smear
+                    subband_matmul = subband_matmul or dplan.subband_matmul
+                    if dplan.smear_dm_scaled and dplan.smear_loss_budget:
+                        # rebuild the DM-scaled per-trial budgets the
+                        # planner grouped under (deterministic in the
+                        # plan geometry, so nothing big hits the cache)
+                        from ..plan.dedisp_plan import dm_smear_budgets
+
+                        smear_budgets = dm_smear_budgets(
+                            dm_plan.dm_list,
+                            tsamp=fil.tsamp, fch1=fil.fch1, foff=fil.foff,
+                            nchans=len(dm_plan.delays),
+                            pulse_width_us=cfg.dm_pulse_width,
+                            max_snr_loss=dplan.smear_loss_budget,
+                            floor=dplan.subband_smear,
+                        )
+                elif dplan.engine == "matmul":
+                    dedisp_engine = "matmul"
                 dedisp_block = dplan.dedisp_block or dedisp_block
+                # tuned wave knobs: an explicit config value wins; the
+                # dataclass default opts into the per-device winner
+                if cfg.dm_block == 0 and dplan.dm_block:
+                    self._tuned_dm_block = int(dplan.dm_block)
+                fields = type(cfg).__dataclass_fields__
+                if (
+                    cfg.accel_bucket == fields["accel_bucket"].default
+                    and dplan.accel_bucket
+                ):
+                    self._tuned_accel_bucket = int(dplan.accel_bucket)
                 tel.event("dedisp_plan", **dplan.summary())
                 tel.set_context(dedisp_plan=dplan.summary())
                 log.info(
@@ -667,6 +706,22 @@ class PeasoupSearch:
                     max_smear=subband_smear,
                     scale=scale,
                     to_host=spill,
+                    use_matmul=subband_matmul,
+                    budgets=smear_budgets,
+                )
+            elif dedisp_engine == "matmul" and not spill:
+                # the MXU banded-matmul engine (tuned winner or forced
+                # via --dedisp_engine): bitwise-equal to the gather
+                # scan, so the spill/sharded paths degrading to gather
+                # elsewhere never changes candidates
+                from ..ops.dedisperse import dedisperse_matmul
+
+                trials = dedisperse_matmul(
+                    fil_to_device(fil),
+                    dm_plan.delay_samples(),
+                    dm_plan.killmask,
+                    dm_plan.out_nsamps,
+                    scale=scale,
                 )
             else:
                 dd = dedisperse if spill else dedisperse_device
@@ -770,8 +825,11 @@ class PeasoupSearch:
         else:
             dispatch_lists = accel_lists
             self._accel_expand = [None] * len(accel_lists)
+        # the tuned accel bucket (explicit config values win; see the
+        # plan-resolution block above)
+        accel_bucket = self._tuned_accel_bucket or cfg.accel_bucket
         self._accel_full_pad = [
-            _accel_pad(len(a), cfg.accel_bucket) for a in accel_lists
+            _accel_pad(len(a), accel_bucket) for a in accel_lists
         ]
         if any(m is not None for m in self._accel_expand):
             n_full = sum(len(a) for a in accel_lists)
@@ -782,7 +840,7 @@ class PeasoupSearch:
                 "representative's spectrum bitwise)", n_disp, n_full,
             )
             tel.event("accel_dedupe", dispatched=n_disp, full=n_full)
-        bucket = cfg.accel_bucket
+        bucket = accel_bucket
         by_bucket: dict[int, list[int]] = {}
         for dm_idx, accs in enumerate(dispatch_lists):
             padded = _accel_pad(len(accs), bucket)
@@ -884,6 +942,17 @@ class PeasoupSearch:
             if dftspec_supported(size, npad_spec):
                 fused_dft = probe_pallas_dftspec(size, npad_spec)
         self._fused_dft = fused_dft
+        # fused once-per-trial spectrum chain (ops/pallas/specchain.py):
+        # deredden -> zap -> interbin in ONE streaming pass over the
+        # (dm_block, nbins) batch instead of three HBM walks. Gated on
+        # the compile+run oracle probe (bitwise parts + FMA-envelope
+        # amplitude); PEASOUP_FUSED_SPEC=0 restores the unfused stanza.
+        fused_spec = False
+        if os.environ.get("PEASOUP_FUSED_SPEC", "1") != "0":
+            from ..ops.pallas import probe_pallas_specchain
+
+            fused_spec = probe_pallas_specchain()
+        self._fused_spec = fused_spec
 
         # --- search-side mesh wiring (mesh chosen before dedispersion) --
         if mesh is not None:
@@ -911,6 +980,7 @@ class PeasoupSearch:
                     pallas_peaks=pp, fused_interbin=fused_interbin and pp,
                     mega_harm=self._mega_harm and pp,
                     fused_dft=self._fused_dft and pp,
+                    fused_spec=self._fused_spec,
                 )
 
             self._dm_sharding = None
@@ -965,6 +1035,15 @@ class PeasoupSearch:
             for padded, dm_indices in sorted(by_bucket.items()):
                 if cfg.dm_block > 0:
                     d_local = max(1, cfg.dm_block // shrink)
+                elif self._tuned_dm_block:
+                    # per-device tuned wave height, still capped by the
+                    # memory-budget formula (tuning ranks throughput;
+                    # the budget owns safety — OOM shrink still applies)
+                    cells = max(8, int(mem_budget / (size_spec_b * 16)))
+                    cap = max(1, min(128, cells // max(1, padded)))
+                    d_local = max(
+                        1, min(self._tuned_dm_block, cap) // shrink
+                    )
                 else:
                     cells = max(8, int(mem_budget / (size_spec_b * 16)))
                     d_local = max(

@@ -495,12 +495,25 @@ class SinglePulseSearch:
         nsamps = dm_plan.out_nsamps
         tpad, span = plan_pad(nsamps)
         pallas_span = 0
+        fused_span = 0
         if cfg.use_pallas:
-            from ..ops.pallas import probe_pallas_boxcar
+            from ..ops.pallas import (
+                probe_pallas_boxcar,
+                probe_pallas_spchain,
+            )
 
-            if probe_pallas_boxcar(len(widths), span):
+            # prefer the fused sweep+dec-fold mega-kernel (the best
+            # planes never round-trip HBM at full resolution); fall
+            # back to the plain boxcar kernel, then the jnp twin —
+            # all three bitwise identical
+            if span % cfg.decimate == 0 and probe_pallas_spchain(
+                len(widths), span, cfg.decimate
+            ):
+                fused_span = span
+            elif probe_pallas_boxcar(len(widths), span):
                 pallas_span = span
         self._pallas_span = pallas_span
+        self._fused_span = fused_span
         sharding = None
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
@@ -558,6 +571,7 @@ class SinglePulseSearch:
             tel.event(
                 "sp_wave_plan", n_chunks=len(chunks), dm_block=blk,
                 shrink=shrink, pallas_span=self._pallas_span,
+                fused_span=self._fused_span,
                 backend="cpu" if cpu_mode else "default",
             )
             try:
@@ -617,7 +631,8 @@ class SinglePulseSearch:
                 shrink = 1
                 trials = np.asarray(trials)  # host-resident input
                 n_dev = 1
-                self._pallas_span = 0  # TPU kernel is moot on CPU
+                self._pallas_span = 0  # TPU kernels are moot on CPU
+                self._fused_span = 0
                 log.warning(
                     "device OOM with dm_block already at the floor "
                     "(%d); falling through to the CPU backend: %.200s",
@@ -724,7 +739,7 @@ class SinglePulseSearch:
             progress.start()
         search_fn = make_single_pulse_search_fn(
             widths, float(cfg.min_snr), cfg.max_events, cfg.decimate,
-            self._pallas_span,
+            self._pallas_span, self._fused_span,
         )
         tel.set_progress(0, len(chunks), unit="chunks")
         try:

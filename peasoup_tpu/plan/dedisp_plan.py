@@ -1,24 +1,32 @@
-"""Dedispersion strategy planning: exact vs two-stage subband.
+"""Dedispersion strategy planning: exact vs two-stage subband vs the
+MXU banded matmul.
 
-The pipeline ships two dedispersion engines (ops/dedisperse.py): the
-direct channel scan (golden-exact) and the two-stage subband engine
-from "Accelerating incoherent dedispersion" (arXiv:1201.5380). Which
-one wins — and at which shape knobs — depends on the observation
-geometry and the device; the reference picks statically. This module
-is the DECISION layer: a device-free analytic cost model over the
-bucket's real delay table plus a parity-tolerance gate whose inputs
-(max extra smear in samples, max fractional S/N loss) are explicit
-plan parameters, not folklore. "Real-Time Dedispersion ... using Auto
-Tuning" (arXiv:1601.01165) shows the remaining shape knobs are best
-set empirically per device — that measurement layer and its
-per-device cache live in :mod:`peasoup_tpu.perf.tuning`; this module
-stays pure numpy so planning is testable and auditable on any backend.
+The pipeline ships three dedispersion engines (ops/dedisperse.py): the
+direct channel scan (golden-exact), the two-stage subband engine from
+"Accelerating incoherent dedispersion" (arXiv:1201.5380), and the
+banded-matmul engine that recasts the shift-and-sum as a one-hot
+contraction on the MXU. Which one wins — and at which shape knobs —
+depends on the observation geometry and the device; the reference
+picks statically. This module is the DECISION layer: a device-free
+analytic cost model over the bucket's real delay table plus a
+parity-tolerance gate whose inputs (max extra smear in samples, max
+fractional S/N loss) are explicit plan parameters, not folklore.
+"Real-Time Dedispersion ... using Auto Tuning" (arXiv:1601.01165)
+shows the remaining shape knobs are best set empirically per device —
+that measurement layer and its per-device cache live in
+:mod:`peasoup_tpu.perf.tuning`; this module stays pure numpy so
+planning is testable and auditable on any backend.
 
 Cost model (arithmetic, in channel-sum MACs over the trial set):
 
 * exact:    ``ndm * nchans * out_nsamps``
 * subband:  ``n_groups * nchans * out_nsamps``  (stage 1, once per
   nominal DM) ``+ ndm * nsub * out_nsamps``     (stage 2, per trial)
+* matmul:   ``sum_blocks ndm_b * nchans * band_b * out_nsamps`` MACs
+  on the MXU (band_b the block's real one-hot band from the delay
+  table), rated at ``MXU_MAC_GAIN`` gather-MACs per matmul-MAC and
+  bounded below by the HBM byte traffic — an effective cost of
+  ``max(macs / MXU_MAC_GAIN, bytes / HBM_BYTES_PER_MAC)``.
 
 with ``n_groups`` computed from the bucket's actual delay table by the
 same greedy smear-bounded grouping the engine executes
@@ -26,6 +34,16 @@ same greedy smear-bounded grouping the engine executes
 ``ops.dedisperse.subband_groups`` — identical spans, plus each group's
 realised worst-case smear for the S/N gate). The classic ~sqrt(C) win
 appears exactly when groups hold several trials.
+
+The matmul engine is bitwise-equal to exact (the delay tables are
+integral), so it carries no parity gate — but the MXU advantage is a
+device property no analytic constant captures honestly, so
+:meth:`DedispPlan.select` NEVER picks it analytically: it computes
+``cost_matmul`` and flags ``matmul_candidate`` when the model puts the
+engine within ``MATMUL_RACE_SLACK`` of the gather winner, and the
+per-device tuner (perf/tuning.py) races the eligible engines and
+selects matmul only when it MEASURES faster (the acceptance contract —
+winner provenance lands in the plan's telemetry summary).
 
 Parity gate: substituting a group nominal's intra-band delay shape
 displaces each channel's read by at most the group's realised smear
@@ -49,7 +67,7 @@ import numpy as np
 
 from .dm_plan import DMPlan
 
-PLAN_VERSION = 1
+PLAN_VERSION = 2
 
 # structural floor for the two-stage split: below ~64 channels the
 # stage-2 pass over nsub pseudo-channels plus the extra dispatches eat
@@ -58,6 +76,19 @@ PLAN_VERSION = 1
 # nchans" is a plan invariant, not a tuning outcome
 MIN_SUBBAND_NCHANS = 64
 MIN_SUBBANDS = 8
+
+# banded-matmul rate model (RELATIVE units — one gather-MAC of the
+# channel scan is the unit of work; the empirical tuner arbitrates the
+# real ratio per device): a conservative MXU-vs-VPU MAC throughput
+# advantage for f32 one-hot contractions, and the HBM bytes one
+# gather-MAC's time buys (the matmul engine is memory-bound once the
+# band is narrow, so the byte term keeps the model honest there)
+MXU_MAC_GAIN = 8.0
+HBM_BYTES_PER_MAC = 2.0
+# race the matmul engine on the device whenever the analytic model puts
+# it within this factor of the gather winner (generous on purpose:
+# measurement, not the model, decides)
+MATMUL_RACE_SLACK = 4.0
 
 
 def effective_subbands(nchans: int, nsub: int) -> int:
@@ -84,14 +115,24 @@ def intra_band_shapes(delay_table: np.ndarray, nsub: int) -> np.ndarray:
 
 
 def subband_group_spans(
-    delay_table: np.ndarray, nsub: int, max_smear: float
+    delay_table: np.ndarray,
+    nsub: int,
+    max_smear: float,
+    budgets: Optional[np.ndarray] = None,
 ) -> list[tuple[int, int, int]]:
     """Greedy smear-bounded DM-trial grouping: the vectorised twin of
     ``ops.dedisperse.subband_groups`` (identical [lo, hi) spans — a
     test pins the equivalence) returning ``(lo, hi, err)`` with each
-    group's realised worst-case intra-band smear in samples."""
+    group's realised worst-case intra-band smear in samples. With
+    ``budgets`` each trial joins under its OWN per-trial cap (the
+    DM-scaled smear budget) instead of the global ``max_smear``."""
     d1 = intra_band_shapes(delay_table, nsub)
     D = d1.shape[0]
+    caps = (
+        np.full(D, float(max_smear))
+        if budgets is None
+        else np.asarray(budgets, dtype=np.float64)
+    )
     spans: list[tuple[int, int, int]] = []
     lo = 0
     step = 128
@@ -101,7 +142,7 @@ def subband_group_spans(
         while hi < D:
             j = min(D, hi + step)
             errs = np.abs(d1[hi:j] - d1[lo]).max(axis=1)
-            bad = np.nonzero(errs > max_smear)[0]
+            bad = np.nonzero(errs > caps[hi:j])[0]
             if bad.size:
                 if bad[0] > 0:
                     err = max(err, int(errs[: bad[0]].max()))
@@ -115,8 +156,84 @@ def subband_group_spans(
     return spans
 
 
+def dm_smear_budgets(
+    dm_list,
+    *,
+    tsamp: float,
+    fch1: float,
+    foff: float,
+    nchans: int,
+    pulse_width_us: float,
+    max_snr_loss: float,
+    floor: float = 1.0,
+) -> np.ndarray:
+    """Per-trial smear budgets in samples: the largest extra smear
+    whose predicted matched-filter S/N loss at that trial's effective
+    width stays within ``max_snr_loss``. Inverting
+    ``predicted_snr_loss(w, s) = 1 - sqrt(w / (w + s)) <= L`` gives
+    ``s <= w * (1 / (1 - L)^2 - 1)`` — high-DM trials, whose intrinsic
+    dispersion smearing already dominates ``w``, absorb many samples
+    of grouping smear for the same loss, so they stop forcing
+    conservative plans (the ISSUE's DM-dependent smear budget).
+    ``floor`` keeps the low-DM budget at the classic global value."""
+    loss = min(max(float(max_snr_loss), 0.0), 0.99)
+    k = 1.0 / (1.0 - loss) ** 2 - 1.0
+    ws = np.asarray(
+        [
+            effective_width_samples(
+                float(dm), tsamp, pulse_width_us, fch1, foff, nchans
+            )
+            for dm in np.asarray(dm_list, dtype=np.float64)
+        ]
+    )
+    return np.maximum(float(floor), ws * k)
+
+
+def matmul_cost_profile(
+    delay_table: np.ndarray,
+    out_nsamps: int,
+    block: Optional[int] = None,
+    quant: Optional[int] = None,
+) -> dict:
+    """Analytic MAC + byte profile of the banded-matmul engine over the
+    bucket's REAL delay table: per aligned DM-trial block, the one-hot
+    band is the block's worst per-channel delay spread (padded to the
+    engine's quantum), MACs are ``ndm_b * C * band_b * T`` and bytes
+    are the block's f32 window copy plus its output. Returns
+    ``{"macs", "bytes", "max_band", "effective"}`` with ``effective``
+    in gather-MAC units (max of the MXU-rated MAC term and the
+    HBM-rated byte term)."""
+    from ..ops.dedisperse import MATMUL_BAND_QUANT, MATMUL_BLOCK, matmul_band
+
+    block = MATMUL_BLOCK if block is None else block
+    quant = MATMUL_BAND_QUANT if quant is None else quant
+    dt = np.asarray(delay_table)
+    D, C = dt.shape
+    T = max(1, int(out_nsamps))
+    macs = 0.0
+    nbytes = 0.0
+    max_band = 0
+    for lo in range(0, D, block):
+        blk = dt[lo : lo + block]
+        band = matmul_band(blk, quant)
+        max_band = max(max_band, band)
+        db = len(blk)
+        macs += float(db) * C * band * T
+        nbytes += 4.0 * (C * (T + band - 1) + db * T)
+    effective = max(macs / MXU_MAC_GAIN, nbytes / HBM_BYTES_PER_MAC)
+    return {
+        "macs": macs,
+        "bytes": nbytes,
+        "max_band": int(max_band),
+        "effective": effective,
+    }
+
+
 def effective_delay_table(
-    delay_table: np.ndarray, nsub: int, max_smear: float
+    delay_table: np.ndarray,
+    nsub: int,
+    max_smear: float,
+    budgets: Optional[np.ndarray] = None,
 ) -> np.ndarray:
     """The integer delay table the subband engine EFFECTIVELY applies:
     each trial reads channel c at ``refdel[d, band(c)] + d1[lo, c]``
@@ -137,7 +254,8 @@ def effective_delay_table(
     )
     d1 = delay_table - refdel[:, band_of]
     eff = np.empty_like(delay_table)
-    for lo, hi, _ in subband_group_spans(delay_table, nsub_eff, max_smear):
+    spans = subband_group_spans(delay_table, nsub_eff, max_smear, budgets)
+    for lo, hi, _ in spans:
         eff[lo:hi] = refdel[lo:hi][:, band_of] + d1[lo][None, :]
     return eff
 
@@ -194,17 +312,29 @@ class DedispPlan:
     refined the knobs, perf/tuning.py), ``cache`` (loaded from the
     tuning cache with zero re-measurement)."""
 
-    engine: str = "exact"  # "exact" | "subband"
+    engine: str = "exact"  # "exact" | "subband" | "matmul" (matmul
+    # only ever via the tuner's measured race — select() never picks it)
     subbands: int = 0
     subband_smear: float = 0.0
+    subband_matmul: bool = False  # subband stages as banded matmuls
     dedisp_block: int = 16
     dm_block: int = 0  # 0 = driver auto-sizing
+    accel_bucket: int = 0  # 0 = driver default (tuned knob)
+    pallas_block: int = 0  # 0 = driver default (tuned Pallas tile)
     cost_exact: float = 0.0
     cost_subband: float = 0.0
+    cost_matmul: float = 0.0  # effective gather-MAC units (MAC+bytes)
+    matmul_band: int = 0  # worst one-hot band over the real table
+    matmul_candidate: bool = False  # analytic model puts matmul within
+    # MATMUL_RACE_SLACK of the gather winner -> the tuner races it
     gain: float = 1.0  # cost_exact / cost_subband at the chosen nsub
     predicted_loss: float = 0.0  # worst-group fractional S/N loss
     max_group_smear: int = 0  # realised worst smear (samples)
     n_groups: int = 0
+    smear_dm_scaled: bool = False  # grouping used DM-scaled budgets
+    smear_loss_budget: float = 0.0  # the per-trial loss fraction those
+    # budgets were derived from (drivers rebuild them deterministically
+    # via dm_smear_budgets; 0 = global max_smear only)
     source: str = "analytic"
     tuning_s: float = 0.0
     trials: list = field(default_factory=list)  # tuner measurements
@@ -225,11 +355,17 @@ class DedispPlan:
             "engine": self.engine,
             "subbands": self.subbands,
             "subband_smear": self.subband_smear,
+            "subband_matmul": self.subband_matmul,
             "dedisp_block": self.dedisp_block,
             "dm_block": self.dm_block,
+            "accel_bucket": self.accel_bucket,
+            "pallas_block": self.pallas_block,
             "gain": round(self.gain, 3),
             "predicted_loss": round(self.predicted_loss, 4),
             "n_groups": self.n_groups,
+            "matmul_candidate": self.matmul_candidate,
+            "cost_matmul": round(self.cost_matmul, 1),
+            "smear_dm_scaled": self.smear_dm_scaled,
             "source": self.source,
             "tuning_s": round(self.tuning_s, 3),
         }
@@ -248,14 +384,22 @@ class DedispPlan:
         min_gain: float = 1.2,
         pulse_width_us: float = 64.0,
         candidates: Optional[list[int]] = None,
+        dm_scale_smear: bool = True,
     ) -> "DedispPlan":
-        """Pick exact vs subband for one plan. Subband is selected
-        exactly when (a) the cost model predicts at least a
-        ``min_gain`` arithmetic win at the best candidate nsub over
-        the bucket's real delay table, AND (b) the parity gate passes:
-        the worst per-group predicted S/N loss under the ``max_smear``
-        budget stays within ``max_snr_loss``. Everything else — small
-        bands, loose geometries, tight loss budgets — keeps the
+        """Pick exact vs subband for one plan (and profile the matmul
+        alternative for the tuner's race). Subband is selected exactly
+        when (a) the cost model predicts at least a ``min_gain``
+        arithmetic win at the best candidate nsub over the bucket's
+        real delay table, AND (b) the parity gate passes: the worst
+        per-group predicted S/N loss stays within ``max_snr_loss``.
+        With ``dm_scale_smear`` the grouping budget scales per trial
+        with its intrinsic DM smearing (:func:`dm_smear_budgets`,
+        floored at ``max_smear``) instead of one global cap. The
+        matmul engine is bitwise-exact so it has no gate, but its MXU
+        advantage is a device property: select() only records
+        ``cost_matmul`` and the ``matmul_candidate`` race flag — the
+        tuner promotes it when it measures faster. Everything else —
+        small bands, loose geometries, tight loss budgets — keeps the
         golden-exact direct scan."""
         D = dm_plan.ndm
         C = len(dm_plan.delays)
@@ -264,38 +408,80 @@ class DedispPlan:
         plan = cls(engine="exact", cost_exact=cost_exact)
         if D < 2:
             return plan
+        delay_table = dm_plan.delay_samples()
+        mm = matmul_cost_profile(delay_table, T)
+        plan.cost_matmul = mm["effective"]
+        plan.matmul_band = mm["max_band"]
+        budgets = None
+        if dm_scale_smear and max_smear > 0 and max_snr_loss > 0:
+            budgets = dm_smear_budgets(
+                dm_plan.dm_list, tsamp=tsamp, fch1=fch1, foff=foff,
+                nchans=C, pulse_width_us=pulse_width_us,
+                max_snr_loss=max_snr_loss, floor=max_smear,
+            )
         cands = candidates if candidates is not None else candidate_subbands(C)
         cands = [s for s in cands if 2 <= s <= C]
-        if not cands:
-            return plan
-        delay_table = dm_plan.delay_samples()
-        best: Optional[tuple[float, int, list[tuple[int, int, int]]]] = None
-        for nsub in cands:
-            nsub_eff = effective_subbands(C, nsub)
-            spans = subband_group_spans(delay_table, nsub_eff, max_smear)
-            cost = float(len(spans)) * C * T + float(D) * nsub_eff * T
-            if best is None or cost < best[0]:
-                best = (cost, nsub_eff, spans)
-        assert best is not None
-        cost_sub, nsub_best, spans = best
-        plan.cost_subband = cost_sub
-        plan.gain = cost_exact / max(1.0, cost_sub)
-        plan.n_groups = len(spans)
-        plan.max_group_smear = max((err for _, _, err in spans), default=0)
-        # parity gate: worst loss over groups, each at its lowest-DM
-        # (narrowest-width) member
-        loss = 0.0
-        for lo, _, err in spans:
-            if err <= 0:
-                continue
-            w = effective_width_samples(
-                float(dm_plan.dm_list[lo]), tsamp, pulse_width_us,
-                fch1, foff, C,
+        if cands:
+            best: Optional[
+                tuple[float, int, list[tuple[int, int, int]]]
+            ] = None
+            for nsub in cands:
+                nsub_eff = effective_subbands(C, nsub)
+                spans = subband_group_spans(
+                    delay_table, nsub_eff, max_smear, budgets
+                )
+                cost = float(len(spans)) * C * T + float(D) * nsub_eff * T
+                if best is None or cost < best[0]:
+                    best = (cost, nsub_eff, spans)
+            assert best is not None
+            cost_sub, nsub_best, spans = best
+            plan.cost_subband = cost_sub
+            plan.gain = cost_exact / max(1.0, cost_sub)
+            plan.n_groups = len(spans)
+            plan.max_group_smear = max(
+                (err for _, _, err in spans), default=0
             )
-            loss = max(loss, predicted_snr_loss(w, err))
-        plan.predicted_loss = loss
-        if plan.gain >= min_gain and loss <= max_snr_loss:
-            plan.engine = "subband"
-            plan.subbands = nsub_best
-            plan.subband_smear = float(max_smear)
+            # parity gate: worst PER-TRIAL loss — each trial's realised
+            # smear under its group nominal, at that trial's own
+            # effective width (the group-max-at-narrowest-width form
+            # over-vetoed DM-scaled budgets, which admit large smears
+            # only on trials wide enough to absorb them)
+            d1 = intra_band_shapes(delay_table, nsub_best)
+            widths = np.asarray(
+                [
+                    effective_width_samples(
+                        float(dm), tsamp, pulse_width_us, fch1, foff, C
+                    )
+                    for dm in dm_plan.dm_list
+                ]
+            )
+            loss = 0.0
+            for lo, hi, err in spans:
+                if err <= 0:
+                    continue
+                errs = np.abs(d1[lo:hi] - d1[lo]).max(axis=1)
+                w = widths[lo:hi]
+                loss = max(
+                    loss,
+                    float(
+                        np.max(1.0 - np.sqrt(w / (w + np.maximum(errs, 0.0))))
+                    ),
+                )
+            plan.predicted_loss = loss
+            if plan.gain >= min_gain and loss <= max_snr_loss:
+                plan.engine = "subband"
+                plan.subbands = nsub_best
+                plan.subband_smear = float(max_smear)
+                plan.smear_dm_scaled = budgets is not None
+                plan.smear_loss_budget = (
+                    float(max_snr_loss) if budgets is not None else 0.0
+                )
+        gather_cost = (
+            plan.cost_subband
+            if plan.engine == "subband"
+            else plan.cost_exact
+        )
+        plan.matmul_candidate = (
+            plan.cost_matmul <= MATMUL_RACE_SLACK * max(1.0, gather_cost)
+        )
         return plan

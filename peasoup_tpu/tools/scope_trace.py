@@ -80,6 +80,42 @@ class ScopeResult:
                 out["other"] += us / 1e6
         return out
 
+    # finer roofline taxonomy (perf/roofline.py STAGES): matched
+    # against the WHOLE tf_op path, so the named scopes the drivers
+    # emit inside one jitted program ("Spectrum-Chain", "Resample",
+    # "Harmonic summing", "Peaks") split the search program's device
+    # time per stage. First match wins; order puts the scoped stages
+    # before the top-level jit-name fallbacks.
+    STAGE_RULES = (
+        ("unpack", ("unpack_fil",)),
+        ("spectrum_chain", ("Spectrum-Chain", "whiten", "deredden")),
+        ("resample", ("Resample", "resample")),
+        ("harmonics", ("Harmonic summing", "harmonic")),
+        ("peaks", ("Peaks", "peaks", "compact", "cluster",
+                   "single_pulse", "boxcar")),
+        ("dedisperse", ("jit(run)", "dedisperse", "subband", "_stage1",
+                        "_stage2", "matmul_block", "tims")),
+        ("fold", ("fold", "_optimise", "pack_subints")),
+    )
+
+    def stage_profile(self) -> dict:
+        """{stage: (device seconds, bytes accessed)} over the roofline
+        taxonomy, + 'other' for anything unclassified (visible, never
+        hidden) — the measured half of perf.roofline.stage_roofline."""
+        out: dict = {name: [0.0, 0] for name, _ in self.STAGE_RULES}
+        out["other"] = [0.0, 0]
+        for op, us, nbytes in self.events:
+            path = op or ""
+            for name, pats in self.STAGE_RULES:
+                if any(p in path for p in pats):
+                    out[name][0] += us / 1e6
+                    out[name][1] += nbytes
+                    break
+            else:
+                out["other"][0] += us / 1e6
+                out["other"][1] += nbytes
+        return {k: (v[0], v[1]) for k, v in out.items()}
+
 
 def parse_trace_events(tr: dict) -> list[tuple[str, float, int]]:
     """(tf_op, duration us, bytes) rows from a loaded trace document's
