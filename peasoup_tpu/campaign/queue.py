@@ -99,10 +99,16 @@ class Job:
     config: dict = field(default_factory=dict)
     bucket: tuple | None = None
     priority: int = 0  # higher claims sooner; outranks bucket affinity
+    nprocs: int = 1  # >1: gang-scheduled across a named process group
     attempts: int = 0
     next_eligible_unix: float = 0.0
     last_error: str | None = None
     created_unix: float = 0.0
+    # preemption provenance: how many times a revoke handed this job
+    # back (zero attempts consumed) and each revoke's request->release
+    # latency — carried into the resumed run's done record
+    preemptions: int = 0
+    preempt_latency_s: list = field(default_factory=list)
 
     def to_doc(self) -> dict:
         return {
@@ -112,10 +118,13 @@ class Job:
             "config": self.config,
             "bucket": list(self.bucket) if self.bucket else None,
             "priority": self.priority,
+            "nprocs": self.nprocs,
             "attempts": self.attempts,
             "next_eligible_unix": self.next_eligible_unix,
             "last_error": self.last_error,
             "created_unix": self.created_unix,
+            "preemptions": self.preemptions,
+            "preempt_latency_s": self.preempt_latency_s,
         }
 
     @classmethod
@@ -128,22 +137,30 @@ class Job:
             config=doc.get("config") or {},
             bucket=tuple(b) if b else None,
             priority=int(doc.get("priority", 0)),
+            nprocs=int(doc.get("nprocs", 1)),
             attempts=int(doc.get("attempts", 0)),
             next_eligible_unix=float(doc.get("next_eligible_unix", 0.0)),
             last_error=doc.get("last_error"),
             created_unix=float(doc.get("created_unix", 0.0)),
+            preemptions=int(doc.get("preemptions", 0)),
+            preempt_latency_s=[
+                float(x) for x in (doc.get("preempt_latency_s") or [])
+            ],
         )
 
 
 @dataclass
 class Claim:
     """A held lease on one job. Only its holder may complete/fail the
-    job or rewrite the job record."""
+    job or rewrite the job record. ``gang`` (gang-scheduled jobs only)
+    names the process group and the exact member set the leader
+    assembled — {"group", "members", "nprocs", "epoch"}."""
 
     job: Job
     worker_id: str
     expires_unix: float
     path: str
+    gang: dict | None = None
 
 
 class JobQueue:
@@ -240,7 +257,11 @@ class JobQueue:
         return f"{socket.gethostname()}-{os.getpid()}"
 
     def try_claim(
-        self, job_id: str, worker_id: str, now: float | None = None
+        self,
+        job_id: str,
+        worker_id: str,
+        now: float | None = None,
+        gang: dict | None = None,
     ) -> Claim | None:
         now = time.time() if now is None else now
         if os.path.exists(self._p(_DONE, job_id)) or os.path.exists(
@@ -290,21 +311,22 @@ class JobQueue:
                 pass
             return None
         expires = now + self.lease_s
+        doc = {
+            "job_id": job_id,
+            "worker_id": worker_id,
+            "pid": os.getpid(),
+            "hostname": socket.gethostname(),
+            "claimed_unix": now,
+            "expires_unix": expires,
+        }
+        if gang:
+            doc["gang"] = gang
         with os.fdopen(fd, "w") as f:
-            json.dump(
-                {
-                    "job_id": job_id,
-                    "worker_id": worker_id,
-                    "pid": os.getpid(),
-                    "hostname": socket.gethostname(),
-                    "claimed_unix": now,
-                    "expires_unix": expires,
-                },
-                f, indent=2,
-            )
+            json.dump(doc, f, indent=2)
             f.write("\n")
         return Claim(
-            job=job, worker_id=worker_id, expires_unix=expires, path=path
+            job=job, worker_id=worker_id, expires_unix=expires, path=path,
+            gang=gang,
         )
 
     def claim_next(
@@ -312,6 +334,8 @@ class JobQueue:
         worker_id: str,
         prefer_bucket: tuple | None = None,
         warm_buckets: "set[tuple] | frozenset[tuple] | None" = None,
+        group: str | None = None,
+        group_members: "list[str] | None" = None,
     ) -> Claim | None:
         """Claim the next eligible job, ranked priority class first
         (higher ``Job.priority`` always claims sooner — an urgent
@@ -320,21 +344,47 @@ class JobQueue:
         shape bucket), then jobs whose bucket is in ``warm_buckets``
         (buckets already warmed/tuned — this worker's own plus any
         recorded in the campaign's done records, see runner.py), then
-        the remainder — each tier grouped BY bucket — so a fleet of
-        workers naturally partitions into shape-coherent streaks,
-        consecutive jobs hit the compiled-program caches, and
-        already-paid warmup/tuning work is exploited before any new
-        bucket is opened."""
+        the remainder — each tier grouped BY bucket, then by ARRIVAL
+        (``created_unix``): a released job (preempted, or handed back
+        by a retiring worker) keeps its original queue position
+        instead of sorting as fresh — so a fleet of workers naturally
+        partitions into shape-coherent streaks, consecutive jobs hit
+        the compiled-program caches, and already-paid warmup/tuning
+        work is exploited before any new bucket is opened.
+
+        Gang jobs (``Job.nprocs > 1``): claimable only by the LEADER
+        of a process group (the lexicographically-first entry of
+        ``group_members``, the caller's live group membership) and
+        only when the group musters ``nprocs`` live members — the
+        claim then carries the assembled member set (all-or-nothing:
+        non-leaders never initiate, an unassemblable gang job is
+        simply skipped so it cannot head-of-line-block ordinary
+        work)."""
         self.reap_stale()
         now = time.time()
         warm = {tuple(b) for b in warm_buckets} if warm_buckets else set()
-        eligible: list[tuple[tuple, str]] = []
+        members = sorted(group_members) if group_members else []
+        eligible: list[tuple[tuple, str, dict | None]] = []
         for jid in self.job_ids():
             if self.state(jid, now) != "pending":
                 continue
             job = self.get_job(jid)
             if job is None:
                 continue
+            gang = None
+            if job.nprocs > 1:
+                if (
+                    not group
+                    or len(members) < job.nprocs
+                    or worker_id != members[0]
+                ):
+                    continue  # not this worker's gang to lead (or none)
+                gang = {
+                    "group": group,
+                    "members": members[: job.nprocs],
+                    "nprocs": int(job.nprocs),
+                    "epoch": uuid.uuid4().hex[:12],
+                }
             bucket = job.bucket or ()
             if prefer_bucket and bucket == tuple(prefer_bucket):
                 tier = 0
@@ -346,11 +396,12 @@ class JobQueue:
                 -job.priority,
                 tier,
                 tuple(str(x) for x in bucket),
+                job.created_unix,
                 jid,
             )
-            eligible.append((rank, jid))
-        for _, jid in sorted(eligible):
-            claim = self.try_claim(jid, worker_id, now)
+            eligible.append((rank, jid, gang))
+        for _, jid, gang in sorted(eligible, key=lambda e: e[0]):
+            claim = self.try_claim(jid, worker_id, now, gang=gang)
             if claim is not None:
                 return claim
         return None
@@ -405,10 +456,174 @@ class JobQueue:
         )
 
     def _release(self, claim: Claim) -> None:
+        # any terminal transition clears a pending preempt request too:
+        # a revoke answered by completion (or failure) is answered
+        self.clear_preempt(claim.job.job_id)
         try:
             os.unlink(claim.path)
         except FileNotFoundError:
             pass  # reaped from under us (lease must have expired)
+
+    # --- priority preemption -----------------------------------------
+    def _preempt_path(self, job_id: str) -> str:
+        # ".preempt" (not ".json") so claim-directory scans — which
+        # filter on ".json" — never mistake a request for a claim
+        return self._p(_CLAIMS, job_id) + ".preempt"
+
+    def request_preempt(
+        self,
+        job_id: str,
+        requester: str = "",
+        grace_s: float = 60.0,
+    ) -> bool:
+        """Ask the holder of ``job_id``'s claim to checkpoint and hand
+        the job back: a preempt-request file lands beside the claim,
+        the victim's lease-renewer beat observes it
+        (campaign/runner.py), and the driver stops at the next
+        DM-block boundary with its checkpoint freshly saved. A victim
+        unresponsive past ``grace_s`` is escalated to the reap path
+        by :meth:`reap_stale`. Returns False when the job holds no
+        live claim (nothing to revoke)."""
+        claim_doc = _read_json(self._p(_CLAIMS, job_id))
+        if claim_doc is None:
+            return False
+        now = time.time()
+        _atomic_write_json(
+            self._preempt_path(job_id),
+            {
+                "job_id": job_id,
+                "requester": requester,
+                "victim_worker": claim_doc.get("worker_id"),
+                "requested_unix": now,
+                "deadline_unix": now + float(grace_s),
+            },
+        )
+        from ..resilience import STATS
+
+        STATS.preemption("requested")
+        log.info(
+            "preempt requested on %s (held by %s%s; grace %.3gs)",
+            job_id, claim_doc.get("worker_id"),
+            f" for {requester}" if requester else "", grace_s,
+        )
+        return True
+
+    def preempt_request(self, job_id: str) -> dict | None:
+        """The pending preempt request on ``job_id``, if any."""
+        return _read_json(self._preempt_path(job_id))
+
+    def clear_preempt(self, job_id: str) -> None:
+        try:
+            os.unlink(self._preempt_path(job_id))
+        except FileNotFoundError:
+            pass
+
+    def release_preempted(
+        self, claim: Claim, observed_unix: float | None = None
+    ) -> float:
+        """The revoke's happy path: the victim checkpointed and hands
+        the claim back with ZERO attempts consumed (preemption is
+        scheduling, not failure). The job record gains a preemption
+        tally + the request->release latency (flows into the resumed
+        run's done record and the rollup) and keeps its
+        ``created_unix`` so :meth:`claim_next` re-claims it at its
+        ORIGINAL queue position. Returns the recorded latency."""
+        now = time.time()
+        req = self.preempt_request(claim.job.job_id) or {}
+        requested = float(
+            req.get("requested_unix") or observed_unix or now
+        )
+        latency = max(0.0, now - requested)
+        job = self.get_job(claim.job.job_id)
+        if job is not None:
+            job.preemptions += 1
+            job.preempt_latency_s.append(round(latency, 4))
+            _atomic_write_json(self._p(_JOBS, job.job_id), job.to_doc())
+            claim.job = job  # the caller sees the updated tallies
+        self._release(claim)  # also clears the preempt request
+        from ..resilience import STATS
+
+        STATS.preemption("released")
+        log.info(
+            "claim on %s preempted away from %s after %.3fs "
+            "(checkpointed; zero attempts consumed)",
+            claim.job.job_id, claim.worker_id, latency,
+        )
+        return latency
+
+    def preemption_wanted(
+        self, claim: Claim, now: float | None = None
+    ) -> dict | None:
+        """Does a PENDING job outrank this claim's priority class? The
+        decentralised preemption trigger: a busy worker's
+        lease-renewer asks this each beat, and — when it also holds
+        the lowest-priority running claim
+        (:meth:`is_lowest_priority_running`) — revokes itself so the
+        urgent job gets a worker without any coordinator. Gang jobs
+        are excluded (they wait for their group, not for a victim)."""
+        now = time.time() if now is None else now
+        best: dict | None = None
+        for jid in self.job_ids():
+            if self.state(jid, now) != "pending":
+                continue
+            job = self.get_job(jid)
+            if job is None or job.nprocs > 1:
+                continue
+            if job.priority > claim.job.priority and (
+                best is None or job.priority > best["priority"]
+            ):
+                best = {"job_id": jid, "priority": job.priority}
+        return best
+
+    def is_lowest_priority_running(
+        self, claim: Claim, now: float | None = None
+    ) -> bool:
+        """Deterministic victim selection: among live (unexpired,
+        non-gang) claims, the one with the smallest (priority, job_id)
+        is THE victim — so when every busy worker evaluates the same
+        pending urgent job, exactly one self-revokes."""
+        now = time.time() if now is None else now
+        lowest: tuple | None = None
+        cdir = os.path.join(self.qdir, _CLAIMS)
+        for name in sorted(os.listdir(cdir)):
+            if not name.endswith(".json"):
+                continue
+            doc = _read_json(os.path.join(cdir, name))
+            if doc is None or float(doc.get("expires_unix", 0)) < now:
+                continue
+            if doc.get("gang"):
+                continue
+            jid = doc.get("job_id") or os.path.splitext(name)[0]
+            job = self.get_job(jid)
+            if job is None:
+                continue
+            key = (job.priority, jid)
+            if lowest is None or key < lowest:
+                lowest = key
+        return lowest is not None and lowest[1] == claim.job.job_id
+
+    # --- gang membership ----------------------------------------------
+    def gang_invitation(self, worker_id: str) -> dict | None:
+        """A live gang claim naming ``worker_id`` as a (non-leader)
+        member: the member-side entry into a gang job. Returns the
+        claim document (carrying the gang member set, epoch and
+        job_id) or None."""
+        now = time.time()
+        cdir = os.path.join(self.qdir, _CLAIMS)
+        for name in sorted(os.listdir(cdir)):
+            if not name.endswith(".json"):
+                continue
+            doc = _read_json(os.path.join(cdir, name))
+            if doc is None or float(doc.get("expires_unix", 0)) < now:
+                continue
+            gang = doc.get("gang")
+            if (
+                gang
+                and worker_id in gang.get("members", [])
+                and worker_id != doc.get("worker_id")
+            ):
+                return doc
+        return None
 
     def _record_failure(self, job_id: str, error: str) -> str:
         """Consume one attempt: exponential backoff, or quarantine when
@@ -449,13 +664,19 @@ class JobQueue:
     # --- stale-claim reaping -----------------------------------------
     def reap_stale(self, now: float | None = None) -> list[str]:
         """Re-queue jobs whose claim lease expired (their worker was
-        SIGKILLed or wedged past its lease). Exactly one reaper wins
-        per claim: the claim is renamed to a private tombstone first,
-        and only the winner of that rename records the failure.
+        SIGKILLed or wedged past its lease) — and jobs whose holder
+        blew a preempt request's grace deadline (alive enough to renew
+        its lease yet unresponsive to the revoke: wedged in device
+        code, or the revoke delivery itself is failing — the
+        ``preempt.revoke`` chaos seam). Exactly one reaper wins per
+        claim: the claim is renamed to a private tombstone first, and
+        only the winner of that rename records the failure.
 
         A renewal racing the reap is detected by re-reading the
         tombstone: if the lease is no longer expired the rename
-        caught a freshly renewed claim, and it is put back."""
+        caught a freshly renewed claim, and it is put back. (A
+        grace-deadline reap deliberately skips the putback — renewing
+        the lease is exactly what an unresponsive victim does.)"""
         now = time.time() if now is None else now
         # chaos seam: a scheduled clock.skew fault shifts THIS
         # reaper's view of lease expiry (drills premature reaping —
@@ -468,7 +689,15 @@ class JobQueue:
                 continue
             path = os.path.join(cdir, name)
             doc = _read_json(path)
-            if doc is None or float(doc.get("expires_unix", 0)) >= now:
+            if doc is None:
+                continue
+            job_id = os.path.splitext(name)[0]
+            expired = float(doc.get("expires_unix", 0)) < now
+            req = self.preempt_request(job_id)
+            overdue = req is not None and (
+                float(req.get("deadline_unix", 0)) < now
+            )
+            if not expired and not overdue:
                 continue
             tomb = f"{path}.reap.{uuid.uuid4().hex[:8]}"
             try:
@@ -476,7 +705,11 @@ class JobQueue:
             except OSError:
                 continue  # lost the reap race
             fresh = _read_json(tomb)
-            if fresh and float(fresh.get("expires_unix", 0)) >= now:
+            if (
+                not overdue
+                and fresh
+                and float(fresh.get("expires_unix", 0)) >= now
+            ):
                 # the owner renewed between our read and the rename:
                 # restore its claim (if a third party claimed in the
                 # gap the owner has genuinely lost the lease)
@@ -485,16 +718,29 @@ class JobQueue:
                 except OSError:
                     os.unlink(tomb)
                 continue
-            job_id = os.path.splitext(name)[0]
             worker = (fresh or {}).get("worker_id", "?")
-            self._record_failure(
-                job_id,
-                f"lease expired (worker {worker} presumed dead)",
-            )
+            if overdue and not expired:
+                self._record_failure(
+                    job_id,
+                    f"preempt grace deadline expired (worker {worker} "
+                    "unresponsive to revoke)",
+                )
+                from ..resilience import STATS
+
+                STATS.preemption("reaped")
+            else:
+                self._record_failure(
+                    job_id,
+                    f"lease expired (worker {worker} presumed dead)",
+                )
+            self.clear_preempt(job_id)
             os.unlink(tomb)
             reaped.append(job_id)
             log.warning(
-                "reaped stale claim on %s (worker %s)", job_id, worker
+                "reaped %s claim on %s (worker %s)",
+                "revoke-unresponsive" if overdue and not expired
+                else "stale",
+                job_id, worker,
             )
         return reaped
 

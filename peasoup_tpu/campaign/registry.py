@@ -66,12 +66,19 @@ def _read_json(path: str) -> dict | None:
 
 
 class WorkerRegistry:
-    """Heartbeat files under ``<root>/queue/workers/``."""
+    """Heartbeat files under ``<root>/queue/workers/``. ``group``
+    names THIS process's gang-scheduling process group: it rides every
+    (re-)registration, so an entry recreated by a beat — after a
+    clock-skewed peer reaped a perfectly live worker — keeps its group
+    membership and the gang pool never silently shrinks."""
 
-    def __init__(self, root: str, lease_s: float = 60.0) -> None:
+    def __init__(
+        self, root: str, lease_s: float = 60.0, group: str | None = None
+    ) -> None:
         self.root = os.path.abspath(root)
         self.wdir = os.path.join(self.root, "queue", _WORKERS)
         self.lease_s = float(lease_s)
+        self.group = group
         os.makedirs(self.wdir, exist_ok=True)
 
     def _path(self, worker_id: str) -> str:
@@ -96,6 +103,7 @@ class WorkerRegistry:
             "jobs_done": 0,
             "current_job": None,
             "last_bucket": None,
+            "group": self.group,  # process group for gang scheduling
             **info,
         }
         path = self._path(worker_id)
@@ -135,12 +143,48 @@ class WorkerRegistry:
         _atomic_write_json(path, doc)
 
     def deregister(self, worker_id: str) -> None:
-        """Clean leave: remove the membership entry."""
+        """Clean leave: remove the membership entry (and any pending
+        retire request — the leave answers it)."""
+        self.clear_retire(worker_id)
         try:
             os.unlink(self._path(worker_id))
             log.info("worker %s left the fleet", worker_id)
         except FileNotFoundError:
             pass  # reaped already — same outcome
+
+    # --- retirement (autoscale scale-down) ----------------------------
+    def _retire_path(self, worker_id: str) -> str:
+        # ".retire" (not ".json") so registry scans — which filter on
+        # ".json" — never mistake a request for a membership entry
+        return self._path(worker_id) + ".retire"
+
+    def request_retire(self, worker_id: str, requester: str = "") -> None:
+        """Ask a worker to leave the fleet cleanly: it observes the
+        marker between jobs (or mid-job via the revoke token — it then
+        checkpoints and releases its claim with zero attempts
+        consumed), deregisters, and exits. The autoscale controller's
+        scale-down path (campaign/autoscale.py)."""
+        _atomic_write_json(
+            self._retire_path(worker_id),
+            {
+                "worker_id": worker_id,
+                "requester": requester,
+                "requested_unix": time.time(),
+            },
+        )
+        log.info(
+            "retire requested for worker %s%s", worker_id,
+            f" (by {requester})" if requester else "",
+        )
+
+    def retire_requested(self, worker_id: str) -> dict | None:
+        return _read_json(self._retire_path(worker_id))
+
+    def clear_retire(self, worker_id: str) -> None:
+        try:
+            os.unlink(self._retire_path(worker_id))
+        except FileNotFoundError:
+            pass
 
     # --- reading ------------------------------------------------------
     def entries(self) -> list[dict]:
@@ -158,6 +202,17 @@ class WorkerRegistry:
             e for e in self.entries()
             if float(e.get("expires_unix", 0)) >= now
         ]
+
+    def live_group(
+        self, group: str, now: float | None = None
+    ) -> list[str]:
+        """Sorted live worker ids of one process group — the gang
+        leader is the first entry (queue.claim_next's contract)."""
+        return sorted(
+            e["worker_id"]
+            for e in self.live(now)
+            if e.get("group") == group and e.get("worker_id")
+        )
 
     # --- reaping ------------------------------------------------------
     def reap(self, now: float | None = None) -> list[str]:
@@ -187,4 +242,16 @@ class WorkerRegistry:
                 doc.get("worker_id"),
                 now - float(doc.get("expires_unix", 0)),
             )
+        # orphaned retire markers (the worker died, or left, before
+        # observing the request) must not leak — the request is moot
+        for name in sorted(os.listdir(self.wdir)):
+            if not name.endswith(".retire"):
+                continue
+            if not os.path.exists(
+                os.path.join(self.wdir, name[: -len(".retire")])
+            ):
+                try:
+                    os.unlink(os.path.join(self.wdir, name))
+                except FileNotFoundError:
+                    pass
         return reaped

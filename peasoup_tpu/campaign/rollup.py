@@ -161,6 +161,45 @@ def build_status(root: str, queue: JobQueue | None = None) -> dict:
             if rec["done"] > 1 and span > 0 else None
         )
     degraded_jobs = sum(1 for d in done if d.get("degraded"))
+    # preemption attribution: revoked-and-resumed jobs carry their
+    # tally + request->release latency into done records; outstanding
+    # requests are revokes still in flight (queue/claims/*.preempt)
+    preempted = [d for d in done if d.get("preemptions")]
+    latencies = [
+        float(x)
+        for d in preempted
+        for x in (d.get("preempt_latency_s") or [])
+    ]
+    preemptions = {
+        "jobs": len(preempted),
+        "total": sum(int(d.get("preemptions", 0)) for d in preempted),
+        "outstanding_requests": len(
+            glob.glob(
+                os.path.join(queue.qdir, "claims", "*.preempt")
+            )
+        ),
+        "latency_s": (
+            {
+                "mean": round(sum(latencies) / len(latencies), 4),
+                "max": round(max(latencies), 4),
+            }
+            if latencies else None
+        ),
+    }
+    gang_jobs = sum(1 for d in done if d.get("gang"))
+    # autoscale decision log (campaign/autoscale.py), embedded so the
+    # controller's reasoning rides the same operator surface
+    from .autoscale import load_autoscale_log
+
+    autoscale = load_autoscale_log(root)
+    if autoscale is not None:
+        autoscale = {
+            k: autoscale.get(k)
+            for k in (
+                "controller_id", "last_action_unix", "spawned_total",
+                "policy", "decisions",
+            )
+        }
     # *.corrupt quarantine accumulation (prune with
     # `peasoup-campaign prune --corrupt`)
     corrupt_files = len(
@@ -202,6 +241,13 @@ def build_status(root: str, queue: JobQueue | None = None) -> dict:
         # crashed helper thread) and quarantined *.corrupt artifacts
         "degraded_jobs": degraded_jobs,
         "corrupt_artifact_files": corrupt_files,
+        # priority preemption: revoked/resumed jobs + revoke latency
+        "preemptions": preemptions,
+        # gang-scheduled (nprocs > 1) completions
+        "gang_jobs": gang_jobs,
+        # autoscale controller decision log (None when no controller
+        # has acted on this campaign)
+        "autoscale": autoscale,
     }
 
 

@@ -93,6 +93,22 @@ class CampaignConfig:
     # worker/job of the bucket loads the plan with zero re-measurement
     tune: bool = False
     tuning_cache: str = ""  # "" = <campaign root>/tuning_cache.json
+    # priority preemption: a worker holding the lowest-priority
+    # running claim revokes ITSELF when a pending job outranks it and
+    # no idle worker is live (the decentralised trigger; operators and
+    # schedulers can also `peasoup-campaign preempt` explicitly). The
+    # victim checkpoints at the next DM-block boundary and releases
+    # with zero attempts consumed; one unresponsive past the grace
+    # deadline is escalated to the reap path.
+    preempt: bool = True
+    preempt_grace_s: float = 60.0
+    # gang-scheduled jobs (Job.nprocs > 1): how long the leader waits
+    # for the full group at the join barrier before releasing the
+    # claim cleanly (no partial-gang deadlock), and how long any
+    # member waits at a mid-run barrier before the gang fails
+    # transient (a dead member must consume exactly one attempt)
+    gang_assemble_s: float = 30.0
+    gang_timeout_s: float = 600.0
 
     def tuning_cache_path(self, root: str) -> str:
         return self.tuning_cache or os.path.join(root, "tuning_cache.json")
@@ -249,11 +265,15 @@ def enqueue_entries(
     pipeline: str,
     ladder: list[int] | None = None,
     priority: int = 0,
+    nprocs: int = 1,
 ) -> int:
     """Idempotently enqueue manifest entries; returns how many were
     new. ``priority`` is the default priority class; a per-entry
     ``"priority"`` in a manifest JSON line overrides it (higher claims
-    sooner — queue.claim_next ranks priority above bucket affinity)."""
+    sooner — queue.claim_next ranks priority above bucket affinity).
+    ``nprocs`` (default / per-entry ``"nprocs"``) > 1 gang-schedules
+    the job across a worker process group via the multi-host drivers —
+    supported for the search and spsearch pipelines."""
     added = 0
     for e in entries:
         inp = e["input"]
@@ -264,11 +284,18 @@ def enqueue_entries(
             config=e.get("config") or {},
             bucket=bucket_for_input(inp, ladder),
             priority=int(e.get("priority", priority)),
+            nprocs=int(e.get("nprocs", nprocs)),
         )
         if job.pipeline not in PIPELINES:
             raise ValueError(
                 f"unknown pipeline {job.pipeline!r} for {inp} "
                 f"(expected one of {PIPELINES})"
+            )
+        if job.nprocs > 1 and job.pipeline not in ("search", "spsearch"):
+            raise ValueError(
+                f"gang scheduling (nprocs={job.nprocs}) is supported "
+                f"for the search/spsearch pipelines only, not "
+                f"{job.pipeline!r} ({inp})"
             )
         added += bool(queue.add_job(job))
     return added
@@ -344,13 +371,19 @@ def run_observation(
     bucket_ladder: list[int] | None = None,
     warmer: "_BucketWarmer | None" = None,
     tuning_cache: str | None = None,
+    comm=None,
+    write_outputs: bool = True,
 ) -> dict:
     """Execute one observation end-to-end inside this process and write
     its outputs (overview.xml + pipeline-specific candidate files)
     under ``job_dir``. Returns the done-record info dict. ``warmer``
     is an in-flight bucket warmup joined after the filterbank read —
     I/O and compile overlap — whose stats land in the telemetry and
-    done record."""
+    done record. ``comm`` (a parallel.multihost.GangComm) routes a
+    gang-scheduled job through the multi-host drivers: this process
+    computes its rank's DM slice and the gang's file-backed exchange
+    merges, so the leader writes outputs identical to a single-process
+    run."""
     from ..io.output import (
         CandidateFileWriter,
         OutputFileWriter,
@@ -424,26 +457,32 @@ def run_observation(
             SinglePulseConfig, overrides, outdir=outdir,
             checkpoint_file=os.path.join(outdir, "search.ckpt.npz"),
         )
-        result = SinglePulseSearch(cfg).run(fil)
+        if comm is not None:
+            from ..parallel.multihost import run_single_pulse_search
+
+            result = run_single_pulse_search(fil, cfg, comm=comm)
+        else:
+            result = SinglePulseSearch(cfg).run(fil)
         # detections whose peak lies in the padding are artefacts of
         # the bucket, not the sky
         cands = [c for c in result.candidates if c.sample < orig_nsamps]
         result.timers["reading"] = reading
         tel.merge_timers(result.timers)
-        tel.set_stage("writing")
-        write_singlepulse(
-            os.path.join(outdir, "candidates.singlepulse"), cands
-        )
-        stats = OutputFileWriter()
-        stats.add_misc_info()
-        stats.add_header(fil.header)
-        stats.add_dm_list(result.dm_list)
-        stats.add_device_info()
-        stats.add_single_pulse_section(
-            cfg, job.input, result.widths, cands
-        )
-        stats.add_timing_info(result.timers)
-        stats.to_file(os.path.join(outdir, "overview.xml"))
+        if write_outputs:
+            tel.set_stage("writing")
+            write_singlepulse(
+                os.path.join(outdir, "candidates.singlepulse"), cands
+            )
+            stats = OutputFileWriter()
+            stats.add_misc_info()
+            stats.add_header(fil.header)
+            stats.add_dm_list(result.dm_list)
+            stats.add_device_info()
+            stats.add_single_pulse_section(
+                cfg, job.input, result.widths, cands
+            )
+            stats.add_timing_info(result.timers)
+            stats.to_file(os.path.join(outdir, "overview.xml"))
         n_cands = len(cands)
     elif job.pipeline == "ffa":
         from ..pipeline.ffa import FFAConfig, FFASearch
@@ -452,18 +491,19 @@ def run_observation(
         result = FFASearch(cfg).run(fil)
         result.timers["reading"] = reading
         tel.merge_timers(result.timers)
-        tel.set_stage("writing")
-        write_ffa_candidates(
-            os.path.join(outdir, "candidates.ffa"), result.candidates
-        )
-        stats = OutputFileWriter()
-        stats.add_misc_info()
-        stats.add_header(fil.header)
-        stats.add_dm_list(result.dm_list)
-        stats.add_device_info()
-        stats.add_ffa_section(cfg, job.input, result.candidates)
-        stats.add_timing_info(result.timers)
-        stats.to_file(os.path.join(outdir, "overview.xml"))
+        if write_outputs:
+            tel.set_stage("writing")
+            write_ffa_candidates(
+                os.path.join(outdir, "candidates.ffa"), result.candidates
+            )
+            stats = OutputFileWriter()
+            stats.add_misc_info()
+            stats.add_header(fil.header)
+            stats.add_dm_list(result.dm_list)
+            stats.add_device_info()
+            stats.add_ffa_section(cfg, job.input, result.candidates)
+            stats.add_timing_info(result.timers)
+            stats.to_file(os.path.join(outdir, "overview.xml"))
         n_cands = len(result.candidates)
     else:  # "search" (validated at enqueue)
         from ..pipeline.search import PeasoupSearch, SearchConfig
@@ -472,22 +512,28 @@ def run_observation(
             SearchConfig, overrides, outdir=outdir,
             checkpoint_file=os.path.join(outdir, "search.ckpt.npz"),
         )
-        result = PeasoupSearch(cfg).run(fil)
+        if comm is not None:
+            from ..parallel.multihost import run_search
+
+            result = run_search(fil, cfg, comm=comm)
+        else:
+            result = PeasoupSearch(cfg).run(fil)
         result.timers["reading"] = reading
         tel.merge_timers(result.timers)
-        tel.set_stage("writing")
-        writer = CandidateFileWriter(outdir)
-        writer.write_binary(result.candidates, "candidates.peasoup")
-        stats = OutputFileWriter()
-        stats.add_misc_info()
-        stats.add_header(fil.header)
-        stats.add_search_parameters(cfg, job.input)
-        stats.add_dm_list(result.dm_list)
-        stats.add_acc_list(result.acc_list_dm0)
-        stats.add_device_info()
-        stats.add_candidates(result.candidates, writer.byte_mapping)
-        stats.add_timing_info(result.timers)
-        stats.to_file(os.path.join(outdir, "overview.xml"))
+        if write_outputs:
+            tel.set_stage("writing")
+            writer = CandidateFileWriter(outdir)
+            writer.write_binary(result.candidates, "candidates.peasoup")
+            stats = OutputFileWriter()
+            stats.add_misc_info()
+            stats.add_header(fil.header)
+            stats.add_search_parameters(cfg, job.input)
+            stats.add_dm_list(result.dm_list)
+            stats.add_acc_list(result.acc_list_dm0)
+            stats.add_device_info()
+            stats.add_candidates(result.candidates, writer.byte_mapping)
+            stats.add_timing_info(result.timers)
+            stats.to_file(os.path.join(outdir, "overview.xml"))
         n_cands = len(result.candidates)
 
     tel.gauge("candidates.written", n_cands)
@@ -601,17 +647,34 @@ class _LeaseRenewer(threading.Thread):
     fleet view. The loop body already tolerates per-renewal failures;
     the crash guard covers everything else (a bug here silently
     forfeiting leases is exactly the invisible-thread-death failure
-    mode)."""
+    mode).
+
+    The beat is also the fleet's revoke channel: it observes a
+    preempt-request file beside the claim (or a retire marker beside
+    the registry entry) and flips the job's
+    :class:`~peasoup_tpu.resilience.revoke.RevokeToken`, which the
+    driver answers at its next checkpoint boundary. With
+    ``self_preempt`` it additionally runs the decentralised victim
+    selection: when a pending job outranks this claim, no live idle
+    worker exists, and this is THE lowest-priority running claim, it
+    writes the preempt request on its own claim — priority preemption
+    with no coordinator."""
 
     def __init__(
         self, queue: JobQueue, claim: Claim, telemetry=None,
         registry: "WorkerRegistry | None" = None,
+        token=None,
+        self_preempt: bool = False,
+        grace_s: float = 60.0,
     ) -> None:
         super().__init__(name="campaign-lease", daemon=True)
         self._queue = queue
         self._claim = claim
         self._telemetry = telemetry
         self._registry = registry
+        self._token = token
+        self._self_preempt = bool(self_preempt)
+        self._grace_s = float(grace_s)
         # NB: not "_stop" — Thread uses that name internally
         self._halt = threading.Event()
 
@@ -634,6 +697,75 @@ class _LeaseRenewer(threading.Thread):
                     )
             except Exception:
                 log.debug("lease renewal failed", exc_info=True)
+            try:
+                self._observe_revoke()
+            except Exception:
+                log.debug("revoke observation failed", exc_info=True)
+
+    def _observe_revoke(self) -> None:
+        token = self._token
+        if token is None or token.is_set():
+            return
+        job_id = self._claim.job.job_id
+        req = self._queue.preempt_request(job_id)
+        if req is None and self._self_preempt and not self._claim.gang:
+            wanted = self._queue.preemption_wanted(self._claim)
+            if wanted is not None and not self._idle_worker_live():
+                if self._queue.is_lowest_priority_running(self._claim):
+                    self._queue.request_preempt(
+                        job_id,
+                        requester=(
+                            f"priority:{wanted['job_id']}"
+                            f"(p{wanted['priority']})"
+                        ),
+                        grace_s=self._grace_s,
+                    )
+                    req = self._queue.preempt_request(job_id)
+        if req is not None:
+            from ..resilience import TransientIOError, faults
+
+            try:
+                # the revoke-delivery seam: an injected fault makes
+                # THIS beat miss the request (an unresponsive victim —
+                # the grace deadline escalates to the reaper)
+                faults.fire("preempt.revoke", context=job_id)
+            except TransientIOError:
+                return
+            token.revoke(
+                kind="preempt",
+                reason=req.get("requester") or "preempt request",
+                requested_unix=req.get("requested_unix"),
+            )
+            if self._telemetry is not None:
+                self._telemetry.event(
+                    "preempt_observed", job_id=job_id,
+                    requester=req.get("requester"),
+                    requested_unix=req.get("requested_unix"),
+                )
+            return
+        if self._registry is not None:
+            ret = self._registry.retire_requested(self._claim.worker_id)
+            if ret is not None:
+                token.revoke(
+                    kind="retire",
+                    reason=ret.get("requester") or "retire request",
+                    requested_unix=ret.get("requested_unix"),
+                )
+                if self._telemetry is not None:
+                    self._telemetry.event(
+                        "retire_observed",
+                        worker_id=self._claim.worker_id,
+                        requester=ret.get("requester"),
+                    )
+
+    def _idle_worker_live(self) -> bool:
+        if self._registry is None:
+            return False
+        return any(
+            e.get("current_job") is None
+            and e.get("worker_id") != self._claim.worker_id
+            for e in self._registry.live()
+        )
 
     def stop(self) -> None:
         self._halt.set()
@@ -645,9 +777,17 @@ class _LeaseRenewer(threading.Thread):
 # --------------------------------------------------------------------------
 
 class CampaignRunner:
-    """One worker process draining a campaign directory."""
+    """One worker process draining a campaign directory. ``group``
+    names the process group this worker belongs to for gang-scheduled
+    jobs (Job.nprocs > 1): the group's lexicographically-first live
+    member leads gang claims; the rest join as ranked members."""
 
-    def __init__(self, root: str, worker_id: str | None = None) -> None:
+    def __init__(
+        self,
+        root: str,
+        worker_id: str | None = None,
+        group: str | None = None,
+    ) -> None:
         self.root = os.path.abspath(root)
         self.campaign = load_campaign_config(self.root)
         self.queue = JobQueue(
@@ -657,15 +797,21 @@ class CampaignRunner:
             backoff_base_s=self.campaign.backoff_base_s,
         )
         self.worker_id = worker_id or JobQueue.default_worker_id()
+        self.group = group
         # fleet membership: workers join and leave at will; the
         # registry's heartbeat files are what rollup/watch render and
         # what the fleet soak audits for leaks (campaign/registry.py)
         self.registry = WorkerRegistry(
-            self.root, lease_s=self.campaign.lease_s
+            self.root, lease_s=self.campaign.lease_s, group=group
         )
         self._jobs_done = 0
         self._last_bucket: tuple | None = None
         self._warmed_buckets: set[tuple] = set()
+        self._retiring = False
+        # gang epochs this worker already served as a member (the
+        # invitation outlives the member's run until the leader
+        # completes — never join the same epoch twice)
+        self._gang_epochs_joined: set[str] = set()
         self._tuning_cache = (
             self.campaign.tuning_cache_path(self.root)
             if self.campaign.tune else None
@@ -679,7 +825,11 @@ class CampaignRunner:
     # --- one job ------------------------------------------------------
     def process_claim(self, claim: Claim) -> str:
         """Run one claimed job under its own observability stack.
-        Returns the job's resulting state (done|backoff|quarantined)."""
+        Returns the job's resulting state (done|backoff|quarantined),
+        or "released" when a revoke (preempt/retire) handed the job
+        back mid-run with zero attempts consumed."""
+        from ..resilience import RevokeToken, activate_token
+
         job = claim.job
         job_dir = os.path.join(self.root, "jobs", job.job_id)
         os.makedirs(job_dir, exist_ok=True)
@@ -694,14 +844,48 @@ class CampaignRunner:
             outdir=job_dir,
             attempt=job.attempts + 1,
             bucket=list(job.bucket) if job.bucket else None,
+            gang=claim.gang,
         )
         from ..resilience import STATS as _RES_STATS
 
         res_base = _RES_STATS.snapshot()
+        token = RevokeToken()
         renewer = _LeaseRenewer(
-            self.queue, claim, telemetry=tel, registry=self.registry
+            self.queue, claim, telemetry=tel, registry=self.registry,
+            token=token,
+            self_preempt=self.campaign.preempt,
+            grace_s=self.campaign.preempt_grace_s,
         )
         renewer.start()
+        comm = None
+        if claim.gang:
+            # gang leader: assemble the group at the join barrier (the
+            # file-backed exchange's round 0), then route through the
+            # multi-host driver. An unassembled gang is a clean release
+            # — zero attempts, no partial-gang deadlock.
+            comm = self._gang_comm(claim.gang, job_dir, rank=0)
+            try:
+                comm.allgather(
+                    self.worker_id.encode(),
+                    context=f"gang-join:{job.job_id}",
+                    timeout_s=self.campaign.gang_assemble_s,
+                )
+            except Exception as exc:
+                renewer.stop()
+                self._gang_cleanup(comm)
+                tel.event(
+                    "gang_unassembled", job_id=job.job_id,
+                    gang=claim.gang, error=f"{exc!s:.200}",
+                )
+                self.queue.release(claim)
+                log.warning(
+                    "gang for %s did not assemble (%s); claim released "
+                    "cleanly", job.job_id, exc,
+                )
+                return "released"
+            tel.event(
+                "gang_assembled", job_id=job.job_id, gang=claim.gang
+            )
         warmer = None
         if (
             self.campaign.warmup
@@ -732,8 +916,10 @@ class CampaignRunner:
             interval=self.campaign.heartbeat_interval,
         ).start()
         overrides = {**self.campaign.config, **job.config}
+        from ..resilience import SearchPreempted
+
         try:
-            with tel.activate():
+            with tel.activate(), activate_token(token):
                 try:
                     # chaos seam: a scheduled worker.kill raises
                     # WorkerKilled (BaseException) here — it skips the
@@ -748,6 +934,7 @@ class CampaignRunner:
                         bucket_ladder=self.campaign.bucket_nsamps,
                         warmer=warmer,
                         tuning_cache=self._tuning_cache,
+                        comm=comm,
                     )
                     compiled = jit_programs_compiled(tel)
                     info["jit_programs_compiled"] = compiled
@@ -789,8 +976,56 @@ class CampaignRunner:
                         res_delta.get("degradations")
                         or res_delta.get("thread_crashes")
                     )
+                    # preemption provenance: a job that was revoked and
+                    # resumed carries its tally + request->release
+                    # latency into the done record (claim.job is the
+                    # record as re-read at claim time)
+                    if job.preemptions:
+                        info["preemptions"] = int(job.preemptions)
+                        info["preempt_latency_s"] = list(
+                            job.preempt_latency_s
+                        )
+                    if claim.gang:
+                        info["gang"] = dict(claim.gang)
                     tel.set_stage("done")
                     tel.write(manifest_path)
+                except SearchPreempted as exc:
+                    # the revoke's cooperative stop: the checkpoint on
+                    # disk is consistent (check_revoke's contract), so
+                    # the claim is RELEASED — zero attempts consumed —
+                    # and the job resumes from the checkpoint later,
+                    # bitwise-equal to an uninterrupted run
+                    tel.event(
+                        "preempted", job_id=job.job_id,
+                        revoke_kind=exc.kind, reason=exc.reason,
+                    )
+                    tel.write(
+                        manifest_path, aborted=True,
+                        abort_reason=f"revoked ({exc.kind}): "
+                        f"{exc.reason:.200}",
+                    )
+                    if comm is not None:
+                        comm.abort(f"leader revoked ({exc.kind})")
+                    if exc.kind == "retire":
+                        self.queue.release(claim)
+                        self._retiring = True
+                        from ..resilience import STATS
+
+                        STATS.preemption("retire")
+                        log.info(
+                            "worker %s retiring: job %s released "
+                            "cleanly at a checkpoint boundary",
+                            self.worker_id, job.job_id,
+                        )
+                    else:
+                        latency = self.queue.release_preempted(
+                            claim, observed_unix=token.observed_unix
+                        )
+                        tel.event(
+                            "preempt_released", job_id=job.job_id,
+                            latency_s=round(latency, 4),
+                        )
+                    return "released"
                 except Exception as exc:
                     tel.event(
                         "campaign_job_failed",
@@ -800,6 +1035,13 @@ class CampaignRunner:
                         manifest_path, aborted=True,
                         abort_reason=f"{type(exc).__name__}: {exc!s:.200}",
                     )
+                    if comm is not None:
+                        # any gang failure fails the gang as ONE unit:
+                        # peers abort fast at their next barrier, and
+                        # the job requeues as a single consumed attempt
+                        comm.abort(
+                            f"leader failed: {type(exc).__name__}"
+                        )
                     state = self.queue.fail(
                         claim, f"{type(exc).__name__}: {exc}"
                     )
@@ -811,6 +1053,8 @@ class CampaignRunner:
             heartbeat.stop()
             recorder.close()
             renewer.stop()
+            if comm is not None:
+                self._gang_cleanup(comm)
         # second chaos seam: dying AFTER the work but BEFORE the done
         # record is the worst case for exactly-once — the reaped job
         # re-runs in full and must complete idempotently
@@ -825,6 +1069,109 @@ class CampaignRunner:
             job.job_id, info["n_candidates"], info["jit_programs_compiled"],
         )
         return "done"
+
+    # --- gang-scheduled jobs ------------------------------------------
+    def _gang_comm(self, gang: dict, job_dir: str, rank: int):
+        """The file-backed exchange for one gang epoch. The leader
+        (rank 0) sweeps stale epoch directories first — a SIGKILLed
+        previous attempt must not leak its blobs."""
+        import shutil
+
+        from ..parallel.multihost import GangComm
+
+        if rank == 0:
+            for name in list(os.listdir(job_dir)) if os.path.isdir(
+                job_dir
+            ) else []:
+                # stale epochs only: a racing member may already have
+                # created (and written its join blob into) THIS epoch
+                if name.startswith("gang-") and name != (
+                    f"gang-{gang['epoch']}"
+                ):
+                    shutil.rmtree(
+                        os.path.join(job_dir, name), ignore_errors=True
+                    )
+        return GangComm(
+            os.path.join(job_dir, f"gang-{gang['epoch']}"),
+            nprocs=int(gang["nprocs"]),
+            rank=rank,
+            timeout_s=self.campaign.gang_timeout_s,
+            heartbeat=lambda: self.registry.beat(self.worker_id),
+        )
+
+    def _gang_cleanup(self, comm) -> None:
+        import shutil
+
+        shutil.rmtree(comm.gang_dir, ignore_errors=True)
+
+    def _gang_member(self, claim_doc: dict) -> None:
+        """The member side of a gang job: compute this rank's DM slice
+        through the same multi-host driver the leader runs, feeding
+        the file-backed exchange. Members hold no claim and consume no
+        attempts — a dying leader (claim reaped, exchange aborted or
+        timed out) just sends the member back to the queue loop; a
+        dying member surfaces at the LEADER's next barrier and fails
+        the gang transiently as one unit."""
+        gang = claim_doc["gang"]
+        job_id = claim_doc["job_id"]
+        epoch = gang.get("epoch", "")
+        self._gang_epochs_joined.add(epoch)
+        job = self.queue.get_job(job_id)
+        if job is None:
+            return
+        rank = gang["members"].index(self.worker_id)
+        job_dir = os.path.join(self.root, "jobs", job_id)
+        os.makedirs(job_dir, exist_ok=True)
+        tel = RunTelemetry()
+        tel.set_context(
+            command="campaign-gang-member",
+            job_id=job_id,
+            worker_id=self.worker_id,
+            pipeline=job.pipeline,
+            inputfile=job.input,
+            outdir=job_dir,
+            gang=gang,
+            process_index=rank,
+            process_count=int(gang["nprocs"]),
+        )
+        self.registry.beat(self.worker_id, current_job=job_id)
+        comm = self._gang_comm(gang, job_dir, rank=rank)
+        log.info(
+            "joining gang for %s as rank %d/%d (epoch %s)",
+            job_id, rank, gang["nprocs"], epoch,
+        )
+        try:
+            with tel.activate():
+                comm.allgather(
+                    self.worker_id.encode(),
+                    context=f"gang-join:{job_id}",
+                    timeout_s=self.campaign.gang_assemble_s,
+                )
+                tel.event("gang_assembled", job_id=job_id, gang=gang)
+                run_observation(
+                    job,
+                    {**self.campaign.config, **job.config},
+                    job_dir, tel,
+                    bucket_ladder=self.campaign.bucket_nsamps,
+                    tuning_cache=self._tuning_cache,
+                    comm=comm,
+                    write_outputs=False,  # the leader owns the outputs
+                )
+                tel.write(
+                    os.path.join(job_dir, f"telemetry.proc{rank}.json")
+                )
+        except Exception as exc:
+            comm.abort(f"member rank {rank} failed: {type(exc).__name__}")
+            log.warning(
+                "gang member rank %d of %s stopped: %.300s",
+                rank, job_id, exc,
+            )
+            tel.event(
+                "gang_member_failed", job_id=job_id, rank=rank,
+                error=f"{exc!s:.200}",
+            )
+        finally:
+            self.registry.beat(self.worker_id, current_job=None)
 
     # --- warmup-aware claiming ----------------------------------------
     def _warm_bucket_hint(self) -> set[tuple]:
@@ -855,28 +1202,56 @@ class CampaignRunner:
         poll_s: float = 1.0,
     ) -> dict:
         """Claim and process jobs until the campaign drains (every job
-        terminal), ``max_jobs`` are processed, or — with
-        ``drain=False`` — the queue has nothing immediately claimable.
-        Registers in the fleet registry for the duration (heartbeat
-        renewed alongside the claim lease; clean deregistration on any
-        exit path — only a SIGKILL leaves an entry, which peers reap).
-        Returns this worker's tally."""
+        terminal), ``max_jobs`` are processed, a retire request lands
+        (autoscale scale-down: the worker finishes — or checkpoints
+        and releases — its current job, deregisters and exits), or —
+        with ``drain=False`` — the queue has nothing immediately
+        claimable. Registers in the fleet registry for the duration
+        (heartbeat renewed alongside the claim lease; clean
+        deregistration on any exit path — only a SIGKILL leaves an
+        entry, which peers reap). Returns this worker's tally."""
         from ..resilience import WorkerKilled
 
-        tally = {"done": 0, "failed": 0, "quarantined": 0}
+        tally = {
+            "done": 0, "failed": 0, "quarantined": 0, "released": 0,
+        }
         processed = 0
-        self.registry.register(self.worker_id)
+        self.registry.register(self.worker_id, group=self.group)
         try:
             while True:
                 if max_jobs is not None and processed >= max_jobs:
+                    break
+                if self._retiring or self.registry.retire_requested(
+                    self.worker_id
+                ):
+                    log.info(
+                        "worker %s retiring (requested): leaving the "
+                        "fleet cleanly", self.worker_id,
+                    )
                     break
                 self.registry.beat(
                     self.worker_id, jobs_done=self._jobs_done,
                     current_job=None,
                 )
+                if self.group:
+                    # a gang claim naming this worker outranks new
+                    # work: the leader is holding the claim for the
+                    # whole group
+                    inv = self.queue.gang_invitation(self.worker_id)
+                    if inv is not None and (
+                        inv["gang"].get("epoch")
+                        not in self._gang_epochs_joined
+                    ):
+                        self._gang_member(inv)
+                        continue
                 claim = self.queue.claim_next(
                     self.worker_id, prefer_bucket=self._last_bucket,
                     warm_buckets=self._warm_bucket_hint(),
+                    group=self.group,
+                    group_members=(
+                        self.registry.live_group(self.group)
+                        if self.group else None
+                    ),
                 )
                 if claim is None:
                     self.registry.reap()
@@ -890,6 +1265,12 @@ class CampaignRunner:
                     time.sleep(poll_s)
                     continue
                 state = self.process_claim(claim)
+                if state == "released":
+                    # a revoke (preempt/retire) or an unassembled gang
+                    # handed the job back: nothing was consumed and
+                    # nothing was processed
+                    tally["released"] += 1
+                    continue
                 processed += 1
                 if state == "done":
                     tally["done"] += 1
@@ -929,13 +1310,16 @@ def run_worker(
     max_jobs: int | None = None,
     drain: bool = True,
     poll_s: float = 1.0,
+    group: str | None = None,
 ) -> dict:
     """THE worker entry point: one call makes this process a campaign
     worker (fleet registration, warmup-aware claiming, per-job
     observability, rollup writes) until it leaves. The CLI
-    (``peasoup-campaign run``), the in-process chaos soak, and the
-    fleet soak's real subprocesses all enter through here, so every
-    soak exercises exactly the code a production worker runs."""
-    return CampaignRunner(root, worker_id=worker_id).run(
+    (``peasoup-campaign run``), the in-process chaos soak, the
+    autoscale controller's spawns, and the fleet soak's real
+    subprocesses all enter through here, so every soak exercises
+    exactly the code a production worker runs. ``group`` opts the
+    worker into a gang-scheduling process group."""
+    return CampaignRunner(root, worker_id=worker_id, group=group).run(
         max_jobs=max_jobs, drain=drain, poll_s=poll_s
     )

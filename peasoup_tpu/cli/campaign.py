@@ -73,9 +73,20 @@ def build_parser() -> argparse.ArgumentParser:
                      help="which pipeline each job runs (default spsearch)")
     run.add_argument("--priority", type=int, default=0,
                      help="priority class for the observations enqueued "
-                     "by THIS invocation (higher claims sooner; a "
+                     "by THIS invocation (higher claims sooner — and may "
+                     "preempt a running lower-priority claim; a "
                      "per-entry 'priority' in a JSON manifest line "
                      "overrides; default 0)")
+    run.add_argument("--nprocs", type=int, default=1,
+                     help="gang-schedule the observations enqueued by "
+                     "THIS invocation across N worker processes of one "
+                     "--group (search/spsearch pipelines; a per-entry "
+                     "'nprocs' in a JSON manifest line overrides; "
+                     "default 1 = no gang)")
+    run.add_argument("--group", default=None,
+                     help="process-group name for gang-scheduled jobs: "
+                     "workers sharing a --group form one gang pool (the "
+                     "lexicographically-first live member leads claims)")
     run.add_argument("--config", default=None,
                      help="pipeline config overrides as inline JSON or "
                      "@file.json (keys = SearchConfig/SinglePulseConfig "
@@ -154,6 +165,39 @@ def build_parser() -> argparse.ArgumentParser:
     )
     ing.add_argument("-w", "--workdir", required=True)
 
+    pe = sub.add_parser(
+        "preempt", help="revoke a running claim: the victim worker "
+        "checkpoints at the next DM-block boundary and releases the "
+        "job with zero attempts consumed (it resumes later, "
+        "bitwise-equal); a victim unresponsive past the grace "
+        "deadline is escalated to the lease reaper",
+    )
+    pe.add_argument("-w", "--workdir", required=True)
+    pe.add_argument("job_id", help="the job whose claim to revoke")
+    pe.add_argument("--grace", type=float, default=60.0,
+                    help="seconds before an unresponsive victim is "
+                    "reaped (default 60)")
+
+    asc = sub.add_parser(
+        "autoscale", help="run the fleet autoscale controller: spawn "
+        "real workers when the backlog outruns the fleet, retire idle "
+        "ones when it drains — bounded by --min/--max with a cooldown, "
+        "decisions logged into campaign_status.json",
+    )
+    asc.add_argument("-w", "--workdir", required=True)
+    asc.add_argument("--min", type=int, default=1, dest="min_workers")
+    asc.add_argument("--max", type=int, default=4, dest="max_workers")
+    asc.add_argument("--cooldown", type=float, default=60.0)
+    asc.add_argument("--backlog-per-worker", type=float, default=2.0)
+    asc.add_argument("--poll", type=float, default=5.0)
+    asc.add_argument("--max-runtime", type=float, default=None,
+                     help="stop the controller after N seconds "
+                     "(default: run until the campaign drains)")
+    asc.add_argument("--spawn-arg", action="append", default=[],
+                     help="extra argument forwarded to each spawned "
+                     "`peasoup-campaign run` (repeatable, e.g. "
+                     "--spawn-arg=--no-warmup)")
+
     pr = sub.add_parser(
         "prune", help="delete quarantined artifacts (the *.corrupt "
         "forensics renamed aside by the resilience layer accumulate "
@@ -225,7 +269,7 @@ def _cmd_run(args) -> int:
         )
     added = enqueue_entries(
         queue, entries, campaign.pipeline, campaign.bucket_nsamps,
-        priority=args.priority,
+        priority=args.priority, nprocs=args.nprocs,
     )
     counts = queue.counts()
     print(
@@ -242,6 +286,7 @@ def _cmd_run(args) -> int:
         max_jobs=args.max_jobs,
         drain=not args.no_drain,
         poll_s=args.poll,
+        group=args.group,
     )
     status = write_status(args.workdir, queue)
     q = status["queue"]
@@ -344,6 +389,58 @@ def _cmd_ingest(args) -> int:
     return 0
 
 
+def _cmd_preempt(args) -> int:
+    from ..campaign.queue import JobQueue
+    from ..campaign.rollup import write_status
+
+    queue = JobQueue(args.workdir)
+    if not queue.request_preempt(
+        args.job_id, requester="operator", grace_s=args.grace
+    ):
+        print(
+            f"{args.job_id}: no live claim to preempt "
+            f"(state: {queue.state(args.job_id)})"
+        )
+        return 1
+    write_status(args.workdir, queue)
+    print(
+        f"preempt requested on {args.job_id} (grace {args.grace:g}s); "
+        "the victim will checkpoint and release"
+    )
+    return 0
+
+
+def _cmd_autoscale(args) -> int:
+    from ..campaign.autoscale import AutoscaleController, AutoscalePolicy
+    from ..campaign.rollup import write_status
+
+    try:
+        controller = AutoscaleController(
+            args.workdir,
+            AutoscalePolicy(
+                min_workers=args.min_workers,
+                max_workers=args.max_workers,
+                cooldown_s=args.cooldown,
+                backlog_per_worker=args.backlog_per_worker,
+            ),
+            extra_args=args.spawn_arg,
+        )
+    except ValueError as exc:
+        print(f"autoscale: {exc}", file=sys.stderr)
+        return 2
+    decisions = controller.run(
+        poll_s=args.poll, max_runtime_s=args.max_runtime
+    )
+    write_status(args.workdir)
+    ups = sum(1 for d in decisions if d["action"] == "up")
+    print(
+        f"autoscale: {ups} scale-up(s), {len(decisions) - ups} "
+        f"retirement(s); decision log in "
+        f"{os.path.join(args.workdir, 'autoscale.json')}"
+    )
+    return 0
+
+
 def _cmd_prune(args) -> int:
     import time
 
@@ -393,6 +490,8 @@ def main(argv: list[str] | None = None) -> int:
         "retry": _cmd_retry,
         "quarantine-list": _cmd_quarantine_list,
         "ingest": _cmd_ingest,
+        "preempt": _cmd_preempt,
+        "autoscale": _cmd_autoscale,
         "prune": _cmd_prune,
     }[args.cmd](args)
 

@@ -173,6 +173,133 @@ def _allgather_pickled(payload: bytes, context: str = "") -> list[bytes]:
         raise  # unreachable (classify always raises); keeps mypy honest
 
 
+class GangComm:
+    """File-backed allgather for gang-scheduled campaign jobs: N worker
+    PROCESSES without a JAX distributed runtime exchange pickled blobs
+    through a shared gang directory (one per claim epoch under the
+    job's directory), so the multi-host drivers below run unchanged —
+    same slice/partial/merge/finalize code, same ``multihost.barrier``
+    and ``multihost.merge`` fault seams — with this object supplying
+    ``nprocs``/``rank``/``allgather`` instead of the JAX collectives.
+
+    Each collective round writes ``r<round>.rank<k>`` (tmp + atomic
+    rename) and waits for every rank's blob. A member that dies —
+    SIGKILL, crash, or a peer aborting via :meth:`abort` — surfaces as
+    a ``TransientIOError`` at the next barrier (never a hang), so the
+    gang fails TRANSIENT as one unit and the job requeues as a single
+    consumed attempt.
+    """
+
+    def __init__(
+        self,
+        gang_dir: str,
+        nprocs: int,
+        rank: int,
+        timeout_s: float = 600.0,
+        poll_s: float = 0.05,
+        heartbeat=None,
+    ) -> None:
+        self.gang_dir = os.path.abspath(gang_dir)
+        self.nprocs = int(nprocs)
+        self.rank = int(rank)
+        self.timeout_s = float(timeout_s)
+        self.poll_s = float(poll_s)
+        self._heartbeat = heartbeat  # called during waits (registry beat)
+        self._round = 0
+        os.makedirs(self.gang_dir, exist_ok=True)
+
+    def _blob_path(self, rnd: int, rank: int) -> str:
+        return os.path.join(self.gang_dir, f"r{rnd:03d}.rank{rank}")
+
+    def abort(self, reason: str) -> None:
+        """Mark the gang aborted so peers fail fast at their next
+        barrier instead of running out the full timeout."""
+        try:
+            with open(
+                os.path.join(self.gang_dir, f"abort.rank{self.rank}"), "w"
+            ) as f:
+                f.write(f"{reason}\n")
+        except OSError:
+            pass  # the timeout remains the backstop
+
+    def _aborted(self) -> str | None:
+        try:
+            for name in os.listdir(self.gang_dir):
+                if name.startswith("abort."):
+                    return name
+        except FileNotFoundError:
+            return "gang directory removed"
+        return None
+
+    def allgather(
+        self,
+        payload: bytes,
+        context: str = "",
+        timeout_s: float | None = None,
+    ) -> list[bytes]:
+        """Exchange one blob per member; returns every member's blob in
+        rank order. The ``multihost.barrier`` fault seam fires here,
+        exactly as it does for the JAX-collective path."""
+        import errno as _errno
+        import time as _time
+
+        faults.fire("multihost.barrier", context=context)
+        rnd = self._round
+        self._round += 1
+        tmp = self._blob_path(rnd, self.rank) + ".w"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, self._blob_path(rnd, self.rank))
+        deadline = _time.monotonic() + (
+            self.timeout_s if timeout_s is None else float(timeout_s)
+        )
+        last_beat = 0.0
+        while True:
+            aborted = self._aborted()
+            if aborted:
+                raise TransientIOError(
+                    _errno.ECONNRESET,
+                    f"gang aborted ({aborted}) at {context or 'barrier'} "
+                    f"round {rnd}",
+                )
+            try:
+                present = [
+                    os.path.exists(self._blob_path(rnd, k))
+                    for k in range(self.nprocs)
+                ]
+            except OSError:
+                present = [False]
+            if all(present):
+                out = []
+                for k in range(self.nprocs):
+                    try:
+                        with open(self._blob_path(rnd, k), "rb") as f:
+                            out.append(f.read())
+                    except OSError as exc:
+                        raise TransientIOError(
+                            _errno.EIO,
+                            f"gang blob unreadable at {context!r} round "
+                            f"{rnd} rank {k}: {exc}",
+                        ) from exc
+                return out
+            if _time.monotonic() > deadline:
+                missing = [k for k, p in enumerate(present) if not p]
+                raise TransientIOError(
+                    _errno.ETIMEDOUT,
+                    f"gang member(s) rank {missing} missing at "
+                    f"{context or 'barrier'} round {rnd} (peer dead or "
+                    "never assembled)",
+                )
+            now = _time.monotonic()
+            if self._heartbeat is not None and now - last_beat > 0.5:
+                last_beat = now
+                try:
+                    self._heartbeat()
+                except Exception:
+                    pass  # liveness beats are best-effort
+            _time.sleep(self.poll_s)
+
+
 def _unpickle_all(blobs: list[bytes], context: str = "") -> list:
     """Deserialise every process's blob — the merge step shared by the
     search/single-pulse/survey-fold drivers, and the ``multihost.merge``
@@ -190,7 +317,22 @@ def _unpickle_all(blobs: list[bytes], context: str = "") -> list:
         raise
 
 
-def run_search(fil, config):
+def _comm_topology(comm: "GangComm | None") -> tuple[int, int, "object"]:
+    """(nprocs, rank, gather) for a driver: the JAX multi-process
+    runtime by default, or a :class:`GangComm` when the campaign gang
+    path supplies one (N worker processes coordinating through the
+    shared filesystem instead of a coordinator)."""
+    if comm is not None:
+        return comm.nprocs, comm.rank, comm.allgather
+    initialize()
+    return (
+        jax.process_count(),
+        jax.process_index(),
+        _allgather_pickled,
+    )
+
+
+def run_search(fil, config, comm: "GangComm | None" = None):
     """Multi-host `peasoup` search: DM-trial data parallelism across
     processes. Each process dedisperses + searches its contiguous slice
     of the global DM list on its LOCAL chips (share-nothing, like the
@@ -198,7 +340,9 @@ def run_search(fil, config):
     over DCN and every process runs the identical global
     distill/score/fold finalize — folds are computed by the trial's
     owner process and exchanged, so the final candidate list is
-    identical (and deterministic) on every process.
+    identical (and deterministic) on every process. With ``comm`` (a
+    gang-scheduled campaign job) the same driver runs over the
+    file-backed exchange instead of the JAX collectives.
 
     Single-process: exactly PeasoupSearch(config).run(fil).
     """
@@ -206,35 +350,36 @@ def run_search(fil, config):
 
     from ..pipeline.search import PartialSearchResult, PeasoupSearch
 
-    initialize()
+    # topology first: jax.distributed.initialize() must run before
+    # the search constructor touches the backend (device discovery)
+    nproc, rank, gather = _comm_topology(comm)
     search = PeasoupSearch(config)
-    nproc = jax.process_count()
     if nproc == 1:
         return search.run(fil)
 
     plan = search.build_dm_plan(fil)
-    lo, hi = dm_slice_for_process(plan.ndm, nproc, jax.process_index())
+    lo, hi = dm_slice_for_process(plan.ndm, nproc, rank)
     log.info(
         "multi-host search: process %d/%d owns DM trials [%d, %d) of %d",
-        jax.process_index(), nproc, lo, hi, plan.ndm,
+        rank, nproc, lo, hi, plan.ndm,
     )
     # tag this host's telemetry so its manifest shard self-identifies
     # (tools/report.py --merge keys hosts on process_index/hostname)
     tel = current_telemetry()
     tel.set_context(
-        process_index=int(jax.process_index()),
+        process_index=int(rank),
         process_count=int(nproc),
         hostname=socket.gethostname(),
         dm_slice=[int(lo), int(hi)],
     )
     tel.event(
         "multihost_slice", processes=nproc,
-        process=jax.process_index(), dm_lo=lo, dm_hi=hi,
+        process=rank, dm_lo=lo, dm_hi=hi,
         ndm=int(plan.ndm),
     )
     part = search.run(fil, dm_slice=(lo, hi), finalize=False)
 
-    blobs = _allgather_pickled(
+    blobs = gather(
         pickle.dumps((part.cands, part.n_accel_trials)),
         context="search:candidates",
     )
@@ -259,7 +404,7 @@ def run_search(fil, config):
 
     def fold_exchange(outcomes: list[dict]) -> list[dict]:
         out = []
-        blobs = _allgather_pickled(
+        blobs = gather(
             pickle.dumps(outcomes), context="search:folds"
         )
         for piece in _unpickle_all(blobs, context="search:folds"):
@@ -269,7 +414,7 @@ def run_search(fil, config):
     return search.finalize(fil, merged, fold_exchange=fold_exchange)
 
 
-def run_single_pulse_search(fil, config):
+def run_single_pulse_search(fil, config, comm: "GangComm | None" = None):
     """Multi-host `spsearch`: DM-trial data parallelism across
     processes, mirroring :func:`run_search`. Each process dedisperses +
     boxcar-searches its contiguous slice of the global DM list on its
@@ -278,7 +423,8 @@ def run_single_pulse_search(fil, config):
     friends-of-friends clustering — so a pulse whose DM footprint
     spans a slice boundary still clusters as ONE candidate, and the
     final list is identical (and deterministic) on every process; the
-    CLI's rank 0 writes it.
+    CLI's rank 0 writes it. With ``comm`` (a gang-scheduled campaign
+    job) the same driver runs over the file-backed exchange.
 
     Single-process: exactly SinglePulseSearch(config).run(fil).
     """
@@ -289,28 +435,29 @@ def run_single_pulse_search(fil, config):
         SinglePulseSearch,
     )
 
-    initialize()
+    # topology first: jax.distributed.initialize() must run before
+    # the search constructor touches the backend (device discovery)
+    nproc, rank, gather = _comm_topology(comm)
     search = SinglePulseSearch(config)
-    nproc = jax.process_count()
     if nproc == 1:
         return search.run(fil)
 
     plan = search.build_dm_plan(fil)
-    lo, hi = dm_slice_for_process(plan.ndm, nproc, jax.process_index())
+    lo, hi = dm_slice_for_process(plan.ndm, nproc, rank)
     log.info(
         "multi-host spsearch: process %d/%d owns DM trials [%d, %d) "
-        "of %d", jax.process_index(), nproc, lo, hi, plan.ndm,
+        "of %d", rank, nproc, lo, hi, plan.ndm,
     )
     tel = current_telemetry()
     tel.set_context(
-        process_index=int(jax.process_index()),
+        process_index=int(rank),
         process_count=int(nproc),
         hostname=socket.gethostname(),
         dm_slice=[int(lo), int(hi)],
     )
     tel.event(
         "multihost_slice", processes=nproc,
-        process=jax.process_index(), dm_lo=lo, dm_hi=hi,
+        process=rank, dm_lo=lo, dm_hi=hi,
         ndm=int(plan.ndm),
     )
     part = search.run(fil, dm_slice=(lo, hi), finalize=False)
@@ -320,7 +467,7 @@ def run_single_pulse_search(fil, config):
     # deterministic
     import numpy as np
 
-    blobs = _allgather_pickled(
+    blobs = gather(
         pickle.dumps((part.events, part.n_overflowed)),
         context="spsearch:events",
     )

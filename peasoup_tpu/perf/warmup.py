@@ -309,20 +309,15 @@ def shape_ctx_for_bucket(bucket, pipeline: str, overrides: dict):
                 max(1, min(256, (search.TOTAL_HBM // 4) // max(1, per_trial)))
             )
         if cfg.use_pallas:
-            # same preference order as the driver: fused chain tail
-            # first, plain boxcar kernel second, jnp twin last
+            # THE driver's kernel-selection ladder (fused chain at the
+            # full span, retiled fused variants, boxcar kernel, jnp
+            # twin) so warmup compiles exactly what the job dispatches
             try:
-                from ..ops.pallas import (
-                    probe_pallas_boxcar,
-                    probe_pallas_spchain,
-                )
+                from ..pipeline.single_pulse import select_sp_kernels
 
-                if span % cfg.decimate == 0 and probe_pallas_spchain(
-                    len(widths), span, cfg.decimate
-                ):
-                    sp_fused_span = span
-                elif probe_pallas_boxcar(len(widths), span):
-                    pallas_span = span
+                pallas_span, sp_fused_span, _ = select_sp_kernels(
+                    widths, span, tpad, cfg.decimate, cfg.use_pallas
+                )
             except Exception:
                 pallas_span = sp_fused_span = 0
     elif pipeline == "search":
@@ -361,6 +356,17 @@ def shape_ctx_for_bucket(bucket, pipeline: str, overrides: dict):
         else:
             cells = max(8, int(searcher.MEM_BUDGET / (size_spec_b * 16)))
             dm_block = max(1, min(128, cells // max(1, accel_pad)))
+    # survey-fold geometry: the sift layer (peasoup_tpu/sift/fold.py)
+    # later batch-folds this bucket's candidates over the SAME
+    # dedispersed trial length, so the fold bucket is derivable right
+    # here — warm_bucket pre-compiles the survey-fold program too and
+    # the first sift pass over a warmed campaign compiles nothing
+    from ..pipeline.folder import fold_geometry
+
+    fold_nints = int(overrides.get("fold_nints", 16))
+    fold_size = int(fold_geometry(plan.out_nsamps, float(tsamp))[0])
+    if fold_size < fold_nints:
+        fold_size = 0  # too short to fold: the hook declines
     return ShapeCtx(
         nsamps=int(nsamps),
         nchans=int(nchans),
@@ -384,6 +390,12 @@ def shape_ctx_for_bucket(bucket, pipeline: str, overrides: dict):
         accel_pad=int(accel_pad),
         max_peaks=int(max_peaks),
         select_smax=int(select_smax),
+        fold_batch=(
+            int(overrides.get("fold_batch", 64)) if fold_size else 0
+        ),
+        fold_nsamps=fold_size,
+        fold_nbins=int(overrides.get("fold_nbins", 64)),
+        fold_nints=fold_nints,
     )
 
 

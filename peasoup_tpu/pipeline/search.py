@@ -1496,6 +1496,14 @@ class PeasoupSearch:
                         )
                 if ckpt is not None:
                     ckpt.save(per_dm_results)
+                # revoke seam: a preempt/retire observed by the lease
+                # renewer stops here, right after the checkpoint save,
+                # so the resumed run restores exactly this state and
+                # the final candidates stay bitwise-equal to an
+                # uninterrupted sweep
+                from ..resilience import check_revoke
+
+                check_revoke("search.wave")
             n_done += len(wave)
             # live progress: the heartbeat derives rate/ETA from this
             # counter, and the stall watchdog treats its advance (or a

@@ -223,6 +223,57 @@ def candidates_from_clusters(
     return out
 
 
+def select_sp_kernels(
+    widths: tuple[int, ...],
+    span: int,
+    tpad: int,
+    decimate: int,
+    use_pallas: bool,
+) -> tuple[int, int, str | None]:
+    """Resolve the single-pulse device-kernel route: ``(pallas_span,
+    fused_span, fallback_rung)``, preferring the fused sweep+dec-fold
+    chain (ops/pallas/spchain.py) at the full tile span, then — when
+    the toolchain probe rejects its (span/dec, dec) retile — RETILED
+    fused variants at successively halved spans (the reshape that
+    Mosaic refuses at one tile geometry is often fine at a smaller
+    one; dec-fold semantics are span-independent, so the bitwise
+    oracle still gates each candidate), then the plain boxcar kernel,
+    then the jnp twin. All routes are bitwise-identical by the probe
+    contract; the rung is a *performance* degradation only.
+
+    ``fallback_rung`` names the resilience degradation rung taken
+    (None when the preferred kernel probed clean — or when the backend
+    has no Pallas support at all, where the twin is the design point,
+    not a degradation)."""
+    if not use_pallas or span <= 0:
+        return 0, 0, None
+    from ..ops.pallas import (
+        backend_supports_pallas,
+        probe_pallas_boxcar,
+        probe_pallas_spchain,
+    )
+    from ..ops.singlepulse import _QUANT
+
+    if span % decimate == 0 and probe_pallas_spchain(
+        len(widths), span, decimate
+    ):
+        return 0, span, None
+    expected = backend_supports_pallas()
+    if expected and decimate > 0 and span % decimate == 0:
+        s = span // 2
+        while s >= max(decimate, _QUANT) and s % _QUANT == 0:
+            if (
+                s % decimate == 0
+                and tpad % s == 0
+                and probe_pallas_spchain(len(widths), s, decimate)
+            ):
+                return 0, s, "spchain_retile"
+            s //= 2
+    if probe_pallas_boxcar(len(widths), span):
+        return span, 0, "boxcar_kernel" if expected else None
+    return 0, 0, "jnp_twin" if expected else None
+
+
 def make_checkpoint_key(
     cfg: SinglePulseConfig, fil, global_ndm: int, widths: tuple[int, ...]
 ) -> str:
@@ -494,24 +545,30 @@ class SinglePulseSearch:
         tel.set_stage("searching")
         nsamps = dm_plan.out_nsamps
         tpad, span = plan_pad(nsamps)
-        pallas_span = 0
-        fused_span = 0
-        if cfg.use_pallas:
-            from ..ops.pallas import (
-                probe_pallas_boxcar,
-                probe_pallas_spchain,
-            )
+        # prefer the fused sweep+dec-fold mega-kernel (the best planes
+        # never round-trip HBM at full resolution); when its retile
+        # probe rejects the full span, try retiled spans, then the
+        # plain boxcar kernel, then the jnp twin — all bitwise
+        # identical, so a fallback rung is a logged performance
+        # degradation, never a correctness event
+        pallas_span, fused_span, rung = select_sp_kernels(
+            widths, span, tpad, cfg.decimate, cfg.use_pallas
+        )
+        if rung is not None:
+            from ..resilience import DegradationLadder
 
-            # prefer the fused sweep+dec-fold mega-kernel (the best
-            # planes never round-trip HBM at full resolution); fall
-            # back to the plain boxcar kernel, then the jnp twin —
-            # all three bitwise identical
-            if span % cfg.decimate == 0 and probe_pallas_spchain(
-                len(widths), span, cfg.decimate
-            ):
-                fused_span = span
-            elif probe_pallas_boxcar(len(widths), span):
-                pallas_span = span
+            DegradationLadder(
+                "spsearch.kernel",
+                ("spchain_retile", "boxcar_kernel", "jnp_twin"),
+            ).step(
+                rung, span=int(span), fused_span=int(fused_span),
+                pallas_span=int(pallas_span), decimate=int(cfg.decimate),
+            )
+            log.warning(
+                "fused spchain kernel rejected at span=%d; degraded to "
+                "rung %s (fused_span=%d, pallas_span=%d)",
+                span, rung, fused_span, pallas_span,
+            )
         self._pallas_span = pallas_span
         self._fused_span = fused_span
         sharding = None
@@ -777,6 +834,13 @@ class SinglePulseSearch:
                 tel.set_progress(ci + 1, len(chunks), unit="chunks")
                 if progress:
                     progress.update((ci + 1) / len(chunks))
+                # revoke seam: a preempt/retire observed by the lease
+                # renewer stops here — the checkpoint just saved is the
+                # state the resumed run restores, so candidates stay
+                # bitwise-equal to an uninterrupted sweep
+                from ..resilience import check_revoke
+
+                check_revoke("spsearch.wave")
         finally:
             if progress:
                 progress.stop()

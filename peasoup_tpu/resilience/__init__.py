@@ -41,9 +41,21 @@ from .policy import (
     load_or_recover,
     quarantine_artifact,
 )
+from .revoke import (
+    RevokeToken,
+    SearchPreempted,
+    activate_token,
+    check_revoke,
+    current_token,
+)
 from .stats import STATS
 
 __all__ = [
+    "RevokeToken",
+    "SearchPreempted",
+    "activate_token",
+    "check_revoke",
+    "current_token",
     "CORRUPT",
     "FATAL",
     "RESOURCE_EXHAUSTED",

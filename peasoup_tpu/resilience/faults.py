@@ -16,7 +16,7 @@ Grammar (``PEASOUP_FAULTS`` env var or ``--faults``)::
     entry   := "seed=" INT | site (":" key "=" value)*
     site    := fil.read | queue.claim | db.ingest | checkpoint.write
              | device.oom | worker.kill | cache.corrupt | clock.skew
-             | multihost.barrier | multihost.merge
+             | multihost.barrier | multihost.merge | preempt.revoke
     key     := p     (per-invocation probability, seeded -> replayable)
              | n     (max injections; bare site defaults to n=1,at=1)
              | at    (an integer -> fire on that 1-based invocation of
@@ -71,6 +71,7 @@ SITES = (
     "clock.skew",
     "multihost.barrier",
     "multihost.merge",
+    "preempt.revoke",
 )
 
 
@@ -108,6 +109,14 @@ def _make_exception(site: str, tag: str) -> BaseException:
     if site == "multihost.merge":
         return TransientIOError(
             _errno.EIO, f"injected multihost merge failure {tag}"
+        )
+    if site == "preempt.revoke":
+        # the revoke-delivery seam: an injected failure makes the
+        # victim's lease-renewer MISS the preempt request this beat
+        # (an unresponsive victim), drilling the grace-deadline
+        # escalation to the reap path (campaign/queue.py reap_stale)
+        return TransientIOError(
+            _errno.EIO, f"injected revoke delivery failure {tag}"
         )
     if site == "cache.corrupt":
         # direct fire (the warmup seam): a garbled persistent-cache

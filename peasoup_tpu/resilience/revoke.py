@@ -1,0 +1,117 @@
+"""Cooperative revoke tokens: checkpointed preemption and retirement.
+
+A running search cannot be interrupted at an arbitrary instruction
+without losing (or worse, duplicating) work — but it CAN stop cleanly
+at a DM-block boundary, where the per-trial checkpoint
+(pipeline/checkpoint.py) has just been persisted. This module is the
+handshake between whoever wants the claim back (a higher-priority job
+revoking a lower-priority one, or the autoscale controller retiring a
+worker) and the driver's wave loop:
+
+- the requester writes a request file beside the claim / registry
+  entry (campaign/queue.py ``request_preempt``, campaign/registry.py
+  ``request_retire``);
+- the victim's ``_LeaseRenewer`` beat observes it and flips the
+  :class:`RevokeToken` the runner activated for the job;
+- the driver calls :func:`check_revoke` after each checkpoint save —
+  the first check after the flip raises :class:`SearchPreempted`, with
+  the checkpoint consistent by construction;
+- the runner catches :class:`SearchPreempted` and releases the claim
+  with ZERO attempts consumed (the revoke is scheduling, not failure);
+  the job later resumes from the checkpoint with candidates
+  bitwise-equal to an uninterrupted run.
+
+The token rides a contextvar, so only the thread actually running the
+victim job sees the revoke — warmup/tuning threads and unrelated
+pipeline invocations in the same process are untouched, and the check
+is a no-op (one contextvar read) when no token is active.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+import time
+
+
+class SearchPreempted(Exception):
+    """Control-flow: the driver stopped at a checkpoint boundary in
+    answer to a revoke. The checkpoint on disk is consistent; the
+    runner must release (not fail) the claim."""
+
+    def __init__(self, kind: str, reason: str = "") -> None:
+        super().__init__(f"search {kind}ed: {reason}" if reason else kind)
+        self.kind = kind
+        self.reason = reason
+
+
+class RevokeToken:
+    """One job's revoke state, set by the lease-renewer thread and read
+    by the driver thread at checkpoint boundaries."""
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self.kind: str | None = None  # "preempt" | "retire"
+        self.reason: str = ""
+        self.requested_unix: float | None = None
+        self.observed_unix: float | None = None
+
+    def revoke(
+        self,
+        kind: str = "preempt",
+        reason: str = "",
+        requested_unix: float | None = None,
+    ) -> None:
+        """Flip the token (idempotent — the first revoke wins)."""
+        with self._lock:
+            if self._event.is_set():
+                return
+            self.kind = kind
+            self.reason = reason
+            self.requested_unix = requested_unix
+            self.observed_unix = time.time()
+            self._event.set()
+
+    def is_set(self) -> bool:
+        return self._event.is_set()
+
+
+_TOKEN: contextvars.ContextVar[RevokeToken | None] = contextvars.ContextVar(
+    "peasoup_revoke_token", default=None
+)
+
+
+def current_token() -> RevokeToken | None:
+    return _TOKEN.get()
+
+
+@contextlib.contextmanager
+def activate_token(token: RevokeToken):
+    """Install ``token`` for the calling thread's context (the runner
+    wraps one job's execution in this)."""
+    handle = _TOKEN.set(token)
+    try:
+        yield token
+    finally:
+        _TOKEN.reset(handle)
+
+
+def check_revoke(site: str = "") -> None:
+    """The driver-side seam: raise :class:`SearchPreempted` when the
+    active token (if any) has been revoked. Call ONLY where the
+    persisted state is consistent — immediately after a checkpoint
+    save is the contract."""
+    token = _TOKEN.get()
+    if token is None or not token.is_set():
+        return
+    from ..obs.telemetry import current
+
+    current().event(
+        "revoke_checkpoint_stop",
+        revoke_kind=token.kind,
+        reason=token.reason,
+        site=site,
+    )
+    raise SearchPreempted(token.kind or "preempt", token.reason)

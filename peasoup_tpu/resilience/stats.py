@@ -26,6 +26,7 @@ _TABLES = (
     "corrupt_artifacts",
     "faults_injected",
     "thread_crashes",
+    "preemptions",
 )
 
 
@@ -68,6 +69,13 @@ class ResilienceStats:
 
     def thread_crashed(self, name: str) -> None:
         self._incr("thread_crashes", name)
+
+    def preemption(self, kind: str) -> None:
+        """A claim revoked (``kind``: "requested" / "released" /
+        "reaped" / "retire") — the scheduling half of elasticity, kept
+        in its own table so preemptive scheduling never reads as
+        failure recovery."""
+        self._incr("preemptions", kind)
 
     # --- reading ------------------------------------------------------
     def snapshot(self) -> dict:

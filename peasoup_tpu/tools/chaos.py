@@ -53,7 +53,7 @@ from ..obs import get_logger
 log = get_logger("tools.chaos")
 
 REPORT_SCHEMA = "peasoup_tpu.chaos_report"
-REPORT_VERSION = 2  # v2: the fleet-mode section (real-process soak)
+REPORT_VERSION = 3  # v3: preempt/gang/autoscale in the fleet schedule
 
 DEFAULT_CAMPAIGN_FAULTS = (
     "fil.read:p=0.25:n=4,db.ingest:at=1,worker.kill:at=obs0"
@@ -66,7 +66,8 @@ DEFAULT_STREAM_FAULTS = "fil.read:at=replay:n=2"
 # tool accepts for the bitwise-equality invariant
 TRANSIENT_SITES = frozenset(
     {"fil.read", "queue.claim", "db.ingest", "checkpoint.write",
-     "worker.kill", "device.oom", "cache.corrupt", "clock.skew"}
+     "worker.kill", "device.oom", "cache.corrupt", "clock.skew",
+     "multihost.barrier", "multihost.merge", "preempt.revoke"}
 )
 
 # fault site -> stats tables where its recovery must leave a mark
@@ -77,10 +78,15 @@ RECOVERY_TABLES = {
     "checkpoint.write": ("retries", "recoveries", "giveups"),
     "device.oom": ("degradations",),
     "cache.corrupt": ("corrupt_artifacts",),
+    "multihost.barrier": ("retries", "recoveries", "giveups"),
+    "multihost.merge": ("retries", "recoveries", "giveups"),
     # worker.kill recovery is the queue reaper: checked against job
     # attempt counts, not a stats table
     "worker.kill": (),
     "clock.skew": (),
+    # preempt.revoke suppresses revoke delivery; its recovery is the
+    # grace-deadline reap, checked against attempt counts
+    "preempt.revoke": (),
 }
 
 
@@ -145,10 +151,14 @@ def _setup_campaign(
     config: dict,
     lease_s: float,
     max_attempts: int,
+    gang_inputs: dict | None = None,
 ):
     """Create the campaign directory + config and enqueue the
     observations; returns the JobQueue (shared by the in-process and
-    fleet soaks, so both judge identical campaigns)."""
+    fleet soaks, so both judge identical campaigns). ``gang_inputs``
+    maps input paths to an ``nprocs`` gang width (fleet soak only —
+    the fault-free reference runs everything single-process, which is
+    exactly what makes gang candidates' bitwise equality a proof)."""
     from ..campaign.queue import Job, JobQueue, job_id_for
     from ..campaign.runner import (
         CampaignConfig,
@@ -166,17 +176,22 @@ def _setup_campaign(
         heartbeat_interval=0.2,
         warmup=False,  # soak speed: compile once via the jit caches
         tune=False,
+        preempt_grace_s=max(10.0, 10 * lease_s),
+        gang_assemble_s=max(10.0, 10 * lease_s),
+        gang_timeout_s=300.0,
     )
     save_campaign_config(root, cfg)
     queue = JobQueue(
         root, lease_s=lease_s, max_attempts=max_attempts,
         backoff_base_s=0.05,
     )
+    gang_inputs = gang_inputs or {}
     for p in inputs:
         queue.add_job(
             Job(
                 job_id=job_id_for(p), input=p, pipeline="spsearch",
                 bucket=bucket_for_input(p),
+                nprocs=int(gang_inputs.get(p, 1)),
             )
         )
     return queue
@@ -242,13 +257,18 @@ def _job_candidate_bytes(root: str, job_id: str) -> bytes | None:
 
 def _tree_residue(root: str) -> list[str]:
     """Leaked atomic-write temps / reap tombstones / claim files /
+    preempt requests / retire markers / gang exchange directories /
     fleet-registry entries (a drained campaign must leave an empty
-    registry: clean leavers deregister, dead workers get reaped)."""
+    registry: clean leavers deregister, dead workers get reaped, and
+    every revoke/gang artifact is consumed by its protocol)."""
     bad = []
     for pat in ("**/*.tmp", "**/*.reap.*", "**/*.ckpt.tmp"):
         bad.extend(glob.glob(os.path.join(root, pat), recursive=True))
     bad.extend(glob.glob(os.path.join(root, "queue", "claims", "*.json")))
+    bad.extend(glob.glob(os.path.join(root, "queue", "claims", "*.preempt")))
     bad.extend(glob.glob(os.path.join(root, "queue", "workers", "*.json")))
+    bad.extend(glob.glob(os.path.join(root, "queue", "workers", "*.retire")))
+    bad.extend(glob.glob(os.path.join(root, "jobs", "*", "gang-*")))
     return sorted(bad)
 
 
@@ -453,6 +473,7 @@ def _fleet_roles(
     late_joiners: int = 1,
     skew_s: float = 10.0,
     faults_spec: str = DEFAULT_FLEET_WORKER_FAULTS,
+    gangs: int = 0,
 ) -> list[dict]:
     """Deterministic (seeded) role assignment for the fleet: which
     workers get SIGKILLed mid-job, which leave voluntarily after one
@@ -460,7 +481,14 @@ def _fleet_roles(
     (flaky reads on one drainer; a positive clock skew on a leaver —
     bounded premature reaping, absorbed by the attempt budget). At
     least one plain drainer always remains so the campaign can drain
-    whatever the churn does."""
+    whatever the churn does.
+
+    With ``gangs`` > 0 the flaky drainer and the (first) late joiner
+    share the process group ``pod0`` — the gang job can only run once
+    the late joiner arrives, so gang assembly-over-time is part of the
+    drill, and neither group member is ever a kill victim or a leaver
+    (a gang that can never assemble would deadlock the job, which the
+    assembly timeout turns into a clean release loop instead)."""
     import random
 
     if n_workers < kills + late_joiners + 1:
@@ -481,6 +509,10 @@ def _fleet_roles(
     leaver_set = set(pool[1 : 1 + leavers])
     faulty = pool[0] if pool else rest[0]
     skewed = next(iter(leaver_set), None)
+    gang_members = (
+        {faulty, min(late)} if gangs and late else
+        set(pool[:2]) if gangs else set()
+    )
     roles = []
     for i in range(n_workers):
         env_faults = []
@@ -495,6 +527,7 @@ def _fleet_roles(
                 "kill": i in victims,
                 "max_jobs": 1 if i in leaver_set else None,
                 "late": i in late,
+                "group": "pod0" if i in gang_members else "",
                 "faults": (
                     ",".join(env_faults + [f"seed={seed}"])
                     if env_faults else ""
@@ -519,23 +552,36 @@ def run_fleet_soak(
     skew_s: float = 10.0,
     timeout_s: float = 900.0,
     config: dict | None = None,
+    gangs: int = 1,
+    preempts: int = 1,
+    autoscale: bool = True,
 ) -> dict:
     """THE fleet-scale soak: N real ``peasoup-campaign run``
     subprocesses drain one shared campaign directory while the parent
     applies a seeded schedule of real SIGKILLs (delivered the moment a
     victim holds a claim), worker churn (a voluntary single-job
-    leaver, a late joiner), a clock-skewed reaper, and per-worker
-    ``PEASOUP_FAULTS``. Judged by the same invariants as the
-    in-process soak — exactly-once, candidates bitwise-equal to a
-    fault-free reference, zero leaked claims/tombstones/registry
-    entries — plus per-site recovery attribution assembled from the
+    leaver, a late joiner), a clock-skewed reaper, per-worker
+    ``PEASOUP_FAULTS`` — and, new in v3, the scheduling drills:
+    ``gangs`` gang-scheduled jobs (nprocs=2 across the ``pod0``
+    process group, which only assembles once the late joiner arrives),
+    ``preempts`` priority preemptions (an urgent observation enqueued
+    mid-soak plus an explicit revoke on a running claim — the victim
+    must checkpoint, release with zero attempts, and the job must
+    resume bitwise-equal), and — with ``autoscale`` — a REAL
+    AutoscaleController spawning at least one extra worker off the
+    backlog. Judged by the same invariants as the in-process soak —
+    exactly-once, candidates bitwise-equal to a fault-free reference,
+    zero leaked claims/preempt-files/retire-markers/gang-dirs/registry
+    entries, gang jobs never partially claimed — plus per-site
+    recovery and preemption-latency attribution assembled from the
     campaign rollup and the workers' own logs."""
     import signal
     import subprocess
     import sys
 
-    from ..campaign.queue import JobQueue, job_id_for
+    from ..campaign.queue import Job, JobQueue, job_id_for
     from ..campaign.rollup import load_campaign_status, write_status
+    from ..campaign.runner import bucket_for_input
     from ..obs.schema import validate_manifest
     from ..resilience import STATS, faults
     from ..resilience.faults import parse_faults
@@ -554,18 +600,34 @@ def run_fleet_soak(
 
     config = config or {"dm_end": 20.0, "min_snr": 7.0, "n_widths": 6}
     data_dir = os.path.join(workdir, "data")
-    inputs = make_observations(data_dir, n_obs=n_obs, nsamps=nsamps)
+    # one extra observation per scheduled preemption: the URGENT job,
+    # enqueued mid-soak at priority 5 (the reference processes it
+    # upfront — priority changes scheduling, never results)
+    n_urgent = max(0, int(preempts))
+    inputs = make_observations(
+        data_dir, n_obs=n_obs + n_urgent, nsamps=nsamps
+    )
+    base_inputs, urgent_inputs = inputs[:n_obs], inputs[n_obs:]
     job_ids = [job_id_for(p) for p in inputs]
+    n_total = len(inputs)
+    # the LAST base observation runs as the gang job (any would do;
+    # the last keeps the early claims free for the kill schedule)
+    gang_inputs = (
+        {base_inputs[-1]: 2} if gangs and n_workers >= 2 else {}
+    )
+    gang_job_ids = {job_id_for(p) for p in gang_inputs}
 
     # --- fault-free reference (in-process; same code path — the
     # workers enter through runner.run_worker either way) -------------
     faults.configure(None)
     STATS.reset()
     ref_root = os.path.join(workdir, "fleet_ref")
-    log.info("fleet soak: fault-free reference campaign (%d obs)", n_obs)
+    log.info(
+        "fleet soak: fault-free reference campaign (%d obs)", n_total
+    )
     ref = _run_campaign(ref_root, inputs, config, lease_s, max_attempts)
     ref_cands = {j: _job_candidate_bytes(ref_root, j) for j in job_ids}
-    if ref["tally"]["done"] != n_obs or any(
+    if ref["tally"]["done"] != n_total or any(
         v is None for v in ref_cands.values()
     ):
         raise RuntimeError(
@@ -574,10 +636,14 @@ def run_fleet_soak(
 
     # --- the fleet ----------------------------------------------------
     root = os.path.join(workdir, "fleet")
-    queue = _setup_campaign(root, inputs, config, lease_s, max_attempts)
+    queue = _setup_campaign(
+        root, base_inputs, config, lease_s, max_attempts,
+        gang_inputs=gang_inputs,
+    )
     roles = _fleet_roles(
         seed, n_workers, kills=kills, leavers=leavers,
         late_joiners=late_joiners, skew_s=skew_s, faults_spec=spec,
+        gangs=gangs,
     )
     logs_dir = os.path.join(workdir, "fleet_logs")
     os.makedirs(logs_dir, exist_ok=True)
@@ -610,6 +676,8 @@ def run_fleet_soak(
         ]
         if role["max_jobs"]:
             cmd += ["--max-jobs", str(role["max_jobs"])]
+        if role.get("group"):
+            cmd += ["--group", role["group"]]
         logf = open(
             os.path.join(logs_dir, role["worker_id"] + ".log"), "wb"
         )
@@ -629,14 +697,49 @@ def run_fleet_soak(
             f" [faults {role['faults']}]" if role["faults"] else "",
         )
 
+    # the real autoscale controller, supervising the same campaign the
+    # fleet drains: its spawns go through the soak's own spawn() so the
+    # extra worker is settled, logged and attributed like any other
+    controller = None
+    if autoscale:
+        from ..campaign.autoscale import (
+            AutoscaleController,
+            AutoscalePolicy,
+        )
+
+        def _scale_spawn(wid: str):
+            role = {
+                "worker_id": wid, "kill": False, "max_jobs": None,
+                "late": False, "group": "", "faults": "",
+            }
+            spawn(role)
+            return procs[wid]["proc"]
+
+        controller = AutoscaleController(
+            root,
+            AutoscalePolicy(
+                min_workers=1,
+                max_workers=n_workers + 1,
+                cooldown_s=max(2.0, 2 * lease_s),
+                backlog_per_worker=1.0,
+            ),
+            spawn=_scale_spawn,
+            controller_id="scale",
+        )
+
     t0 = time.perf_counter()
     for role in roles:
         if not role["late"]:
             spawn(role)
     late_pending = [r for r in roles if r["late"]]
     pending_victims = {r["worker_id"] for r in roles if r["kill"]}
+    gang_workers = {r["worker_id"] for r in roles if r.get("group")}
     kills_done: list[dict] = []
     joins: list[str] = []
+    preempts_requested: list[dict] = []
+    preempt_targets_tried: set[str] = set()
+    urgent_enqueued = False
+    last_scale_step = 0.0
     claims_dir = os.path.join(root, "queue", "claims")
     done_dir = os.path.join(root, "queue", "done")
     timed_out = False
@@ -644,6 +747,85 @@ def run_fleet_soak(
         if time.perf_counter() - t0 > timeout_s:
             timed_out = True
             break
+        # preemption drill: once any claim is live, enqueue the urgent
+        # observation at priority 5 AND revoke one running claim
+        # explicitly (retrying with a new target if a fast job slipped
+        # to done before its renewer observed) — never a gang claim,
+        # never the kill victim's (those drills must stay orthogonal)
+        if n_urgent and os.path.isdir(claims_dir):
+            if not urgent_enqueued and any(
+                n.endswith(".json") for n in os.listdir(claims_dir)
+            ):
+                # the fleet is busy: the urgent work arrives NOW, at
+                # priority 5 — exactly the displacement scenario
+                for up in urgent_inputs:
+                    queue.add_job(
+                        Job(
+                            job_id=job_id_for(up), input=up,
+                            pipeline="spsearch",
+                            bucket=bucket_for_input(up),
+                            priority=5,
+                        )
+                    )
+                urgent_enqueued = True
+                log.info(
+                    "fleet: enqueued %d urgent obs at priority 5",
+                    len(urgent_inputs),
+                )
+            confirmed = sum(
+                1 for jid in preempt_targets_tried
+                if (j := queue.get_job(jid)) is not None and j.preemptions
+            )
+            outstanding = any(
+                queue.preempt_request(jid) is not None
+                for jid in preempt_targets_tried
+            )
+            if confirmed < n_urgent and not outstanding:
+                for name in sorted(os.listdir(claims_dir)):
+                    if not name.endswith(".json"):
+                        continue
+                    try:
+                        with open(os.path.join(claims_dir, name)) as f:
+                            doc = json.load(f)
+                    except (OSError, json.JSONDecodeError):
+                        continue
+                    jid = doc.get("job_id")
+                    if (
+                        not jid
+                        or jid in preempt_targets_tried
+                        or jid in gang_job_ids
+                        or doc.get("gang")
+                        or doc.get("worker_id") in pending_victims
+                    ):
+                        continue
+                    # generous grace: the target is usually the FIRST
+                    # claim (coldest compile), and the victim can only
+                    # answer at a chunk boundary — the grace-deadline
+                    # escalation is drilled separately in unit tests
+                    if queue.request_preempt(
+                        jid, requester="chaos-soak", grace_s=300.0,
+                    ):
+                        preempt_targets_tried.add(jid)
+                        preempts_requested.append(
+                            {
+                                "job_id": jid,
+                                "victim": doc.get("worker_id"),
+                            }
+                        )
+                        log.info(
+                            "fleet: preempt requested on %s (held by "
+                            "%s)", jid, doc.get("worker_id"),
+                        )
+                        break
+        # autoscale control loop, throttled to ~1 Hz
+        if controller is not None and (
+            time.perf_counter() - last_scale_step > 1.0
+        ):
+            last_scale_step = time.perf_counter()
+            try:
+                controller.step()
+            except Exception:
+                log.warning("autoscale step failed", exc_info=True)
         # churn: the late joiners arrive once the fleet has made first
         # progress (a done record) — they must claim from the warm
         # bucket tier, not reopen cold ones
@@ -703,11 +885,19 @@ def run_fleet_soak(
             ent["proc"].wait(timeout=10)
         ent["logf"].close()
     wall_s = round(time.perf_counter() - t0, 3)
+    from ..campaign.registry import WorkerRegistry
+
+    # sweep what the settled processes can no longer sweep themselves:
+    # expired corpses and any retire marker that landed after its
+    # worker had already exited (deregistration bugs still surface —
+    # a LIVE leftover entry is not reaped here and fails the
+    # zero-residue invariant below)
+    WorkerRegistry(root, lease_s=lease_s).reap()
     write_status(root, queue)  # final rollup over the settled tree
 
     # --- invariants ---------------------------------------------------
     counts = queue.counts()
-    violations = _exactly_once_violations(root, counts, job_ids, n_obs)
+    violations = _exactly_once_violations(root, counts, job_ids, n_total)
     if timed_out:
         violations.append(
             f"fleet did not drain within {timeout_s:.0f}s"
@@ -785,8 +975,8 @@ def run_fleet_soak(
                 f"fault {site} fired {n}x across the fleet but the "
                 "rollup shows no recovery marks"
             )
+    done = queue.done_records()
     if kills_done:
-        done = queue.done_records()
         reaped = [d for d in done if int(d.get("attempts", 1)) > 1]
         recovery["worker.kill"] = {
             "sigkills": len(kills_done),
@@ -798,8 +988,109 @@ def run_fleet_soak(
                 "reaped retry (attempts > 1)"
             )
 
+    # --- preemption attribution ---------------------------------------
+    preempted_done = [d for d in done if d.get("preemptions")]
+    preempt_section = {
+        "requested": preempts_requested,
+        "jobs_resumed": len(preempted_done),
+        "latency_s": sorted(
+            float(x)
+            for d in preempted_done
+            for x in (d.get("preempt_latency_s") or [])
+        ),
+    }
+    if n_urgent:
+        if not preempted_done:
+            violations.append(
+                "preemption scheduled but no done record carries a "
+                "preemption tally (revoke never landed or was lost)"
+            )
+        elif not preempt_section["latency_s"]:
+            violations.append(
+                "preempted job resumed without preempt_latency_s "
+                "attribution in its done record"
+            )
+        for d in preempted_done:
+            if int(d.get("attempts", 1)) == 1:
+                continue
+            # a revoke must consume ZERO attempts. Attempts > 1 on a
+            # preempted job is allowed only when ANOTHER drill also
+            # hit it: the SIGKILL victim's reaped claim, or the
+            # clock-skewed reaper prematurely reaping a fresh claim
+            # (skew >> lease makes every claim look expired to it) —
+            # both leave a reap signature in the job record's
+            # last_error. The zero-attempt release itself is pinned
+            # deterministically by tests/test_fleet.py.
+            jid = d.get("job_id")
+            job = queue.get_job(jid)
+            reap_attributed = jid in {
+                k.get("job_id") for k in kills_done
+            } or (
+                job is not None
+                and job.last_error is not None
+                and (
+                    "lease expired" in job.last_error
+                    or "grace deadline" in job.last_error
+                )
+            )
+            if not reap_attributed:
+                violations.append(
+                    f"preempted job {jid} consumed {d['attempts']} "
+                    "attempts (revoke must consume zero) with no reap "
+                    "to attribute them to"
+                )
+
+    # --- gang attribution ---------------------------------------------
+    gang_done = [d for d in done if d.get("gang")]
+    gang_section = {
+        "scheduled": sorted(gang_job_ids),
+        "done": len(gang_done),
+        "members": sorted(
+            {m for d in gang_done for m in d["gang"].get("members", [])}
+        ),
+    }
+    if gang_inputs:
+        if len(gang_done) != len(gang_job_ids):
+            violations.append(
+                f"{len(gang_job_ids)} gang job(s) scheduled but "
+                f"{len(gang_done)} completed with gang provenance"
+            )
+        for d in gang_done:
+            g = d["gang"]
+            if len(g.get("members", [])) != int(g.get("nprocs", 0)):
+                violations.append(
+                    f"gang job {d.get('job_id')} completed PARTIALLY "
+                    f"claimed: members {g.get('members')} vs nprocs "
+                    f"{g.get('nprocs')}"
+                )
+
+    # --- autoscale attribution ----------------------------------------
+    scale_section = None
+    if controller is not None:
+        scale_section = {
+            "decisions": controller.decisions,
+            "ups": sum(
+                1 for d in controller.decisions if d["action"] == "up"
+            ),
+            "downs": sum(
+                1 for d in controller.decisions if d["action"] == "down"
+            ),
+        }
+        if not scale_section["ups"]:
+            violations.append(
+                "autoscale controller never scaled up despite the "
+                "backlog (no 'up' decision)"
+            )
+        if "autoscale" not in (rollup or {}) or not (
+            rollup.get("autoscale") or {}
+        ).get("decisions"):
+            violations.append(
+                "rollup lacks the autoscale decision log"
+            )
+
     return {
         "n_obs": n_obs,
+        "n_urgent": n_urgent,
         "n_workers": n_workers,
         "faults": spec,
         "seed": seed,
@@ -813,6 +1104,9 @@ def run_fleet_soak(
         "queue": counts,
         "worker_logs": sorted(e["log"] for e in procs.values()),
         "recovery": recovery,
+        "preemption": preempt_section,
+        "gang": gang_section,
+        "autoscale": scale_section,
         "violations": violations,
     }
 
@@ -998,6 +1292,23 @@ def build_parser() -> argparse.ArgumentParser:
         help="seconds before an undrained fleet is a violation "
         "(default 900)",
     )
+    fleet.add_argument(
+        "--gangs", type=int, default=1,
+        help="gang-scheduled jobs (nprocs=2 across the pod0 process "
+        "group; default 1, 0 disables)",
+    )
+    fleet.add_argument(
+        "--preempts", type=int, default=1,
+        help="priority preemptions: urgent obs enqueued mid-soak + a "
+        "revoke on a running claim, asserted checkpointed/zero-attempt/"
+        "latency-attributed (default 1, 0 disables)",
+    )
+    fleet.add_argument(
+        "--autoscale", action=argparse.BooleanOptionalAction,
+        default=True,
+        help="run a real AutoscaleController over the fleet and assert "
+        "at least one backlog-driven scale-up (default on)",
+    )
     return p
 
 
@@ -1048,6 +1359,9 @@ def main(argv=None) -> int:
                 late_joiners=args.late_joiners,
                 skew_s=args.skew,
                 timeout_s=args.fleet_timeout,
+                gangs=args.gangs,
+                preempts=args.preempts,
+                autoscale=args.autoscale,
             )
             report["fleet"] = sec
             violations += [f"fleet: {v}" for v in sec["violations"]]

@@ -259,6 +259,32 @@ def render_campaign_status(st: dict, stale_after: float = 0.0) -> str:
             if w.get("current_job"):
                 bits.append(f"on {w['current_job']}")
             lines.append("  ".join(bits))
+    pre = st.get("preemptions") or {}
+    if pre.get("jobs") or pre.get("outstanding_requests"):
+        lat = pre.get("latency_s") or {}
+        bits = [
+            f"  preemptions: {pre.get('total', 0)} revoke(s) over "
+            f"{pre.get('jobs', 0)} job(s)"
+        ]
+        if lat.get("mean") is not None:
+            bits.append(
+                f"latency mean {lat['mean']:.3g}s max {lat['max']:.3g}s"
+            )
+        if pre.get("outstanding_requests"):
+            bits.append(f"{pre['outstanding_requests']} in flight")
+        lines.append("  ".join(bits))
+    if st.get("gang_jobs"):
+        lines.append(f"  gang jobs done: {st['gang_jobs']}")
+    scale = st.get("autoscale") or {}
+    if scale.get("decisions"):
+        last = scale["decisions"][-1]
+        ups = sum(1 for d in scale["decisions"] if d.get("action") == "up")
+        downs = len(scale["decisions"]) - ups
+        lines.append(
+            f"  autoscale: {ups} up / {downs} down; last "
+            f"{last.get('action')} {last.get('worker_id')} "
+            f"({last.get('reason')})"
+        )
     if st.get("degraded_jobs"):
         lines.append(
             f"  *** {st['degraded_jobs']} job(s) completed DEGRADED "

@@ -228,6 +228,36 @@ class TestWarmup:
         ].build_for(dry)
         assert kwargs == {"nbits": 2, "nsamps": 4096, "nchans": 8}
 
+    def test_shape_ctx_derives_fold_bucket(self):
+        """ISSUE 13 satellite: the campaign ctx carries the SIFT fold
+        bucket (fold_batch/fold_nsamps/fold_nbins/fold_nints) derived
+        from the same dedispersed trial length the survey folder will
+        bucket on — so warm_bucket pre-compiles the survey-fold
+        program too."""
+        from peasoup_tpu.pipeline.folder import fold_geometry
+
+        by_name = {s.name: s for s in registered_programs()}
+        for pipeline in ("spsearch", "search"):
+            ctx = shape_ctx_for_bucket(BUCKET, pipeline, SP_OVERRIDES)
+            assert ctx.fold_batch == 64
+            assert ctx.fold_nsamps == fold_geometry(
+                ctx.out_nsamps, BUCKET[3]
+            )[0]
+            assert ctx.fold_nbins == 64 and ctx.fold_nints == 16
+            built = by_name[
+                "ops.survey_fold.survey_fold_batch"
+            ].build_for(ctx)
+            assert built is not None
+            _, args, kwargs = built
+            assert args[0].shape == (64, ctx.fold_nsamps)
+            assert kwargs == {"nbins": 64, "nints": 16}
+        # overrides flow through (the sift batch knobs)
+        ctx = shape_ctx_for_bucket(
+            BUCKET, "spsearch",
+            {**SP_OVERRIDES, "fold_batch": 16, "fold_nbins": 32},
+        )
+        assert ctx.fold_batch == 16 and ctx.fold_nbins == 32
+
     def test_warm_bucket_aot(self, fresh_cache):
         """AOT bucket warmup compiles the hook-parameterised programs
         at production shapes without executing anything. The bucket is

@@ -150,6 +150,135 @@ class TestPallasBoxcar:
             )
 
 
+class TestSpchainRetileFallback:
+    """The Mosaic retile fallback ladder (ISSUE 13 satellite): when the
+    toolchain probe rejects the fused spchain kernel's (span/dec, dec)
+    reshape at the full tile span, the driver tries RETILED spans
+    before dropping to the boxcar kernel, and only then the jnp twin —
+    each fallback logged as a resilience degradation rung (and none of
+    it on backends without Pallas at all, where the twin is the design
+    point)."""
+
+    def _patch(self, monkeypatch, supports, spchain_ok, boxcar_ok):
+        import peasoup_tpu.ops.pallas as pallas_mod
+
+        monkeypatch.setattr(
+            pallas_mod, "backend_supports_pallas", lambda: supports
+        )
+        monkeypatch.setattr(
+            pallas_mod, "probe_pallas_spchain",
+            lambda nw, span, dec: spchain_ok(span),
+        )
+        monkeypatch.setattr(
+            pallas_mod, "probe_pallas_boxcar",
+            lambda nw, span: boxcar_ok,
+        )
+
+    def test_full_span_accepted_no_rung(self, monkeypatch):
+        from peasoup_tpu.pipeline.single_pulse import select_sp_kernels
+
+        self._patch(monkeypatch, True, lambda s: True, True)
+        widths = default_widths(6)
+        assert select_sp_kernels(widths, 8192, 16384, 32, True) == (
+            0, 8192, None,
+        )
+
+    def test_retiled_span_fallback(self, monkeypatch):
+        """Full span rejected, half span accepted: the fused kernel
+        still runs — retiled — and the rung names the retile."""
+        from peasoup_tpu.pipeline.single_pulse import select_sp_kernels
+
+        self._patch(
+            monkeypatch, True, lambda s: s <= 4096, True
+        )
+        widths = default_widths(6)
+        assert select_sp_kernels(widths, 8192, 16384, 32, True) == (
+            0, 4096, "spchain_retile",
+        )
+
+    def test_boxcar_fallback_when_no_retile_fits(self, monkeypatch):
+        from peasoup_tpu.pipeline.single_pulse import select_sp_kernels
+
+        self._patch(monkeypatch, True, lambda s: False, True)
+        widths = default_widths(6)
+        assert select_sp_kernels(widths, 8192, 16384, 32, True) == (
+            8192, 0, "boxcar_kernel",
+        )
+
+    def test_jnp_twin_last_rung(self, monkeypatch):
+        from peasoup_tpu.pipeline.single_pulse import select_sp_kernels
+
+        self._patch(monkeypatch, True, lambda s: False, False)
+        widths = default_widths(6)
+        assert select_sp_kernels(widths, 8192, 16384, 32, True) == (
+            0, 0, "jnp_twin",
+        )
+
+    def test_no_rung_on_backends_without_pallas(self, monkeypatch):
+        """CPU (or any backend the probes decline wholesale): the twin
+        is the design point — no degradation is logged."""
+        from peasoup_tpu.pipeline.single_pulse import select_sp_kernels
+
+        self._patch(monkeypatch, False, lambda s: False, False)
+        widths = default_widths(6)
+        assert select_sp_kernels(widths, 8192, 16384, 32, True) == (
+            0, 0, None,
+        )
+        # and with use_pallas off nothing probes at all
+        assert select_sp_kernels(widths, 8192, 16384, 32, False) == (
+            0, 0, None,
+        )
+
+    def test_driver_logs_degradation_event(self, monkeypatch, tmp_path):
+        """End-to-end: a pallas-capable backend whose probes reject
+        everything runs the twin AND flips the resilience degradation
+        table — operators see the fallback, candidates stay correct."""
+        from peasoup_tpu.io.sigproc import read_filterbank
+        from peasoup_tpu.resilience.stats import STATS
+
+        path, _, _ = make_sp_fil(
+            tmp_path, nsamps=1 << 12, dm_end=20.0, t0=1500
+        )
+        fil = read_filterbank(path)
+        cfg = SinglePulseConfig(dm_end=20.0, min_snr=7.0, n_widths=6)
+        ref = SinglePulseSearch(cfg).run(fil)
+        self._patch(monkeypatch, True, lambda s: False, False)
+        STATS.reset()
+        got = SinglePulseSearch(cfg).run(fil)
+        deg = STATS.snapshot()["degradations"]
+        assert deg.get("spsearch.kernel:jnp_twin") == 1, deg
+        assert [
+            (c.dm_idx, c.sample, c.width, c.snr) for c in got.candidates
+        ] == [
+            (c.dm_idx, c.sample, c.width, c.snr) for c in ref.candidates
+        ]
+
+    def test_retiled_kernel_bitwise_vs_twin(self, rng):
+        """A retiled (smaller-than-plan) span is still bitwise the
+        twin — the geometry the fallback ladder routes to is gated by
+        the same oracle as the full span."""
+        from peasoup_tpu.ops.pallas.spchain import boxcar_dec_best_pallas
+        from peasoup_tpu.ops.singlepulse import boxcar_dec_best_twin
+
+        t, dec = 4096, 32
+        x = rng.normal(size=(2, t)).astype(np.float32)
+        x[1, 700:712] += 20.0
+        widths = default_widths(6)
+        tpad, span = plan_pad(t)  # span == tpad == 4096 here
+        retiled = span // 2  # 2048: divides tpad, multiple of dec
+        wext = width_extent(widths)
+        norm = normalise_trials(jnp.asarray(x))
+        csum = prefix_sum_padded(norm, tpad, wext)
+        scales = width_scales(widths)
+        got = boxcar_dec_best_pallas(
+            csum, widths, scales, t, tpad, dec, span=retiled,
+            interpret=True,
+        )
+        ref = boxcar_dec_best_twin(csum, widths, scales, t, tpad, dec)
+        for g, r in zip(got, ref):
+            assert np.array_equal(np.asarray(g), np.asarray(r))
+
+
 # --------------------------------------------------------------------------
 # friends-of-friends clustering
 # --------------------------------------------------------------------------
