@@ -42,6 +42,7 @@ import tempfile
 import time
 
 from ..obs import get_logger
+from ..obs.metrics import MetricsRecorder
 from .registry import WorkerRegistry
 from .rollup import build_status
 
@@ -141,6 +142,11 @@ class AutoscaleController:
             )
         self.registry = WorkerRegistry(self.root)
         self.controller_id = controller_id
+        # the controller's own time series rides the same fleet
+        # directory as the workers': its decisions are fleet metrics
+        self.metrics = MetricsRecorder(
+            self.registry.metrics_path(controller_id)
+        )
         self._extra_args = list(extra_args or [])
         self._env = env
         self._spawn = spawn or (
@@ -273,6 +279,15 @@ class AutoscaleController:
         self.last_action_unix = now
         self.decisions.append(decision)
         self._write_log(now)
+        try:
+            self.metrics.counter(
+                "autoscale_decisions_total", action=decision["action"]
+            )
+            self.metrics.gauge(
+                "autoscale_live_workers", decision.get("live", 0)
+            )
+        except Exception:
+            log.debug("autoscale metrics failed", exc_info=True)
         log.info(
             "autoscale %s: %s (%s)", decision["action"],
             decision["worker_id"], decision["reason"],

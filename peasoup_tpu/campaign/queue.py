@@ -100,6 +100,11 @@ class Job:
     bucket: tuple | None = None
     priority: int = 0  # higher claims sooner; outranks bucket affinity
     nprocs: int = 1  # >1: gang-scheduled across a named process group
+    # trace correlation (obs/trace.py): minted at enqueue, propagated
+    # through claim docs / preempt requests / gang invitations, so a
+    # preempted-and-resumed or gang-scheduled job renders as ONE
+    # connected trace across every worker process that touched it
+    trace_id: str = ""
     attempts: int = 0
     next_eligible_unix: float = 0.0
     last_error: str | None = None
@@ -109,6 +114,12 @@ class Job:
     # latency — carried into the resumed run's done record
     preemptions: int = 0
     preempt_latency_s: list = field(default_factory=list)
+    # resilience counters a RELEASED attempt survived (retries,
+    # degradations, injected faults): a revoke consumes zero attempts
+    # and writes no done record, so without this carry the marks would
+    # vanish and the campaign rollup could no longer attribute every
+    # injected fault to its recovery path — the chaos soak's invariant
+    carried_resilience: dict = field(default_factory=dict)
 
     def to_doc(self) -> dict:
         return {
@@ -119,12 +130,14 @@ class Job:
             "bucket": list(self.bucket) if self.bucket else None,
             "priority": self.priority,
             "nprocs": self.nprocs,
+            "trace_id": self.trace_id,
             "attempts": self.attempts,
             "next_eligible_unix": self.next_eligible_unix,
             "last_error": self.last_error,
             "created_unix": self.created_unix,
             "preemptions": self.preemptions,
             "preempt_latency_s": self.preempt_latency_s,
+            "carried_resilience": self.carried_resilience,
         }
 
     @classmethod
@@ -138,6 +151,7 @@ class Job:
             bucket=tuple(b) if b else None,
             priority=int(doc.get("priority", 0)),
             nprocs=int(doc.get("nprocs", 1)),
+            trace_id=str(doc.get("trace_id") or ""),
             attempts=int(doc.get("attempts", 0)),
             next_eligible_unix=float(doc.get("next_eligible_unix", 0.0)),
             last_error=doc.get("last_error"),
@@ -146,6 +160,7 @@ class Job:
             preempt_latency_s=[
                 float(x) for x in (doc.get("preempt_latency_s") or [])
             ],
+            carried_resilience=doc.get("carried_resilience") or {},
         )
 
 
@@ -190,6 +205,12 @@ class JobQueue:
         """Idempotent enqueue: True when this call created the record,
         False when the job already exists (any state)."""
         job.created_unix = job.created_unix or time.time()
+        if not job.trace_id:
+            # the trace id is born here: enqueue is the first event of
+            # the job's life, and everything downstream inherits it
+            from ..obs.trace import new_trace_id
+
+            job.trace_id = new_trace_id()
         path = self._p(_JOBS, job.job_id)
         try:
             fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
@@ -318,6 +339,9 @@ class JobQueue:
             "hostname": socket.gethostname(),
             "claimed_unix": now,
             "expires_unix": expires,
+            # trace propagation: the claim is the hand-off artifact a
+            # gang member (or a watcher) reads, so the trace id rides it
+            "trace_id": job.trace_id,
         }
         if gang:
             doc["gang"] = gang
@@ -496,6 +520,9 @@ class JobQueue:
                 "victim_worker": claim_doc.get("worker_id"),
                 "requested_unix": now,
                 "deadline_unix": now + float(grace_s),
+                # trace propagation: the revoke is part of the job's
+                # one connected trace (the revoke-latency span)
+                "trace_id": claim_doc.get("trace_id"),
             },
         )
         from ..resilience import STATS
@@ -550,6 +577,29 @@ class JobQueue:
             claim.job.job_id, claim.worker_id, latency,
         )
         return latency
+
+    def record_carried_resilience(
+        self, claim: Claim, delta: dict
+    ) -> None:
+        """Fold a to-be-released attempt's resilience counter deltas
+        (resilience/stats.py ``delta_since`` shape: table -> key ->
+        count) into the job record, so the resumed run's done record
+        still accounts for every fault this attempt survived. Caller
+        must hold the claim (job records have a single writer); call
+        BEFORE :meth:`release` / :meth:`release_preempted`."""
+        if not delta:
+            return
+        job = self.get_job(claim.job.job_id)
+        if job is None:
+            return
+        for table, kv in delta.items():
+            if not isinstance(kv, dict):
+                continue
+            tgt = job.carried_resilience.setdefault(table, {})
+            for k, v in kv.items():
+                tgt[k] = tgt.get(k, 0) + int(v)
+        _atomic_write_json(self._p(_JOBS, job.job_id), job.to_doc())
+        claim.job = job  # the caller sees the carried tallies
 
     def preemption_wanted(
         self, claim: Claim, now: float | None = None

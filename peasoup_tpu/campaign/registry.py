@@ -87,6 +87,14 @@ class WorkerRegistry:
         )
         return os.path.join(self.wdir, f"{safe[:80]}.json")
 
+    def metrics_path(self, worker_id: str) -> str:
+        """The worker's time-series file (obs/metrics.py), living
+        beside its membership entry so the fleet aggregator finds the
+        whole fleet's history in one directory. Deliberately NOT
+        removed on deregister/reap: the history of a departed worker
+        is the point of having history."""
+        return self._path(worker_id)[: -len(".json")] + ".metrics.jsonl"
+
     # --- lifecycle ----------------------------------------------------
     def register(self, worker_id: str, **info) -> dict:
         """Join the fleet. Idempotent for one incarnation; a stale or
@@ -144,8 +152,9 @@ class WorkerRegistry:
 
     def deregister(self, worker_id: str) -> None:
         """Clean leave: remove the membership entry (and any pending
-        retire request — the leave answers it)."""
+        retire or profile request — the leave answers both)."""
         self.clear_retire(worker_id)
+        self.clear_profile(worker_id)
         try:
             os.unlink(self._path(worker_id))
             log.info("worker %s left the fleet", worker_id)
@@ -183,6 +192,48 @@ class WorkerRegistry:
     def clear_retire(self, worker_id: str) -> None:
         try:
             os.unlink(self._retire_path(worker_id))
+        except FileNotFoundError:
+            pass
+
+    # --- on-demand profiling (obs/profiler.py) ------------------------
+    def _profile_path(self, worker_id: str) -> str:
+        # ".profile" (not ".json") so registry scans — which filter on
+        # ".json" — never mistake a request for a membership entry
+        return self._path(worker_id) + ".profile"
+
+    def request_profile(
+        self,
+        worker_id: str,
+        seconds: float = 5.0,
+        requester: str = "",
+    ) -> None:
+        """Ask a live worker for a bounded ``jax.profiler`` capture:
+        it observes the marker on its next lease-renewer beat (busy)
+        or claim poll (idle), runs the capture on a helper thread
+        (guarded no-op on CPU), announces it in its metrics stream,
+        and clears the request — ``peasoup-campaign profile``'s write
+        half."""
+        _atomic_write_json(
+            self._profile_path(worker_id),
+            {
+                "worker_id": worker_id,
+                "seconds": float(seconds),
+                "requester": requester,
+                "requested_unix": time.time(),
+            },
+        )
+        log.info(
+            "device profile requested for worker %s (%.3gs)%s",
+            worker_id, seconds,
+            f" by {requester}" if requester else "",
+        )
+
+    def profile_requested(self, worker_id: str) -> dict | None:
+        return _read_json(self._profile_path(worker_id))
+
+    def clear_profile(self, worker_id: str) -> None:
+        try:
+            os.unlink(self._profile_path(worker_id))
         except FileNotFoundError:
             pass
 
@@ -242,16 +293,18 @@ class WorkerRegistry:
                 doc.get("worker_id"),
                 now - float(doc.get("expires_unix", 0)),
             )
-        # orphaned retire markers (the worker died, or left, before
-        # observing the request) must not leak — the request is moot
-        for name in sorted(os.listdir(self.wdir)):
-            if not name.endswith(".retire"):
-                continue
-            if not os.path.exists(
-                os.path.join(self.wdir, name[: -len(".retire")])
-            ):
-                try:
-                    os.unlink(os.path.join(self.wdir, name))
-                except FileNotFoundError:
-                    pass
+        # orphaned retire/profile markers (the worker died, or left,
+        # before observing the request) must not leak — the request is
+        # moot either way
+        for suffix in (".retire", ".profile"):
+            for name in sorted(os.listdir(self.wdir)):
+                if not name.endswith(suffix):
+                    continue
+                if not os.path.exists(
+                    os.path.join(self.wdir, name[: -len(suffix)])
+                ):
+                    try:
+                        os.unlink(os.path.join(self.wdir, name))
+                    except FileNotFoundError:
+                        pass
         return reaped

@@ -135,6 +135,9 @@ def build_status(root: str, queue: JobQueue | None = None) -> dict:
             "pid": e.get("pid"),
             "jobs_done": e.get("jobs_done", 0),
             "current_job": e.get("current_job"),
+            # clamped at zero: a clock-skewed writer can stamp an
+            # expiry ahead of this reader's clock, and a NEGATIVE
+            # heartbeat age is noise operators learn to distrust
             "last_beat_s": round(
                 max(0.0, now - (
                     float(e.get("expires_unix", now)) - registry.lease_s
@@ -143,6 +146,7 @@ def build_status(root: str, queue: JobQueue | None = None) -> dict:
         }
         for e in registry.live(now)
     ]
+    live_ids = {w["worker_id"] for w in live_workers}
     per_worker: dict[str, dict] = {}
     for d in done:
         wid = d.get("worker_id") or "?"
@@ -154,12 +158,28 @@ def build_status(root: str, queue: JobQueue | None = None) -> dict:
         if t:
             rec["first_unix"] = min(rec["first_unix"] or t, t)
             rec["last_unix"] = max(rec["last_unix"] or t, t)
-    for rec in per_worker.values():
+    # a departed/reaped worker's rate must AGE OUT: its jobs_per_h was
+    # computed over its own active span, so hours later the rollup
+    # would still advertise a throughput nobody is delivering. Live
+    # workers keep their rate; non-live workers keep it only within a
+    # grace window of their last completion.
+    rate_decay_s = max(300.0, 10.0 * registry.lease_s)
+    for wid, rec in per_worker.items():
         span = (rec["last_unix"] or 0) - (rec["first_unix"] or 0)
-        rec["jobs_per_h"] = (
+        rate = (
             round((rec["done"] - 1) / span * 3600.0, 3)
             if rec["done"] > 1 and span > 0 else None
         )
+        rec["live"] = wid in live_ids
+        # clamped: under clock skew a done record can be stamped ahead
+        # of this reader's clock (negative age = nonsense)
+        age = max(0.0, now - (rec["last_unix"] or now))
+        rec["last_done_age_s"] = round(age, 3)
+        if not rec["live"] and age > rate_decay_s:
+            rec["jobs_per_h"] = None
+            rec["rate_stale"] = True
+        else:
+            rec["jobs_per_h"] = rate
     degraded_jobs = sum(1 for d in done if d.get("degraded"))
     # preemption attribution: revoked-and-resumed jobs carry their
     # tally + request->release latency into done records; outstanding
@@ -208,6 +228,17 @@ def build_status(root: str, queue: JobQueue | None = None) -> dict:
             recursive=True,
         )
     )
+    # fleet time-series summary (obs/metrics.py): how much history is
+    # on disk and where to point `peasoup-campaign metrics`
+    from ..obs.metrics import metrics_paths
+
+    mpaths = metrics_paths(root)
+    mbytes = 0
+    for p in mpaths:
+        try:
+            mbytes += os.path.getsize(p)
+        except OSError:
+            pass
     return {
         "schema": CAMPAIGN_SCHEMA,
         "version": CAMPAIGN_VERSION,
@@ -241,6 +272,8 @@ def build_status(root: str, queue: JobQueue | None = None) -> dict:
         # crashed helper thread) and quarantined *.corrupt artifacts
         "degraded_jobs": degraded_jobs,
         "corrupt_artifact_files": corrupt_files,
+        # per-worker time-series on disk (peasoup-campaign metrics)
+        "metrics": {"files": len(mpaths), "bytes": mbytes},
         # priority preemption: revoked/resumed jobs + revoke latency
         "preemptions": preemptions,
         # gang-scheduled (nprocs > 1) completions

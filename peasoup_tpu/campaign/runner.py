@@ -44,7 +44,9 @@ import numpy as np
 from ..obs import get_logger
 from ..obs.flight import FlightRecorder
 from ..obs.heartbeat import Heartbeat
+from ..obs.metrics import MetricsRecorder
 from ..obs.telemetry import RunTelemetry
+from ..obs.trace import Tracer, new_trace_id
 from .db import DB_FILENAME, CandidateDB
 from .queue import Claim, Job, JobQueue, job_id_for
 from .registry import WorkerRegistry
@@ -56,6 +58,14 @@ CAMPAIGN_CONFIG = "campaign.json"
 CAMPAIGN_CONFIG_SCHEMA = "peasoup_tpu.campaign"
 
 PIPELINES = ("search", "spsearch", "ffa")
+
+
+def _safe_name(s: str) -> str:
+    """Filesystem-safe worker id (same sanitisation as the registry's
+    entry filenames, so per-worker artifacts line up by stem)."""
+    return "".join(
+        c if c.isalnum() or c in "-_." else "_" for c in s
+    )[:80]
 
 
 # --------------------------------------------------------------------------
@@ -109,6 +119,13 @@ class CampaignConfig:
     # transient (a dead member must consume exactly one attempt)
     gang_assemble_s: float = 30.0
     gang_timeout_s: float = 600.0
+    # fleet observability (obs/metrics.py, obs/trace.py): per-worker
+    # time-series metrics under queue/workers/ and per-job trace span
+    # files under jobs/<id>/ — both on by default (append-only JSON
+    # lines, negligible next to device work); `peasoup-campaign
+    # metrics` / `trace` consume them
+    metrics: bool = True
+    trace: bool = True
 
     def tuning_cache_path(self, root: str) -> str:
         return self.tuning_cache or os.path.join(root, "tuning_cache.json")
@@ -666,6 +683,7 @@ class _LeaseRenewer(threading.Thread):
         token=None,
         self_preempt: bool = False,
         grace_s: float = 60.0,
+        on_beat=None,
     ) -> None:
         super().__init__(name="campaign-lease", daemon=True)
         self._queue = queue
@@ -675,6 +693,9 @@ class _LeaseRenewer(threading.Thread):
         self._token = token
         self._self_preempt = bool(self_preempt)
         self._grace_s = float(grace_s)
+        # per-beat hook: how a BUSY worker observes fleet requests that
+        # are not revokes (the on-demand profile.request watcher)
+        self._on_beat = on_beat
         # NB: not "_stop" — Thread uses that name internally
         self._halt = threading.Event()
 
@@ -701,6 +722,11 @@ class _LeaseRenewer(threading.Thread):
                 self._observe_revoke()
             except Exception:
                 log.debug("revoke observation failed", exc_info=True)
+            if self._on_beat is not None:
+                try:
+                    self._on_beat()
+                except Exception:
+                    log.debug("beat hook failed", exc_info=True)
 
     def _observe_revoke(self) -> None:
         token = self._token
@@ -816,6 +842,15 @@ class CampaignRunner:
             self.campaign.tuning_cache_path(self.root)
             if self.campaign.tune else None
         )
+        # fleet observability: this worker's append-only time series
+        # (queue depth, throughput, preemption latency...) next to its
+        # registry entry, and the single-flight on-demand profiler
+        self.metrics = MetricsRecorder(
+            self.registry.metrics_path(self.worker_id),
+            enabled=self.campaign.metrics,
+        )
+        self._profile_thread: threading.Thread | None = None
+        self._last_queue_sample = 0.0
         # the persistent XLA cache backs the in-process caches across
         # worker restarts (utils/cache.py)
         from ..utils.cache import enable_compilation_cache
@@ -823,11 +858,16 @@ class CampaignRunner:
         enable_compilation_cache()
 
     # --- one job ------------------------------------------------------
-    def process_claim(self, claim: Claim) -> str:
+    def process_claim(
+        self, claim: Claim, claim_wait_s: float | None = None
+    ) -> str:
         """Run one claimed job under its own observability stack.
         Returns the job's resulting state (done|backoff|quarantined),
         or "released" when a revoke (preempt/retire) handed the job
-        back mid-run with zero attempts consumed."""
+        back mid-run with zero attempts consumed. ``claim_wait_s`` is
+        how long this worker idled before winning the claim (a
+        scheduling span in the job's trace and a fleet latency
+        histogram)."""
         from ..resilience import RevokeToken, activate_token
 
         job = claim.job
@@ -845,7 +885,28 @@ class CampaignRunner:
             attempt=job.attempts + 1,
             bucket=list(job.bucket) if job.bucket else None,
             gang=claim.gang,
+            trace_id=job.trace_id or None,
         )
+        # the job's trace: this process's span file under the job dir,
+        # keyed by the trace id minted at enqueue — a resumed or
+        # gang-scheduled run appends to the SAME trace from another
+        # process/worker, and the export stitches them into one
+        tracer = Tracer(
+            os.path.join(
+                job_dir, f"trace-{_safe_name(self.worker_id)}.jsonl"
+            ),
+            job.trace_id or new_trace_id(),
+            worker=self.worker_id,
+            enabled=self.campaign.trace,
+        )
+        tracer.attach(tel)
+        now_unix = time.time()
+        if claim_wait_s is not None:
+            tracer.span_at(
+                "claim_wait", now_unix - claim_wait_s, claim_wait_s,
+                job_id=job.job_id,
+            )
+            self.metrics.observe("claim_wait_seconds", claim_wait_s)
         from ..resilience import STATS as _RES_STATS
 
         res_base = _RES_STATS.snapshot()
@@ -855,6 +916,7 @@ class CampaignRunner:
             token=token,
             self_preempt=self.campaign.preempt,
             grace_s=self.campaign.preempt_grace_s,
+            on_beat=self._observe_profile,
         )
         renewer.start()
         comm = None
@@ -865,11 +927,15 @@ class CampaignRunner:
             # — zero attempts, no partial-gang deadlock.
             comm = self._gang_comm(claim.gang, job_dir, rank=0)
             try:
-                comm.allgather(
-                    self.worker_id.encode(),
-                    context=f"gang-join:{job.job_id}",
-                    timeout_s=self.campaign.gang_assemble_s,
-                )
+                with tracer.span(
+                    "gang_join", cat="sched", rank=0,
+                    nprocs=claim.gang.get("nprocs"),
+                ):
+                    comm.allgather(
+                        self.worker_id.encode(),
+                        context=f"gang-join:{job.job_id}",
+                        timeout_s=self.campaign.gang_assemble_s,
+                    )
             except Exception as exc:
                 renewer.stop()
                 self._gang_cleanup(comm)
@@ -877,6 +943,7 @@ class CampaignRunner:
                     "gang_unassembled", job_id=job.job_id,
                     gang=claim.gang, error=f"{exc!s:.200}",
                 )
+                tracer.close()
                 self.queue.release(claim)
                 log.warning(
                     "gang for %s did not assemble (%s); claim released "
@@ -919,7 +986,14 @@ class CampaignRunner:
         from ..resilience import SearchPreempted
 
         try:
-            with tel.activate(), activate_token(token):
+            with tel.activate(), activate_token(token), \
+                    tracer.activate(), tracer.span(
+                        "job_attempt",
+                        job_id=job.job_id,
+                        pipeline=job.pipeline,
+                        attempt=job.attempts + 1,
+                        priority=job.priority,
+                    ):
                 try:
                     # chaos seam: a scheduled worker.kill raises
                     # WorkerKilled (BaseException) here — it skips the
@@ -966,6 +1040,18 @@ class CampaignRunner:
                     # survived (retries, degradations, injected
                     # faults), for the done record + campaign rollup
                     res_delta = _RES_STATS.delta_since(res_base)
+                    # a previously RELEASED attempt's survived faults
+                    # ride the job record (queue.record_carried_
+                    # resilience) — fold them in so the done record
+                    # accounts for the job's WHOLE history
+                    for table, kv in (
+                        claim.job.carried_resilience or {}
+                    ).items():
+                        if not isinstance(kv, dict):
+                            continue
+                        tgt = res_delta.setdefault(table, {})
+                        for k, v in kv.items():
+                            tgt[k] = tgt.get(k, 0) + int(v)
                     if res_delta:
                         info["resilience"] = res_delta
                     # a job that descended a degradation ladder (OOM
@@ -1006,6 +1092,14 @@ class CampaignRunner:
                     )
                     if comm is not None:
                         comm.abort(f"leader revoked ({exc.kind})")
+                    # whatever this attempt survived must not vanish
+                    # with the zero-attempt release: carry it on the
+                    # job record into the resumed run's done record
+                    rel_delta = _RES_STATS.delta_since(res_base)
+                    if rel_delta:
+                        self.queue.record_carried_resilience(
+                            claim, rel_delta
+                        )
                     if exc.kind == "retire":
                         self.queue.release(claim)
                         self._retiring = True
@@ -1025,6 +1119,19 @@ class CampaignRunner:
                             "preempt_released", job_id=job.job_id,
                             latency_s=round(latency, 4),
                         )
+                        # the revoke-latency span: request -> release,
+                        # in the job's one connected trace
+                        release_unix = time.time()
+                        tracer.span_at(
+                            "revoke", release_unix - latency, latency,
+                            kind=exc.kind, job_id=job.job_id,
+                        )
+                        self.metrics.observe(
+                            "preemption_latency_seconds", latency
+                        )
+                    self.metrics.counter(
+                        "preemptions_total", event=exc.kind
+                    )
                     return "released"
                 except Exception as exc:
                     tel.event(
@@ -1045,6 +1152,7 @@ class CampaignRunner:
                     state = self.queue.fail(
                         claim, f"{type(exc).__name__}: {exc}"
                     )
+                    self.metrics.counter("jobs_failed_total", state=state)
                     log.warning(
                         "job %s failed -> %s: %s", job.job_id, state, exc
                     )
@@ -1053,6 +1161,7 @@ class CampaignRunner:
             heartbeat.stop()
             recorder.close()
             renewer.stop()
+            tracer.close()
             if comm is not None:
                 self._gang_cleanup(comm)
         # second chaos seam: dying AFTER the work but BEFORE the done
@@ -1062,6 +1171,7 @@ class CampaignRunner:
 
         _faults.fire("worker.kill", context=f"{job.job_id}:pre-complete")
         self.queue.complete(claim, worker_id=self.worker_id, **info)
+        self._record_job_metrics(tel, info)
         if job.bucket:
             self._last_bucket = job.bucket
         log.info(
@@ -1133,7 +1243,19 @@ class CampaignRunner:
             gang=gang,
             process_index=rank,
             process_count=int(gang["nprocs"]),
+            trace_id=claim_doc.get("trace_id") or job.trace_id or None,
         )
+        # the member's spans join the job's ONE trace: the id rides the
+        # gang claim document the invitation handed us
+        tracer = Tracer(
+            os.path.join(
+                job_dir, f"trace-{_safe_name(self.worker_id)}.jsonl"
+            ),
+            claim_doc.get("trace_id") or job.trace_id or new_trace_id(),
+            worker=self.worker_id,
+            enabled=self.campaign.trace,
+        )
+        tracer.attach(tel)
         self.registry.beat(self.worker_id, current_job=job_id)
         comm = self._gang_comm(gang, job_dir, rank=rank)
         log.info(
@@ -1141,12 +1263,19 @@ class CampaignRunner:
             job_id, rank, gang["nprocs"], epoch,
         )
         try:
-            with tel.activate():
-                comm.allgather(
-                    self.worker_id.encode(),
-                    context=f"gang-join:{job_id}",
-                    timeout_s=self.campaign.gang_assemble_s,
-                )
+            with tel.activate(), tracer.activate(), tracer.span(
+                "gang_member", job_id=job_id, rank=rank,
+                nprocs=int(gang["nprocs"]),
+            ):
+                with tracer.span(
+                    "gang_join", cat="sched", rank=rank,
+                    nprocs=gang.get("nprocs"),
+                ):
+                    comm.allgather(
+                        self.worker_id.encode(),
+                        context=f"gang-join:{job_id}",
+                        timeout_s=self.campaign.gang_assemble_s,
+                    )
                 tel.event("gang_assembled", job_id=job_id, gang=gang)
                 run_observation(
                     job,
@@ -1171,6 +1300,7 @@ class CampaignRunner:
                 error=f"{exc!s:.200}",
             )
         finally:
+            tracer.close()
             self.registry.beat(self.worker_id, current_job=None)
 
     # --- warmup-aware claiming ----------------------------------------
@@ -1193,6 +1323,99 @@ class CampaignRunner:
         except Exception:  # a torn done record must not stall claiming
             log.debug("warm-bucket hint scan failed", exc_info=True)
         return warm
+
+    # --- fleet observability ------------------------------------------
+    def _record_job_metrics(self, tel: RunTelemetry, info: dict) -> None:
+        """One completed job's contribution to this worker's time
+        series: completion/duration, per-stage seconds + throughput,
+        device-memory high water, warmup/tuning wall, compiles."""
+        m = self.metrics
+        if not m.enabled:
+            return
+        try:
+            m.counter("jobs_done_total", pipeline=info.get("pipeline", ""))
+            dur = float(info.get("duration_s") or 0.0)
+            if dur:
+                m.observe("job_duration_seconds", dur)
+            for stage, secs in sorted(tel.timers.items()):
+                m.counter("stage_seconds_total", float(secs), stage=stage)
+            trials = float(tel.counters.get("search.dm_trials_done", 0))
+            searching = float(tel.timers.get("searching", 0.0))
+            if trials and searching > 0:
+                m.gauge(
+                    "stage_throughput_per_s", trials / searching,
+                    stage="searching", unit="dm_trials",
+                )
+            peak = tel.gauges.get("memory.peak_bytes")
+            if peak:
+                m.gauge("device_memory_peak_bytes", float(peak))
+            if info.get("warmup_s") is not None:
+                m.counter("warmup_seconds_total", float(info["warmup_s"]))
+            if info.get("tuning_s") is not None:
+                m.counter("tuning_seconds_total", float(info["tuning_s"]))
+            m.counter(
+                "jit_programs_compiled_total",
+                int(info.get("jit_programs_compiled", 0)),
+            )
+            if info.get("gang"):
+                m.counter("gang_jobs_total")
+            if info.get("degraded"):
+                m.counter("degraded_jobs_total")
+        except Exception:  # metrics must never fail a completed job
+            log.debug("job metrics recording failed", exc_info=True)
+
+    def _sample_queue_metrics(self, min_interval_s: float = 1.0) -> None:
+        """Throttled queue-depth gauges (one sample per derived state)
+        — the "what was queue depth over the last hour" series."""
+        if not self.metrics.enabled:
+            return
+        now_mono = time.monotonic()
+        if now_mono - self._last_queue_sample < min_interval_s:
+            return
+        self._last_queue_sample = now_mono
+        try:
+            counts = self.queue.counts()
+            for state in (
+                "pending", "running", "backoff", "stale", "done",
+                "quarantined",
+            ):
+                self.metrics.gauge(
+                    "queue_depth", counts.get(state, 0), state=state
+                )
+            self.metrics.gauge("queue_jobs_total", counts.get("total", 0))
+        except Exception:
+            log.debug("queue metrics sampling failed", exc_info=True)
+
+    def _observe_profile(self) -> None:
+        """The worker side of on-demand profiling: observe a
+        ``profile.request`` beside our registry entry (written by
+        ``peasoup-campaign profile``), clear it (single-flight), and
+        run the bounded capture on a helper thread so neither the
+        renewer beat nor the claim loop blocks on it."""
+        if self._profile_thread is not None and (
+            self._profile_thread.is_alive()
+        ):
+            return
+        req = self.registry.profile_requested(self.worker_id)
+        if req is None:
+            return
+        self.registry.clear_profile(self.worker_id)
+        seconds = float(req.get("seconds") or 5.0)
+        now_unix = time.time()
+        outdir = os.path.join(
+            self.root, "profiles",
+            f"{_safe_name(self.worker_id)}-{int(now_unix)}",
+        )
+        from ..obs.profiler import start_profile_capture
+
+        # the capture announces itself in this worker's metrics stream
+        self._profile_thread = start_profile_capture(
+            outdir, seconds, metrics=self.metrics
+        )
+        log.info(
+            "device profile capture started for %s (%.3gs, requested "
+            "by %s)", self.worker_id, seconds, req.get("requester") or "?",
+        )
 
     # --- the loop -----------------------------------------------------
     def run(
@@ -1217,6 +1440,7 @@ class CampaignRunner:
         }
         processed = 0
         self.registry.register(self.worker_id, group=self.group)
+        wait_t0 = time.perf_counter()  # claim-wait latency base
         try:
             while True:
                 if max_jobs is not None and processed >= max_jobs:
@@ -1233,6 +1457,11 @@ class CampaignRunner:
                     self.worker_id, jobs_done=self._jobs_done,
                     current_job=None,
                 )
+                # fleet observability: queue-depth time series and the
+                # idle-side profile.request watcher (the busy side is
+                # the lease renewer's beat hook)
+                self._sample_queue_metrics()
+                self._observe_profile()
                 if self.group:
                     # a gang claim naming this worker outranks new
                     # work: the leader is holding the claim for the
@@ -1264,7 +1493,13 @@ class CampaignRunner:
                     # others are running, or retries back off: wait
                     time.sleep(poll_s)
                     continue
-                state = self.process_claim(claim)
+                state = self.process_claim(
+                    claim,
+                    claim_wait_s=round(
+                        time.perf_counter() - wait_t0, 6
+                    ),
+                )
+                wait_t0 = time.perf_counter()
                 if state == "released":
                     # a revoke (preempt/retire) or an unassembled gang
                     # handed the job back: nothing was consumed and

@@ -137,6 +137,16 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--poll", type=float, default=1.0,
                      help="seconds between queue polls while waiting "
                      "(default 1)")
+    run.add_argument("--metrics", action=argparse.BooleanOptionalAction,
+                     default=True,
+                     help="per-worker time-series metrics under "
+                     "queue/workers/ (obs/metrics.py; read with "
+                     "`peasoup-campaign metrics`; default on)")
+    run.add_argument("--trace", action=argparse.BooleanOptionalAction,
+                     default=True,
+                     help="per-job trace span files under jobs/<id>/ "
+                     "(obs/trace.py; export with `peasoup-campaign "
+                     "trace`; default on)")
     run.add_argument("--log-level", dest="log_level", default=None,
                      choices=["debug", "info", "warning", "error"])
     run.add_argument("-v", "--verbose", action="store_true")
@@ -198,6 +208,51 @@ def build_parser() -> argparse.ArgumentParser:
                      "`peasoup-campaign run` (repeatable, e.g. "
                      "--spawn-arg=--no-warmup)")
 
+    me = sub.add_parser(
+        "metrics", help="aggregate every worker's time-series metrics "
+        "(queue/workers/*.metrics.jsonl) and print the Prometheus text "
+        "exposition; --serve exposes it on a stdlib HTTP endpoint",
+    )
+    me.add_argument("-w", "--workdir", required=True)
+    me.add_argument("--json", action="store_true",
+                    help="print the raw samples (one JSON object per "
+                    "worker) instead of the exposition")
+    me.add_argument("--serve", action="store_true",
+                    help="serve GET /metrics forever (Prometheus "
+                    "scrape target; ctrl-C to stop)")
+    me.add_argument("--port", type=int, default=9099)
+    me.add_argument("--host", default="127.0.0.1")
+
+    tr = sub.add_parser(
+        "trace", help="export one or more jobs' cross-process trace "
+        "spans as Chrome trace-event JSON (load at ui.perfetto.dev): "
+        "a preempted-and-resumed job or an N-member gang renders as "
+        "ONE connected timeline, one track per worker",
+    )
+    tr.add_argument("-w", "--workdir", required=True)
+    tr.add_argument("job_ids", nargs="*",
+                    help="jobs to export (default: every job with "
+                    "trace files)")
+    tr.add_argument("-o", "--output", default=None,
+                    help="output trace JSON path (default: "
+                    "<workdir>/trace.json)")
+    tr.add_argument("--no-autoscale", action="store_true",
+                    help="omit the autoscale decision instants from "
+                    "the campaign track")
+
+    pf = sub.add_parser(
+        "profile", help="request a bounded on-demand jax.profiler "
+        "capture from a LIVE worker: a profile.request file lands "
+        "beside its registry entry, the worker observes it on its "
+        "next beat and captures into <workdir>/profiles/ (guarded "
+        "no-op on the CPU backend)",
+    )
+    pf.add_argument("-w", "--workdir", required=True)
+    pf.add_argument("worker_id", help="the worker to profile (see "
+                    "`peasoup-campaign status` fleet view)")
+    pf.add_argument("--seconds", type=float, default=5.0,
+                    help="capture duration (bounded at 60s; default 5)")
+
     pr = sub.add_parser(
         "prune", help="delete quarantined artifacts (the *.corrupt "
         "forensics renamed aside by the resilience layer accumulate "
@@ -248,6 +303,8 @@ def _cmd_run(args) -> int:
             warmup_mode=args.warmup_mode,
             tune=args.tune,
             tuning_cache=args.tuning_cache,
+            metrics=args.metrics,
+            trace=args.trace,
         ),
     )
     queue = JobQueue(
@@ -441,6 +498,123 @@ def _cmd_autoscale(args) -> int:
     return 0
 
 
+def _cmd_metrics(args) -> int:
+    from ..obs.metrics import (
+        fleet_samples,
+        metrics_paths,
+        prometheus_exposition,
+        serve_metrics,
+    )
+
+    if args.serve:
+        try:
+            serve_metrics(args.workdir, port=args.port, host=args.host)
+        except KeyboardInterrupt:
+            pass
+        return 0
+    if not metrics_paths(args.workdir):
+        print(
+            f"no metrics files under {args.workdir}/queue/workers/ "
+            "(campaign never ran, or ran with --no-metrics)",
+            file=sys.stderr,
+        )
+        return 1
+    samples = fleet_samples(args.workdir)
+    if args.json:
+        print(json.dumps(samples, indent=2))
+    else:
+        sys.stdout.write(prometheus_exposition(samples))
+    return 0
+
+
+def _cmd_trace(args) -> int:
+    from ..campaign.autoscale import load_autoscale_log
+    from ..obs.trace import (
+        export_chrome_trace,
+        load_spans,
+        trace_paths,
+        trace_summary,
+    )
+
+    jobs_dir = os.path.join(args.workdir, "jobs")
+    job_ids = list(args.job_ids)
+    if not job_ids and os.path.isdir(jobs_dir):
+        job_ids = sorted(
+            j for j in os.listdir(jobs_dir)
+            if trace_paths(os.path.join(jobs_dir, j))
+        )
+    spans = []
+    for jid in job_ids:
+        spans.extend(load_spans(trace_paths(os.path.join(jobs_dir, jid))))
+    if not spans:
+        print(
+            f"no trace spans under {jobs_dir} "
+            "(campaign never ran, or ran with --no-trace)",
+            file=sys.stderr,
+        )
+        return 1
+    extra = None
+    if not args.no_autoscale:
+        scale = load_autoscale_log(args.workdir) or {}
+        extra = [
+            {
+                "name": f"autoscale:{d.get('action')}",
+                "ts_unix": float(d.get("unix", 0.0)),
+                "args": {
+                    "worker_id": d.get("worker_id"),
+                    "reason": d.get("reason"),
+                },
+            }
+            for d in scale.get("decisions") or []
+        ]
+    doc = export_chrome_trace(spans, extra_instants=extra)
+    out = args.output or os.path.join(args.workdir, "trace.json")
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    for jid in job_ids:
+        summ = trace_summary(
+            load_spans(trace_paths(os.path.join(jobs_dir, jid)))
+        )
+        flag = "" if summ["connected"] else "  *** DISCONNECTED ***"
+        print(
+            f"{jid}: {summ['n_spans']} spans across "
+            f"{len(summ['workers'])} worker(s) "
+            f"[{', '.join(summ['workers'])}]"
+            f"  trace_id={','.join(summ['trace_ids'])}{flag}"
+        )
+    print(
+        f"exported {len(doc['traceEvents'])} trace events -> {out}\n"
+        "view: open https://ui.perfetto.dev and load the file "
+        "(or chrome://tracing)"
+    )
+    return 0
+
+
+def _cmd_profile(args) -> int:
+    from ..campaign.registry import WorkerRegistry
+
+    registry = WorkerRegistry(args.workdir)
+    live = {e.get("worker_id") for e in registry.live()}
+    if args.worker_id not in live:
+        print(
+            f"{args.worker_id}: not a live worker "
+            f"(live: {sorted(w for w in live if w)})",
+            file=sys.stderr,
+        )
+        return 1
+    registry.request_profile(
+        args.worker_id, seconds=args.seconds, requester="operator"
+    )
+    print(
+        f"profile requested for {args.worker_id} ({args.seconds:g}s); "
+        f"the capture lands under "
+        f"{os.path.join(args.workdir, 'profiles')}/ and is announced "
+        "in the worker's metrics stream (profile_captures_total)"
+    )
+    return 0
+
+
 def _cmd_prune(args) -> int:
     import time
 
@@ -492,6 +666,9 @@ def main(argv: list[str] | None = None) -> int:
         "ingest": _cmd_ingest,
         "preempt": _cmd_preempt,
         "autoscale": _cmd_autoscale,
+        "metrics": _cmd_metrics,
+        "trace": _cmd_trace,
+        "profile": _cmd_profile,
         "prune": _cmd_prune,
     }[args.cmd](args)
 

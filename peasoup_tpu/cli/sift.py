@@ -182,7 +182,26 @@ def _cmd_report(args) -> int:
     json_path = args.json_out or os.path.join(sift_dir, "report.json")
     with CandidateDB(db_path) as db:
         doc = build_report(db, campaign_status, limit=args.limit)
-    write_report(doc, json_path, html_path)
+    # the DM-time bowtie diagnostic rides beside the report and is
+    # linked from it (a missing/empty SP table renders an empty plot;
+    # a failure only loses the plot, never the report)
+    bowtie_href = None
+    try:
+        from ..tools.plotting import bowtie_from_db
+
+        svg = bowtie_from_db(db_path)
+        os.makedirs(sift_dir, exist_ok=True)
+        bowtie_path = os.path.join(sift_dir, "bowtie.svg")
+        tmp = bowtie_path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(svg)
+        os.replace(tmp, bowtie_path)
+        bowtie_href = "bowtie.svg"
+    except Exception as exc:
+        print(
+            f"peasoup-sift: bowtie plot skipped: {exc}", file=sys.stderr
+        )
+    write_report(doc, json_path, html_path, bowtie_href=bowtie_href)
     print(f"peasoup-sift report: {json_path} + {html_path}")
     if args.print_summary:
         run = doc["run"]

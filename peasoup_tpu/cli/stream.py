@@ -143,6 +143,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="stop after N chunks (0 = run to stream end)",
     )
     g.add_argument(
+        "--metrics-jsonl", dest="metrics_jsonl", default="",
+        help="append-only time-series metrics file (chunk latency, "
+        "queue depth, trigger counts; obs/metrics.py — read with "
+        "`peasoup-campaign metrics` tooling or Prometheus); default "
+        "off",
+    )
+    g.add_argument(
         "--no-warmup", dest="no_warmup", action="store_true",
         help="skip the AOT warmup of the chunk programs before ingest",
     )
@@ -226,6 +233,7 @@ def main(argv: list[str] | None = None) -> int:
         latency_slo_s=args.latency_slo_s,
         max_chunks=args.max_chunks,
         warmup=not args.no_warmup,
+        metrics_jsonl=args.metrics_jsonl,
     )
     os.makedirs(outdir, exist_ok=True)
     with tel.activate(), live_observability(

@@ -2,14 +2,28 @@
 telemetry subsystem (obs/telemetry.py) behind the versioned
 ``telemetry.json`` run manifest, the live ``status.json`` heartbeat +
 stall watchdog (obs/heartbeat.py), the crash flight recorder
-(obs/flight.py), and the manifest schema contract (obs/schema.py +
-manifest.schema.json). See README "Observability" and "Live
-observability"."""
+(obs/flight.py), the manifest schema contract (obs/schema.py +
+manifest.schema.json) — and the FLEET layer: per-worker time-series
+metrics with Prometheus exposition (obs/metrics.py +
+metrics.schema.json), cross-process trace correlation with
+Chrome/Perfetto export (obs/trace.py), and on-demand device profiling
+of live workers (obs/profiler.py). See README "Observability", "Live
+observability" and "Fleet observability"."""
 
 from .flight import FLIGHT_SCHEMA, FlightRecorder, load_flight
 from .heartbeat import STATUS_SCHEMA, Heartbeat, load_status
 from .log import configure as configure_logging
 from .log import get_logger, resolve_level
+from .metrics import (
+    METRICS_SCHEMA,
+    MetricsRecorder,
+    fleet_samples,
+    load_series,
+    parse_exposition,
+    prometheus_exposition,
+    validate_sample,
+)
+from .profiler import capture_device_profile
 from .schema import SchemaError, validate_manifest
 from .telemetry import (
     MANIFEST_SCHEMA,
@@ -18,6 +32,18 @@ from .telemetry import (
     RunTelemetry,
     current,
     load_manifest,
+)
+from .trace import (
+    TRACE_SCHEMA,
+    Tracer,
+    current_tracer,
+    export_chrome_trace,
+    job_instant,
+    job_span,
+    load_spans,
+    new_trace_id,
+    trace_paths,
+    trace_summary,
 )
 
 __all__ = [
@@ -38,4 +64,22 @@ __all__ = [
     "RunTelemetry",
     "current",
     "load_manifest",
+    "METRICS_SCHEMA",
+    "MetricsRecorder",
+    "fleet_samples",
+    "load_series",
+    "parse_exposition",
+    "prometheus_exposition",
+    "validate_sample",
+    "capture_device_profile",
+    "TRACE_SCHEMA",
+    "Tracer",
+    "current_tracer",
+    "export_chrome_trace",
+    "job_instant",
+    "job_span",
+    "load_spans",
+    "new_trace_id",
+    "trace_paths",
+    "trace_summary",
 ]

@@ -1,0 +1,139 @@
+"""On-demand device profiling of a LIVE worker.
+
+"Which kernel is this worker stuck in" is a question operators ask
+about a process they did not start with profiling enabled. The
+fleet-side protocol (campaign/registry.py) is a ``profile.request``
+file beside the worker's registry entry — written by
+``peasoup-campaign profile``, observed by the worker's lease-renewer
+beat (busy worker) or claim loop (idle worker) — and this module is
+the worker-side capture: a **bounded** ``jax.profiler`` trace into the
+campaign's ``profiles/`` directory, announced in the worker's metrics
+stream and telemetry so the capture itself is observable.
+
+The capture is guarded: on the CPU backend the XLA profiler has
+nothing useful to say (and the CI soaks run on CPU), so the request is
+acknowledged as a structured no-op unless ``allow_cpu`` forces it —
+the protocol round-trips everywhere, the device cost is only ever
+paid on a real accelerator.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from .log import get_logger
+
+log = get_logger("obs.profiler")
+
+# hard ceiling on a requested capture: profiling costs device memory
+# and wall time, and a fat-fingered request must not profile for hours
+MAX_CAPTURE_S = 60.0
+DEFAULT_CAPTURE_S = 5.0
+
+
+def capture_device_profile(
+    outdir: str,
+    duration_s: float = DEFAULT_CAPTURE_S,
+    allow_cpu: bool = False,
+    telemetry=None,
+) -> dict:
+    """Run one bounded ``jax.profiler`` capture into ``outdir``.
+
+    Returns a structured outcome dict (always — failures are reported,
+    never raised: a broken profiler must not take the worker down):
+    ``{"captured": bool, "skipped": reason|None, "seconds": float,
+    "outdir": path|None, "backend": str}``.
+    """
+    duration_s = max(0.1, min(float(duration_s), MAX_CAPTURE_S))
+    t0 = time.perf_counter()
+    backend = "unknown"
+    outcome: dict = {
+        "captured": False,
+        "skipped": None,
+        "seconds": 0.0,
+        "outdir": None,
+        "backend": backend,
+        "requested_s": duration_s,
+    }
+    try:
+        import jax
+
+        backend = jax.default_backend()
+        outcome["backend"] = backend
+    except Exception as exc:
+        outcome["skipped"] = f"jax unavailable: {exc!s:.120}"
+        return _announce(outcome, telemetry)
+    if backend == "cpu" and not allow_cpu:
+        # guarded no-op: the protocol completes, the cost is not paid
+        outcome["skipped"] = "cpu backend (no device profile to take)"
+        log.info(
+            "profile request acknowledged as a no-op on the CPU backend"
+        )
+        return _announce(outcome, telemetry)
+    try:
+        os.makedirs(outdir, exist_ok=True)
+        jax.profiler.start_trace(outdir)
+        try:
+            time.sleep(duration_s)
+        finally:
+            jax.profiler.stop_trace()
+        outcome["captured"] = True
+        outcome["outdir"] = os.path.abspath(outdir)
+        log.info(
+            "device profile captured: %.3gs into %s", duration_s, outdir
+        )
+    except Exception as exc:
+        outcome["skipped"] = f"{type(exc).__name__}: {exc!s:.200}"
+        log.warning("device profile capture failed: %s", exc)
+    outcome["seconds"] = round(time.perf_counter() - t0, 3)
+    return _announce(outcome, telemetry)
+
+
+def start_profile_capture(
+    outdir: str,
+    duration_s: float,
+    metrics=None,
+    telemetry=None,
+):
+    """Run :func:`capture_device_profile` on a daemon helper thread
+    (under the resilience crash guard) so the caller's beat/claim loop
+    never blocks on the capture; announces the outcome in ``metrics``
+    (an obs.metrics.MetricsRecorder) — the capture is itself an
+    observable fleet event. Returns the started thread."""
+    import threading
+
+    def _capture() -> None:
+        outcome = capture_device_profile(
+            outdir, duration_s=duration_s, telemetry=telemetry
+        )
+        if metrics is not None:
+            metrics.counter(
+                "profile_captures_total",
+                outcome=(
+                    "captured" if outcome.get("captured") else "skipped"
+                ),
+            )
+            metrics.gauge(
+                "profile_capture_seconds", outcome.get("seconds", 0.0)
+            )
+
+    def _guarded() -> None:
+        from ..resilience import guard_thread
+
+        guard_thread("campaign-profile", _capture, telemetry=telemetry)
+
+    thread = threading.Thread(
+        target=_guarded, name="campaign-profile", daemon=True
+    )
+    thread.start()
+    return thread
+
+
+def _announce(outcome: dict, telemetry) -> dict:
+    if telemetry is not None:
+        try:
+            telemetry.event("device_profile", **outcome)
+        except Exception:
+            pass
+    return outcome

@@ -35,6 +35,7 @@ from jax.sharding import Mesh
 
 from ..obs import get_logger
 from ..obs.telemetry import current as current_telemetry
+from ..obs.trace import job_span
 from ..resilience import TransientIOError, faults
 from .mesh import make_mesh
 
@@ -240,7 +241,6 @@ class GangComm:
         """Exchange one blob per member; returns every member's blob in
         rank order. The ``multihost.barrier`` fault seam fires here,
         exactly as it does for the JAX-collective path."""
-        import errno as _errno
         import time as _time
 
         faults.fire("multihost.barrier", context=context)
@@ -254,6 +254,21 @@ class GangComm:
             self.timeout_s if timeout_s is None else float(timeout_s)
         )
         last_beat = 0.0
+        # the barrier wait is a span in the job's connected trace (a
+        # no-op when the campaign runner has no tracer active): gang
+        # stragglers become visible as long gang_barrier spans
+        with job_span(
+            "gang_barrier", cat="sched",
+            context=context or "barrier", round=rnd, rank=self.rank,
+        ):
+            return self._await_round(rnd, context, deadline, last_beat)
+
+    def _await_round(
+        self, rnd: int, context: str, deadline: float, last_beat: float
+    ) -> list[bytes]:
+        import errno as _errno
+        import time as _time
+
         while True:
             aborted = self._aborted()
             if aborted:

@@ -25,6 +25,7 @@ from ..core.candidates import Candidate, CandidateCollection
 from ..io.masks import read_killfile, read_zapfile
 from ..obs import get_logger
 from ..obs.telemetry import current as current_telemetry
+from ..obs.trace import job_span
 from ..io.sigproc import Filterbank
 from ..ops.dedisperse import (
     dedisperse,
@@ -1439,13 +1440,18 @@ class PeasoupSearch:
         tel = current_telemetry()
         tel.set_progress(0, n_chunks, unit="chunks")
         n_done = 0
-        for wave in waves:
+        for wi, wave in enumerate(waves):
             todo = [
                 c for c in wave
                 if not all(d in per_dm_results for d in c[0])
             ]
             if todo:
-                with trace_span("DM-Loop"):  # NVTX parity: pipeline_multi.cu:144
+                # fleet-trace span (obs/trace.py, no-op outside a
+                # campaign job): each search wave is one unit of the
+                # job's connected timeline
+                with job_span(
+                    "wave", wave=wi, chunks=len(todo),
+                ), trace_span("DM-Loop"):  # NVTX parity: pipeline_multi.cu:144
                     try:
                         self._search_wave(
                             todo, dispatch_lists, trials, tim_len, zapmask_dev,
@@ -1495,7 +1501,8 @@ class PeasoupSearch:
                             per_dm_results, **disp,
                         )
                 if ckpt is not None:
-                    ckpt.save(per_dm_results)
+                    with job_span("checkpoint", wave=wi):
+                        ckpt.save(per_dm_results)
                 # revoke seam: a preempt/retire observed by the lease
                 # renewer stops here, right after the checkpoint save,
                 # so the resumed run restores exactly this state and

@@ -31,6 +31,7 @@ from ..io.masks import read_killfile
 from ..io.sigproc import Filterbank
 from ..obs import get_logger
 from ..obs.telemetry import current as current_telemetry
+from ..obs.trace import job_span
 from ..ops.dedisperse import (
     dedisperse,
     dedisperse_device,
@@ -805,7 +806,9 @@ class SinglePulseSearch:
                     tel.set_progress(ci + 1, len(chunks), unit="chunks")
                     continue
                 lo, hi = chunk[0], chunk[-1] + 1
-                with trace_span("SP-Chunk"):
+                # fleet-trace span (obs/trace.py, no-op outside a
+                # campaign job): one search wave of the job's timeline
+                with job_span("wave", wave=ci), trace_span("SP-Chunk"):
                     block = trials[lo:hi]
                     if spill:
                         block = jnp.asarray(block)
@@ -830,7 +833,8 @@ class SinglePulseSearch:
                         np.int32(counts[j]),
                     )
                 if ckpt is not None:
-                    ckpt.save(per_dm)
+                    with job_span("checkpoint", wave=ci):
+                        ckpt.save(per_dm)
                 tel.set_progress(ci + 1, len(chunks), unit="chunks")
                 if progress:
                     progress.update((ci + 1) / len(chunks))

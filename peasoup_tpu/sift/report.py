@@ -156,10 +156,12 @@ def _fmt(v, nd=3):
     return html.escape(str(v))
 
 
-def render_html(doc: dict) -> str:
+def render_html(doc: dict, bowtie_href: str | None = None) -> str:
     """The self-contained survey page. The full report JSON is inlined
     (``</`` escaped so a string can never close the script block) —
-    saving the page saves the data."""
+    saving the page saves the data. ``bowtie_href`` links the DM-time
+    bowtie diagnostic SVG the CLI writes beside the report
+    (tools/plotting.py render_bowtie_svg)."""
     run = doc["run"]
     parts = [
         "<!doctype html><html><head><meta charset='utf-8'>",
@@ -238,6 +240,12 @@ def render_html(doc: dict) -> str:
             f"<td>{_fmt(s['period_frac_resid'], 4)}</td></tr>"
         )
     parts.append("</table>")
+    if bowtie_href:
+        parts.append(
+            f"<p><a href='{html.escape(bowtie_href)}'>DM&#8211;time "
+            "bowtie diagnostic</a> (all single-pulse detections, "
+            "marker area &#8733; S/N)</p>"
+        )
     camp = doc.get("campaign")
     if camp:
         q = camp.get("queue") or {}
@@ -257,13 +265,16 @@ def render_html(doc: dict) -> str:
 
 
 def write_report(
-    doc: dict, json_path: str | None, html_path: str | None
+    doc: dict,
+    json_path: str | None,
+    html_path: str | None,
+    bowtie_href: str | None = None,
 ) -> None:
     """Validate then write the requested artefacts (atomic rename)."""
     validate_report(doc)
     for path, payload in (
         (json_path, json.dumps(doc, indent=2) + "\n"),
-        (html_path, render_html(doc)),
+        (html_path, render_html(doc, bowtie_href=bowtie_href)),
     ):
         if not path:
             continue

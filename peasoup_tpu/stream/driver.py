@@ -100,6 +100,9 @@ class StreamConfig:
     # performance
     warmup: bool = True  # AOT-compile the chunk programs before ingest
     flush_every: int = 1  # rolling-table rewrite cadence (chunks)
+    # fleet observability: append-only time series (obs/metrics.py) of
+    # chunk latency / queue depth / triggers — "" disables
+    metrics_jsonl: str = ""
 
 
 @dataclass
@@ -387,6 +390,12 @@ class StreamingSearch:
         tail = jnp.zeros((ndm, hold), jnp.uint8)
 
         # --- ingest ----------------------------------------------------
+        from ..obs.metrics import MetricsRecorder
+
+        metrics = MetricsRecorder(
+            cfg.metrics_jsonl or os.path.join(cfg.outdir, "metrics.jsonl"),
+            enabled=bool(cfg.metrics_jsonl),
+        )
         sink = TriggerSink(cfg.outdir, limit=cfg.limit, run_id=tel.run_id)
         self._sink = sink
         q = BoundedBlockQueue(cfg.queue_blocks, cfg.policy)
@@ -569,6 +578,7 @@ class StreamingSearch:
                     latency_s=round(lat, 4), slo_s=cfg.latency_slo_s,
                     misses=miss,
                 )
+            metrics.observe("chunk_latency_seconds", lat)
 
             # --- compile accounting (the zero-recompile contract) -----
             from ..campaign.runner import jit_programs_compiled
@@ -608,6 +618,8 @@ class StreamingSearch:
                     sample=rec["sample"], width=rec["width"],
                     latency_s=rec["latency_s"],
                 )
+            if confirmed:
+                metrics.counter("triggers_total", len(confirmed))
             if confirmed or (k % max(1, cfg.flush_every)) == 0:
                 sink.flush_table()
             timers["clustering"] += time.perf_counter() - t0
@@ -615,6 +627,10 @@ class StreamingSearch:
             if t_done - t_last_status > 1.0:
                 t_last_status = t_done
                 st = self._status_section()
+                metrics.gauge(
+                    "queue_depth_blocks",
+                    st.get("queue_depth_blocks", 0) or 0,
+                )
                 tel.gauge("stream.queue_depth", st.get(
                     "queue_depth_blocks", 0
                 ))

@@ -53,7 +53,10 @@ from ..obs import get_logger
 log = get_logger("tools.chaos")
 
 REPORT_SCHEMA = "peasoup_tpu.chaos_report"
-REPORT_VERSION = 3  # v3: preempt/gang/autoscale in the fleet schedule
+# v3: preempt/gang/autoscale in the fleet schedule
+# v4: fleet "observability" section — schema-valid metrics series,
+#     exposition round-trip, per-job trace connectivity/unclosed spans
+REPORT_VERSION = 4
 
 DEFAULT_CAMPAIGN_FAULTS = (
     "fil.read:p=0.25:n=4,db.ingest:at=1,worker.kill:at=obs0"
@@ -1064,6 +1067,116 @@ def run_fleet_soak(
                     f"{g.get('nprocs')}"
                 )
 
+    # --- fleet observability: metrics series + connected traces ------
+    # (ISSUE 14) the soak is ALSO the proof of the observability layer:
+    # every worker's time series must be schema-valid and render a
+    # parseable Prometheus exposition with nonzero queue-depth (and,
+    # when a preemption was drilled, nonzero preemption-latency)
+    # samples covering the soak window; every terminal job's span files
+    # must merge into ONE connected trace with zero unclosed spans —
+    # the preempted-and-resumed job showing both attempts plus the
+    # revoke span, and the gang job showing both members' processes.
+    from ..obs import metrics as obs_metrics
+    from ..obs.trace import load_spans, trace_paths, trace_summary
+
+    obs_section: dict = {"metrics": {}, "traces": {}}
+    try:
+        fleet_metrics = obs_metrics.fleet_samples(root, validate=True)
+    except Exception as exc:
+        fleet_metrics = {}
+        violations.append(
+            f"metrics series schema-invalid: {exc!s:.200}"
+        )
+    n_samples = sum(len(v) for v in fleet_metrics.values())
+    obs_section["metrics"]["sources"] = sorted(fleet_metrics)
+    obs_section["metrics"]["samples"] = n_samples
+    if not n_samples:
+        violations.append("fleet wrote no metrics samples")
+    try:
+        expo = obs_metrics.prometheus_exposition(fleet_metrics)
+        obs_section["metrics"]["exposition_series"] = len(
+            obs_metrics.parse_exposition(expo)
+        )
+    except Exception as exc:
+        violations.append(
+            f"Prometheus exposition failed to render/parse: {exc!s:.200}"
+        )
+    qdepth = obs_metrics.series(fleet_metrics, "queue_depth", "gauge")
+    if not qdepth or max(r["value"] for r in qdepth) <= 0:
+        violations.append(
+            "queue_depth series empty or all-zero over the soak"
+        )
+    else:
+        obs_section["metrics"]["queue_depth_samples"] = len(qdepth)
+        obs_section["metrics"]["queue_depth_span_s"] = round(
+            qdepth[-1]["t"] - qdepth[0]["t"], 3
+        )
+        if qdepth[-1]["t"] - qdepth[0]["t"] <= 0:
+            violations.append(
+                "queue_depth series does not span the soak window"
+            )
+    plat = obs_metrics.series(
+        fleet_metrics, "preemption_latency_seconds", "hist"
+    )
+    if n_urgent:
+        if not plat or max(r["value"] for r in plat) <= 0:
+            violations.append(
+                "preemption drilled but no nonzero "
+                "preemption_latency_seconds metric recorded"
+            )
+        else:
+            obs_section["metrics"]["preemption_latency_max_s"] = round(
+                max(r["value"] for r in plat), 4
+            )
+    preempted_ids = {
+        d.get("job_id") for d in done if d.get("preemptions")
+    }
+    for j in job_ids:
+        spans = load_spans(trace_paths(os.path.join(root, "jobs", j)))
+        summ = trace_summary(spans)
+        obs_section["traces"][j] = {
+            "n_spans": summ["n_spans"],
+            "trace_ids": summ["trace_ids"],
+            "connected": summ["connected"],
+            "workers": summ["workers"],
+            "unclosed": summ["unclosed"],
+            "attempts": sum(
+                1 for s in spans if s.get("name") == "job_attempt"
+            ),
+        }
+        if not spans:
+            violations.append(f"job {j}: no trace spans written")
+            continue
+        if not summ["connected"]:
+            violations.append(
+                f"job {j}: trace NOT connected (trace_ids "
+                f"{summ['trace_ids']})"
+            )
+        if summ["unclosed"]:
+            violations.append(
+                f"job {j}: {summ['unclosed']} unclosed span(s)"
+            )
+        names = set(summ["span_names"])
+        if j in preempted_ids:
+            n_attempts = obs_section["traces"][j]["attempts"]
+            if n_attempts < 2:
+                violations.append(
+                    f"preempted job {j}: trace shows {n_attempts} "
+                    "attempt span(s), expected the original AND the "
+                    "resume in one connected trace"
+                )
+            if "revoke" not in names:
+                violations.append(
+                    f"preempted job {j}: no revoke-latency span in "
+                    "its trace"
+                )
+        if j in gang_job_ids and len(summ["workers"]) < 2:
+            violations.append(
+                f"gang job {j}: trace spans from "
+                f"{summ['workers']} — expected both members' "
+                "processes in one connected trace"
+            )
+
     # --- autoscale attribution ----------------------------------------
     scale_section = None
     if controller is not None:
@@ -1107,6 +1220,7 @@ def run_fleet_soak(
         "preemption": preempt_section,
         "gang": gang_section,
         "autoscale": scale_section,
+        "observability": obs_section,
         "violations": violations,
     }
 

@@ -209,6 +209,190 @@ class CandidatePlotter:
         return fig
 
 
+# --------------------------------------------------------------------------
+# DM-time bowtie / waterfall diagnostic (self-contained SVG, no matplotlib)
+# --------------------------------------------------------------------------
+
+def render_bowtie_svg(
+    times_s,
+    dms,
+    snrs,
+    widths=None,
+    title: str = "DM-time bowtie",
+    width_px: int = 920,
+    height_px: int = 430,
+    min_snr: float = 0.0,
+) -> str:
+    """The classic single-pulse diagnostic: every detection scattered
+    in (time, DM) with marker area scaling with S/N. A real dispersed
+    pulse traces the bowtie (S/N peaking at the true DM and fading
+    symmetrically above/below); RFI stripes the DM axis at constant
+    time. Pure-SVG by construction — no matplotlib, so the plot can be
+    generated headless and embedded verbatim in the sift HTML report.
+    """
+    times = np.asarray(times_s, dtype=float)
+    dms = np.asarray(dms, dtype=float)
+    snrs = np.asarray(snrs, dtype=float)
+    keep = snrs >= float(min_snr)
+    times, dms, snrs = times[keep], dms[keep], snrs[keep]
+    widths_arr = (
+        np.asarray(widths)[keep] if widths is not None else None
+    )
+    ml, mr, mt, mb = 64, 18, 34, 46  # margins
+    pw, ph = width_px - ml - mr, height_px - mt - mb
+    parts = [
+        f'<svg xmlns="http://www.w3.org/2000/svg" width="{width_px}" '
+        f'height="{height_px}" viewBox="0 0 {width_px} {height_px}" '
+        f'font-family="system-ui, sans-serif">',
+        f'<rect width="{width_px}" height="{height_px}" fill="#ffffff"/>',
+        f'<rect x="{ml}" y="{mt}" width="{pw}" height="{ph}" '
+        f'fill="#f8f9fb" stroke="#c8ccd4"/>',
+        f'<text x="{ml}" y="20" font-size="14" fill="#1a1a2e">'
+        f"{_esc(title)} — {times.size} events</text>",
+    ]
+    if times.size == 0:
+        parts.append(
+            f'<text x="{ml + pw / 2:.0f}" y="{mt + ph / 2:.0f}" '
+            f'font-size="13" fill="#666" text-anchor="middle">'
+            "no single-pulse events</text></svg>"
+        )
+        return "".join(parts)
+    t0, t1 = float(times.min()), float(times.max())
+    d0, d1 = float(dms.min()), float(dms.max())
+    tspan = (t1 - t0) or 1.0
+    dspan = (d1 - d0) or 1.0
+    s0, s1 = float(snrs.min()), float(snrs.max())
+    sspan = (s1 - s0) or 1.0
+
+    def _x(t: float) -> float:
+        return ml + (t - t0) / tspan * pw
+
+    def _y(d: float) -> float:
+        return mt + ph - (d - d0) / dspan * ph
+
+    # axes: 5 ticks each
+    for i in range(6):
+        tx = t0 + tspan * i / 5.0
+        x = _x(tx)
+        parts.append(
+            f'<line x1="{x:.1f}" y1="{mt + ph}" x2="{x:.1f}" '
+            f'y2="{mt + ph + 4}" stroke="#888"/>'
+            f'<text x="{x:.1f}" y="{mt + ph + 17}" font-size="10" '
+            f'fill="#444" text-anchor="middle">{tx:.3g}</text>'
+        )
+        dv = d0 + dspan * i / 5.0
+        y = _y(dv)
+        parts.append(
+            f'<line x1="{ml - 4}" y1="{y:.1f}" x2="{ml}" y2="{y:.1f}" '
+            f'stroke="#888"/>'
+            f'<text x="{ml - 7}" y="{y + 3:.1f}" font-size="10" '
+            f'fill="#444" text-anchor="end">{dv:.4g}</text>'
+        )
+    parts.append(
+        f'<text x="{ml + pw / 2:.0f}" y="{height_px - 10}" '
+        f'font-size="11" fill="#1a1a2e" text-anchor="middle">'
+        "Time (s)</text>"
+        f'<text x="14" y="{mt + ph / 2:.0f}" font-size="11" '
+        f'fill="#1a1a2e" text-anchor="middle" '
+        f'transform="rotate(-90 14 {mt + ph / 2:.0f})">'
+        "DM (pc cm&#8315;&#179;)</text>"
+    )
+    # strongest drawn last (on top); radius grows with S/N
+    order = np.argsort(snrs)
+    for i in order:
+        r = 1.5 + 6.5 * (snrs[i] - s0) / sspan
+        extra = (
+            f"w={int(widths_arr[i])} " if widths_arr is not None else ""
+        )
+        parts.append(
+            f'<circle cx="{_x(times[i]):.1f}" cy="{_y(dms[i]):.1f}" '
+            f'r="{r:.2f}" fill="#2563eb" fill-opacity="0.45" '
+            f'stroke="none"><title>t={times[i]:.4f}s DM={dms[i]:.2f} '
+            f"S/N={snrs[i]:.1f} {extra}</title></circle>"
+        )
+    parts.append(
+        f'<text x="{width_px - mr}" y="20" font-size="10" fill="#666" '
+        f'text-anchor="end">S/N {s0:.1f}&#8211;{s1:.1f} '
+        "(area &#8733; S/N)</text></svg>"
+    )
+    return "".join(parts)
+
+
+def _esc(s: str) -> str:
+    return (
+        str(s).replace("&", "&amp;").replace("<", "&lt;")
+        .replace(">", "&gt;")
+    )
+
+
+def bowtie_from_singlepulse(path: str, **kw) -> str:
+    """Bowtie SVG from a ``.singlepulse`` text table
+    (io.output.write_singlepulse / tools.parsers.read_singlepulse)."""
+    from .parsers import read_singlepulse
+
+    cands = read_singlepulse(path)
+    return render_bowtie_svg(
+        cands["time_s"], cands["dm"], cands["snr"],
+        widths=cands["width"],
+        title=f"DM-time bowtie — {path.split('/')[-1]}",
+        **kw,
+    )
+
+
+def bowtie_from_db(db_path: str, job_id: str | None = None, **kw) -> str:
+    """Bowtie SVG over a campaign database's single-pulse candidates
+    (optionally one job's), with per-observation time offsets from
+    tstart so a multi-observation campaign lays out on one axis."""
+    from ..campaign.db import CandidateDB
+
+    with CandidateDB(db_path) as db:
+        rows = db.all_candidates(kind="single_pulse")
+    if job_id is not None:
+        rows = [r for r in rows if r.get("job_id") == job_id]
+    if rows:
+        t0_mjd = min(float(r.get("obs_tstart") or 0.0) for r in rows)
+    times, dms, snrs, widths = [], [], [], []
+    for r in rows:
+        day_off = (float(r.get("obs_tstart") or 0.0) - t0_mjd) * 86400.0
+        times.append(day_off + float(r.get("time_s") or 0.0))
+        dms.append(float(r.get("dm") or 0.0))
+        snrs.append(float(r.get("snr") or 0.0))
+        widths.append(int(r.get("width") or 0))
+    title = "DM-time bowtie — campaign DB" + (
+        f" [{job_id}]" if job_id else ""
+    )
+    return render_bowtie_svg(
+        times, dms, snrs, widths=widths, title=title, **kw
+    )
+
+
+def bowtie_main(argv=None) -> int:
+    """``peasoup-bowtie`` — render the DM-time bowtie diagnostic from
+    a campaign DB (candidates.sqlite) or a .singlepulse table."""
+    import argparse
+
+    p = argparse.ArgumentParser(prog="peasoup-bowtie")
+    p.add_argument(
+        "source",
+        help="candidates.sqlite (campaign DB) or a .singlepulse table",
+    )
+    p.add_argument("-o", "--outfile", default="bowtie.svg")
+    p.add_argument("--job", default=None,
+                   help="restrict a DB source to one job id")
+    p.add_argument("--min-snr", type=float, default=0.0)
+    args = p.parse_args(argv)
+    if args.source.endswith(".singlepulse"):
+        svg = bowtie_from_singlepulse(args.source, min_snr=args.min_snr)
+    else:
+        svg = bowtie_from_db(
+            args.source, job_id=args.job, min_snr=args.min_snr
+        )
+    with open(args.outfile, "w") as f:
+        f.write(svg)
+    print(args.outfile)
+    return 0
+
+
 def main(argv=None) -> int:
     import argparse
 

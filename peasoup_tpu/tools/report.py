@@ -189,6 +189,50 @@ def _render_merged_sections(man: dict) -> list[str]:
     return lines
 
 
+def render_timeline(man: dict, width: int = 60) -> str:
+    """Historical timeline view of one run: the ``stage`` transition
+    events rendered as a gantt over the run's wall clock, with the
+    remaining adaptive events as markers — "what was this run doing
+    when" from the manifest alone (no live heartbeat needed)."""
+    events = man.get("events") or []
+    duration = float(man.get("duration_s") or 0.0)
+    stages: list[tuple[str, float, float]] = []  # (name, t0, t1)
+    cur: tuple[str, float] | None = None
+    for rec in events:
+        if rec.get("kind") != "stage":
+            continue
+        t = float(rec.get("t", 0.0))
+        if cur is not None:
+            stages.append((cur[0], cur[1], t))
+        cur = (str(rec.get("name", "?")), t)
+    if cur is not None:
+        stages.append((cur[0], cur[1], max(duration, cur[1])))
+    if not stages:
+        return (
+            "no stage events in this manifest (older writer?) — "
+            "nothing to draw\n"
+        )
+    total = max(duration, stages[-1][2]) or 1.0
+    lines = [
+        f"timeline: run {man.get('run_id', '?')}  "
+        f"{total:.3f}s wall  ({len(stages)} stage segments)",
+        f"  {'stage':<16} 0s{' ' * (width - 6)}{total:8.2f}s",
+    ]
+    for name, t0, t1 in stages:
+        lo = int(t0 / total * width)
+        hi = max(lo + 1, int(t1 / total * width))
+        bar = " " * lo + "#" * (hi - lo) + " " * (width - hi)
+        lines.append(f"  {name:<16} |{bar}|  {t1 - t0:8.3f}s")
+    marks = [" "] * width
+    other = [r for r in events if r.get("kind") != "stage"]
+    for rec in other:
+        i = min(width - 1, int(float(rec.get("t", 0.0)) / total * width))
+        marks[i] = "*"
+    if other:
+        lines.append(f"  {'events':<16} |{''.join(marks)}|  ({len(other)})")
+    return "\n".join(lines) + "\n"
+
+
 def diff(a: dict, b: dict, max_events: int = 0) -> str:
     """Aligned comparison of two manifests (timers + counters/gauges):
     the 'why did this BENCH number move' view."""
@@ -408,7 +452,17 @@ def main(argv: list[str] | None = None) -> int:
         help="with --merge: write the merged manifest JSON here "
         "(still renders the summary to stdout)",
     )
+    p.add_argument(
+        "--timeline", action="store_true",
+        help="render one manifest's stage transitions as a wall-clock "
+        "gantt (the historical what-was-it-doing-when view)",
+    )
     args = p.parse_args(argv)
+    if args.timeline:
+        if len(args.manifests) != 1:
+            p.error("--timeline expects exactly one manifest")
+        sys.stdout.write(render_timeline(load_manifest(args.manifests[0])))
+        return 0
     if args.merge:
         if len(args.manifests) < 2:
             p.error("--merge expects at least two per-host shards")

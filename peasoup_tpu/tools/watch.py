@@ -352,6 +352,131 @@ def render_campaign_status(st: dict, stale_after: float = 0.0) -> str:
     return "\n".join(lines) + "\n"
 
 
+_SPARK = " ▁▂▃▄▅▆▇█"
+
+
+def _sparkline_row(values: list[float | None], width: int) -> str:
+    """Unicode sparkline over per-bin values (None = no data)."""
+    present = [v for v in values if v is not None]
+    if not present:
+        return "·" * width
+    lo, hi = min(present), max(present)
+    span = (hi - lo) or 1.0
+    out = []
+    for v in values:
+        if v is None:
+            out.append("·")
+        else:
+            idx = 1 + int((v - lo) / span * (len(_SPARK) - 2))
+            out.append(_SPARK[min(len(_SPARK) - 1, idx)])
+    return "".join(out)
+
+
+def _binned(
+    recs: list[dict], t_lo: float, t_hi: float, width: int,
+    reduce: str = "last",
+) -> list[float | None]:
+    """Bin time-ordered samples into ``width`` slots. ``reduce``:
+    'last' (gauge semantics), 'sum' (histogram counts), 'max'."""
+    bins: list[list[float]] = [[] for _ in range(width)]
+    span = (t_hi - t_lo) or 1.0
+    for rec in recs:
+        t = float(rec.get("t", 0.0))
+        if t < t_lo or t > t_hi:
+            continue
+        i = min(width - 1, int((t - t_lo) / span * width))
+        bins[i].append(float(rec.get("value", 0.0)))
+    out: list[float | None] = []
+    for b in bins:
+        if not b:
+            out.append(None)
+        elif reduce == "sum":
+            out.append(sum(b))
+        elif reduce == "max":
+            out.append(max(b))
+        else:
+            out.append(b[-1])
+    return out
+
+
+def render_metrics_history(
+    samples_by_source: dict, width: int = 48, window_s: float = 3600.0
+) -> str:
+    """The historical timeline view over a campaign's per-worker
+    time-series files (obs/metrics.py): queue depth, completion and
+    preemption-latency series rendered as sparklines — "what happened
+    over the last hour" without re-running the soak."""
+    from ..obs.metrics import series
+
+    all_t = [
+        float(r.get("t", 0.0))
+        for recs in samples_by_source.values()
+        for r in recs
+    ]
+    if not all_t:
+        return "no metrics samples found\n"
+    t_hi = max(all_t)
+    t_lo = max(min(all_t), t_hi - window_s)
+    span = max(1.0, t_hi - t_lo)
+    lines = [
+        f"metrics history: {len(samples_by_source)} worker(s), "
+        f"{len(all_t)} samples over {span:.0f}s"
+    ]
+
+    def _row(label: str, values: list, unit: str = "") -> None:
+        present = [v for v in values if v is not None]
+        if not present:
+            return
+        lines.append(
+            f"  {label:<26} {_sparkline_row(values, width)}  "
+            f"min {min(present):g}  max {max(present):g}{unit}"
+        )
+
+    for state in ("pending", "running", "done"):
+        recs = [
+            r
+            for r in series(samples_by_source, "queue_depth", "gauge")
+            if (r.get("labels") or {}).get("state") == state
+        ]
+        _row(f"queue depth [{state}]", _binned(recs, t_lo, t_hi, width, "max"))
+    _row(
+        "jobs done (fleet)",
+        _binned(
+            series(samples_by_source, "jobs_done_total", "counter"),
+            t_lo, t_hi, width, "max",
+        ),
+    )
+    lat = series(
+        samples_by_source, "preemption_latency_seconds", "hist"
+    )
+    _row(
+        "preempt latency (s)", _binned(lat, t_lo, t_hi, width, "max"),
+    )
+    _row(
+        "claim wait (s)",
+        _binned(
+            series(samples_by_source, "claim_wait_seconds", "hist"),
+            t_lo, t_hi, width, "max",
+        ),
+    )
+    _row(
+        "device mem peak (GB)",
+        [
+            (v / 1e9 if v is not None else None)
+            for v in _binned(
+                series(
+                    samples_by_source, "device_memory_peak_bytes",
+                    "gauge",
+                ),
+                t_lo, t_hi, width, "max",
+            )
+        ],
+    )
+    if len(lines) == 1:
+        lines.append("  (no renderable series yet)")
+    return "\n".join(lines) + "\n"
+
+
 def resolve_status_path(path: str) -> str:
     """A directory argument resolves to the campaign rollup inside it
     when one exists (else the single-run status.json)."""
@@ -394,7 +519,36 @@ def main(argv: list[str] | None = None) -> int:
         help="give up after this many seconds without a snapshot "
         "appearing (default: wait forever)",
     )
+    p.add_argument(
+        "--history", action="store_true",
+        help="render the campaign's historical metrics timeline "
+        "(queue depth / throughput / preemption latency sparklines "
+        "from queue/workers/*.metrics.jsonl) and exit",
+    )
+    p.add_argument(
+        "--window", type=float, default=3600.0,
+        help="with --history: how many trailing seconds to render "
+        "(default 3600)",
+    )
     args = p.parse_args(argv)
+
+    if args.history:
+        from ..obs.metrics import fleet_samples
+
+        root = (
+            args.status if os.path.isdir(args.status)
+            else os.path.dirname(os.path.abspath(args.status))
+        )
+        samples = fleet_samples(root)
+        if not samples:
+            sys.stderr.write(
+                f"no metrics files under {root}/queue/workers/\n"
+            )
+            return 1
+        sys.stdout.write(
+            render_metrics_history(samples, window_s=args.window)
+        )
+        return 0
 
     t0 = time.monotonic()
     last_seq = None

@@ -1,0 +1,1111 @@
+"""Fleet observability tests (ISSUE 14): the time-series metrics
+recorder + Prometheus exposition, cross-process trace correlation +
+Chrome/Perfetto export, on-demand device profiling, the rollup
+throughput-decay fix, mixed-schema watch/report tolerance, and the
+DM-time bowtie diagnostic."""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from peasoup_tpu.obs import metrics as obs_metrics
+from peasoup_tpu.obs import trace as obs_trace
+from peasoup_tpu.obs.metrics import (
+    MetricsRecorder,
+    fleet_samples,
+    load_series,
+    parse_exposition,
+    prometheus_exposition,
+    serve_metrics,
+    validate_sample,
+)
+from peasoup_tpu.obs.schema import SchemaError
+from peasoup_tpu.obs.trace import (
+    Tracer,
+    export_chrome_trace,
+    job_span,
+    load_spans,
+    new_trace_id,
+    trace_paths,
+    trace_summary,
+)
+
+
+# --------------------------------------------------------------------------
+# metrics recorder
+# --------------------------------------------------------------------------
+
+class TestMetricsRecorder:
+    def test_counter_is_cumulative(self, tmp_path):
+        p = str(tmp_path / "w.metrics.jsonl")
+        r = MetricsRecorder(p)
+        r.counter("jobs_done_total")
+        r.counter("jobs_done_total", 2)
+        vals = [s["value"] for s in load_series(p)]
+        assert vals == [1, 3]
+
+    def test_counter_series_independent_per_label_set(self, tmp_path):
+        p = str(tmp_path / "w.metrics.jsonl")
+        r = MetricsRecorder(p)
+        r.counter("preemptions_total", event="released")
+        r.counter("preemptions_total", event="retire")
+        r.counter("preemptions_total", event="released")
+        series = [
+            (s["labels"]["event"], s["value"]) for s in load_series(p)
+        ]
+        assert series == [("released", 1), ("retire", 1), ("released", 2)]
+
+    def test_every_line_schema_valid(self, tmp_path):
+        p = str(tmp_path / "w.metrics.jsonl")
+        r = MetricsRecorder(p)
+        r.counter("a_total")
+        r.gauge("queue_depth", 4, state="pending")
+        r.observe("lat_seconds", 0.25)
+        samples = load_series(p, validate=True)  # raises on drift
+        assert [s["kind"] for s in samples] == ["counter", "gauge", "hist"]
+
+    def test_schema_rejects_bad_sample(self):
+        with pytest.raises(SchemaError):
+            validate_sample({"t": 1.0, "name": "x", "kind": "nope",
+                             "value": 1.0})
+        with pytest.raises(SchemaError):
+            validate_sample({"t": 1.0, "name": "x", "kind": "gauge"})
+        with pytest.raises(SchemaError):
+            validate_sample(
+                {"t": 1.0, "name": "x", "kind": "gauge", "value": 1.0,
+                 "labels": {"a": 3}}  # label values must be strings
+            )
+
+    def test_rotation_bounds_file_and_keeps_counters_monotone(
+        self, tmp_path
+    ):
+        p = str(tmp_path / "w.metrics.jsonl")
+        r = MetricsRecorder(p, max_bytes=2000, keep_bytes=800)
+        for _ in range(200):
+            r.counter("spam_total")
+        assert os.path.getsize(p) <= 2100  # bounded (one line slack)
+        vals = [s["value"] for s in load_series(p, validate=True)]
+        # the newest tail survived and the cumulative total kept
+        # counting across the rotation (carried in recorder memory)
+        assert vals == sorted(vals)
+        assert vals[-1] == 200
+        assert len(vals) < 200
+
+    def test_disabled_recorder_writes_nothing(self, tmp_path):
+        p = str(tmp_path / "w.metrics.jsonl")
+        r = MetricsRecorder(p, enabled=False)
+        r.counter("a_total")
+        r.gauge("g", 1)
+        r.observe("h", 1)
+        assert not os.path.exists(p)
+
+    def test_torn_tail_skipped(self, tmp_path):
+        p = str(tmp_path / "w.metrics.jsonl")
+        r = MetricsRecorder(p)
+        r.gauge("g", 1)
+        with open(p, "a") as f:
+            f.write('{"t": 5, "name": "g", "ki')  # writer died mid-line
+        assert len(load_series(p)) == 1
+
+
+# --------------------------------------------------------------------------
+# exposition + aggregation
+# --------------------------------------------------------------------------
+
+class TestExposition:
+    def _samples(self, tmp_path):
+        p = str(tmp_path / "w1.metrics.jsonl")
+        r = MetricsRecorder(p)
+        r.counter("jobs_done_total")
+        r.gauge("queue_depth", 3, state="pending")
+        for v in (0.1, 0.4, 2.0):
+            r.observe("preemption_latency_seconds", v)
+        return {"w1": load_series(p)}
+
+    def test_exposition_renders_and_parses(self, tmp_path):
+        text = prometheus_exposition(self._samples(tmp_path))
+        parsed = parse_exposition(text)
+        by_name = {}
+        for name, labels, value in parsed:
+            by_name.setdefault(name, []).append((labels, value))
+        assert by_name["peasoup_jobs_done_total"][0][1] == 1
+        [(labels, depth)] = by_name["peasoup_queue_depth"]
+        assert labels == {"state": "pending", "worker": "w1"}
+        assert depth == 3
+        # histogram triplet: cumulative buckets + sum + count
+        assert by_name["peasoup_preemption_latency_seconds_count"][0][1] == 3
+        assert by_name["peasoup_preemption_latency_seconds_sum"][0][1] == (
+            pytest.approx(2.5)
+        )
+        buckets = {
+            labels["le"]: v
+            for labels, v in by_name[
+                "peasoup_preemption_latency_seconds_bucket"
+            ]
+        }
+        assert buckets["+Inf"] == 3
+        assert buckets["0.25"] == 1
+        # TYPE comments present
+        assert "# TYPE peasoup_queue_depth gauge" in text
+        assert "# TYPE peasoup_jobs_done_total counter" in text
+        assert (
+            "# TYPE peasoup_preemption_latency_seconds histogram" in text
+        )
+
+    def test_gauge_last_value_wins(self, tmp_path):
+        p = str(tmp_path / "w1.metrics.jsonl")
+        r = MetricsRecorder(p)
+        r.gauge("queue_depth", 5, state="pending")
+        r.gauge("queue_depth", 2, state="pending")
+        text = prometheus_exposition({"w1": load_series(p)})
+        [(_, labels, v)] = [
+            t for t in parse_exposition(text)
+            if t[0] == "peasoup_queue_depth"
+        ]
+        assert v == 2
+
+    def test_label_escaping_round_trips(self, tmp_path):
+        p = str(tmp_path / "w1.metrics.jsonl")
+        r = MetricsRecorder(p)
+        r.gauge("g", 1, reason='he said "no", then \\left')
+        text = prometheus_exposition({"w1": load_series(p)})
+        [(_, labels, _v)] = parse_exposition(
+            "\n".join(
+                ln for ln in text.splitlines() if not ln.startswith("#")
+            )
+        )
+        assert labels["reason"] == 'he said "no", then \\left'
+
+    def test_parse_rejects_malformed(self):
+        with pytest.raises(ValueError):
+            parse_exposition("peasoup_x{le=0.5} 1")  # unquoted label
+        with pytest.raises(ValueError):
+            parse_exposition("not a metric line at all!!! x")
+
+    def test_series_query_orders_and_tags(self, tmp_path):
+        samples = {
+            "w2": [{"t": 2.0, "name": "queue_depth", "kind": "gauge",
+                    "value": 1.0}],
+            "w1": [{"t": 1.0, "name": "queue_depth", "kind": "gauge",
+                    "value": 4.0}],
+        }
+        s = obs_metrics.series(samples, "queue_depth", "gauge")
+        assert [(r["source"], r["value"]) for r in s] == [
+            ("w1", 4.0), ("w2", 1.0),
+        ]
+
+    def test_fleet_samples_globs_workers_dir(self, tmp_path):
+        root = tmp_path / "camp"
+        wdir = root / "queue" / "workers"
+        wdir.mkdir(parents=True)
+        for w in ("a", "b"):
+            MetricsRecorder(str(wdir / f"{w}.metrics.jsonl")).gauge("g", 1)
+        assert sorted(fleet_samples(str(root))) == ["a", "b"]
+
+    def test_serve_metrics_http_endpoint(self, tmp_path):
+        root = tmp_path / "camp"
+        wdir = root / "queue" / "workers"
+        wdir.mkdir(parents=True)
+        MetricsRecorder(str(wdir / "w.metrics.jsonl")).counter("up_total")
+        # port 0 → ephemeral; serve exactly one request on a thread
+        srv = threading.Thread(
+            target=serve_metrics,
+            args=(str(root),),
+            kwargs={"port": 0, "max_requests": 1},
+            daemon=True,
+        )
+        # find the port by racing is fragile; instead serve on a fixed
+        # ephemeral port chosen by binding a socket first
+        import socket as _socket
+
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        srv = threading.Thread(
+            target=serve_metrics,
+            args=(str(root),),
+            kwargs={"port": port, "max_requests": 1},
+            daemon=True,
+        )
+        srv.start()
+        deadline = time.monotonic() + 5
+        body = None
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=2
+                ) as resp:
+                    body = resp.read().decode()
+                break
+            except OSError:
+                time.sleep(0.05)
+        srv.join(timeout=5)
+        assert body is not None and "peasoup_up_total" in body
+        parse_exposition(body)
+
+
+# --------------------------------------------------------------------------
+# tracer
+# --------------------------------------------------------------------------
+
+class TestTracer:
+    def test_span_instant_span_at(self, tmp_path):
+        p = str(tmp_path / "trace-w1.jsonl")
+        tr = Tracer(p, "t" * 16, worker="w1")
+        with tr.span("wave", wave=0):
+            pass
+        tr.instant("checkpoint_saved", wave=0)
+        tr.span_at("claim_wait", 100.0, 0.5)
+        tr.close()
+        spans = load_spans(p)
+        names = [s["name"] for s in spans]
+        assert sorted(names) == ["checkpoint_saved", "claim_wait", "wave"]
+        summ = trace_summary(spans)
+        assert summ["connected"] and summ["unclosed"] == 0
+        assert summ["workers"] == ["w1"]
+        by = {s["name"]: s for s in spans}
+        assert by["claim_wait"]["ts_unix"] == 100.0
+        assert by["claim_wait"]["dur_s"] == 0.5
+        assert by["checkpoint_saved"]["instant"] is True
+
+    def test_close_force_ends_open_spans(self, tmp_path):
+        p = str(tmp_path / "trace-w1.jsonl")
+        tr = Tracer(p, "t" * 16, worker="w1")
+        tr.begin("job_attempt")
+        tr.close()
+        [span] = load_spans(p)
+        assert span["forced_end"] is True
+        assert isinstance(span["dur_s"], float)
+        assert trace_summary([span])["unclosed"] == 0
+
+    def test_disabled_tracer_writes_nothing(self, tmp_path):
+        p = str(tmp_path / "trace-w1.jsonl")
+        tr = Tracer(p, "t" * 16, enabled=False)
+        with tr.span("x"):
+            pass
+        tr.instant("y")
+        tr.close()
+        assert not os.path.exists(p)
+
+    def test_job_span_noop_without_ambient_tracer(self):
+        with job_span("wave", wave=0):  # must not raise or write
+            pass
+
+    def test_job_span_uses_ambient_tracer(self, tmp_path):
+        p = str(tmp_path / "trace-w1.jsonl")
+        tr = Tracer(p, "t" * 16, worker="w1")
+        with tr.activate():
+            with job_span("wave", wave=3):
+                pass
+        tr.close()
+        [span] = load_spans(p)
+        assert span["name"] == "wave" and span["args"]["wave"] == 3
+
+    def test_telemetry_bridge_stages_and_instants(self, tmp_path):
+        from peasoup_tpu.obs.telemetry import RunTelemetry
+
+        p = str(tmp_path / "trace-w1.jsonl")
+        tel = RunTelemetry()
+        tr = Tracer(p, "t" * 16, worker="w1")
+        tr.attach(tel)
+        tel.set_stage("reading")
+        tel.set_stage("searching")  # closes reading, opens searching
+        tel.event("dedisp_plan", engine="exact")
+        tr.close()
+        spans = load_spans(p)
+        names = {s["name"] for s in spans}
+        assert {"stage:reading", "stage:searching", "dedisp_plan"} <= names
+        reading = next(s for s in spans if s["name"] == "stage:reading")
+        assert "forced_end" not in reading  # closed by the transition
+        plan = next(s for s in spans if s["name"] == "dedisp_plan")
+        assert plan["instant"] is True and plan["args"]["engine"] == "exact"
+        # detach on close: later events must not write
+        n = len(spans)
+        tel.event("late")
+        assert len(load_spans(p)) == n
+
+    def test_two_workers_one_connected_trace(self, tmp_path):
+        tid = new_trace_id()
+        job_dir = tmp_path / "jobs" / "j1"
+        for w in ("w1", "w2"):
+            tr = Tracer(
+                str(job_dir / f"trace-{w}.jsonl"), tid, worker=w
+            )
+            with tr.span("job_attempt"):
+                pass
+            tr.close()
+        spans = load_spans(trace_paths(str(job_dir)))
+        summ = trace_summary(spans)
+        assert summ["connected"] is True
+        assert summ["workers"] == ["w1", "w2"]
+        assert summ["trace_ids"] == [tid]
+
+    def test_different_trace_ids_not_connected(self, tmp_path):
+        job_dir = tmp_path / "j"
+        for w, tid in (("w1", "a" * 16), ("w2", "b" * 16)):
+            tr = Tracer(str(job_dir / f"trace-{w}.jsonl"), tid, worker=w)
+            tr.instant("x")
+            tr.close()
+        assert trace_summary(
+            load_spans(trace_paths(str(job_dir)))
+        )["connected"] is False
+
+    def test_chrome_export(self, tmp_path):
+        p = str(tmp_path / "trace-w1.jsonl")
+        tr = Tracer(p, "t" * 16, worker="w1")
+        with tr.span("wave"):
+            pass
+        tr.instant("mark")
+        tr.close()
+        doc = export_chrome_trace(
+            load_spans(p),
+            extra_instants=[
+                {"name": "autoscale:up", "ts_unix": time.time()}
+            ],
+        )
+        evs = doc["traceEvents"]
+        phs = [e["ph"] for e in evs]
+        assert "M" in phs and "X" in phs and "i" in phs
+        meta = [e for e in evs if e["ph"] == "M"]
+        assert {m["args"]["name"] for m in meta} == {"w1", "campaign"}
+        x = next(e for e in evs if e["ph"] == "X")
+        assert x["args"]["trace_id"] == "t" * 16
+        assert x["ts"] >= 0 and x["dur"] >= 0
+        # json-serialisable end to end
+        json.dumps(doc)
+
+    def test_load_spans_skips_torn_tail(self, tmp_path):
+        p = str(tmp_path / "trace-w1.jsonl")
+        tr = Tracer(p, "t" * 16, worker="w1")
+        tr.instant("ok")
+        tr.close()
+        with open(p, "a") as f:
+            f.write('{"trace_id": "t", "name": "torn"')
+        assert [s["name"] for s in load_spans(p)] == ["ok"]
+
+
+# --------------------------------------------------------------------------
+# on-demand profiling
+# --------------------------------------------------------------------------
+
+class TestProfiler:
+    def test_cpu_guarded_noop(self, tmp_path):
+        from peasoup_tpu.obs.profiler import capture_device_profile
+
+        out = capture_device_profile(str(tmp_path / "prof"), 0.2)
+        assert out["captured"] is False
+        assert "cpu" in (out["skipped"] or "")
+        assert not os.path.exists(str(tmp_path / "prof"))
+
+    def test_allow_cpu_really_captures(self, tmp_path):
+        from peasoup_tpu.obs.profiler import capture_device_profile
+
+        out = capture_device_profile(
+            str(tmp_path / "prof"), 0.2, allow_cpu=True
+        )
+        assert out["captured"] is True
+        assert os.path.isdir(out["outdir"])
+        assert out["seconds"] >= 0.2
+
+    def test_duration_is_bounded(self, tmp_path):
+        from peasoup_tpu.obs import profiler
+
+        t0 = time.perf_counter()
+        out = profiler.capture_device_profile(
+            str(tmp_path / "p"), duration_s=10_000.0
+        )
+        # CPU no-op returns immediately, but the requested duration
+        # must already be clamped to the ceiling
+        assert out["requested_s"] == profiler.MAX_CAPTURE_S
+        assert time.perf_counter() - t0 < profiler.MAX_CAPTURE_S
+
+    def test_registry_request_round_trip(self, tmp_path):
+        from peasoup_tpu.campaign.registry import WorkerRegistry
+
+        reg = WorkerRegistry(str(tmp_path))
+        reg.register("w1")
+        assert reg.profile_requested("w1") is None
+        reg.request_profile("w1", seconds=2.5, requester="op")
+        req = reg.profile_requested("w1")
+        assert req["seconds"] == 2.5 and req["requester"] == "op"
+        reg.clear_profile("w1")
+        assert reg.profile_requested("w1") is None
+
+    def test_orphaned_profile_request_reaped(self, tmp_path):
+        from peasoup_tpu.campaign.registry import WorkerRegistry
+
+        reg = WorkerRegistry(str(tmp_path))
+        reg.register("gone")
+        reg.request_profile("gone")
+        reg.deregister("gone")
+        # deregister answers the pending request
+        assert reg.profile_requested("gone") is None
+        reg.register("gone2")
+        reg.request_profile("gone2")
+        os.unlink(reg._path("gone2"))  # simulated SIGKILL + reap
+        reg.reap()
+        assert reg.profile_requested("gone2") is None
+
+    def test_metrics_file_survives_deregister(self, tmp_path):
+        from peasoup_tpu.campaign.registry import WorkerRegistry
+
+        reg = WorkerRegistry(str(tmp_path))
+        reg.register("w1")
+        MetricsRecorder(reg.metrics_path("w1")).gauge("g", 1)
+        reg.deregister("w1")
+        reg.reap()
+        assert os.path.exists(reg.metrics_path("w1"))
+
+
+# --------------------------------------------------------------------------
+# trace-id propagation through the queue protocol
+# --------------------------------------------------------------------------
+
+class TestTracePropagation:
+    def test_enqueue_mints_and_claim_carries(self, tmp_path):
+        from peasoup_tpu.campaign.queue import Job, JobQueue
+
+        q = JobQueue(str(tmp_path))
+        q.add_job(Job(job_id="a", input="x.fil"))
+        job = q.get_job("a")
+        assert len(job.trace_id) == 16
+        claim = q.try_claim("a", "w1")
+        doc = json.load(open(claim.path))
+        assert doc["trace_id"] == job.trace_id
+
+    def test_preempt_request_carries_trace_id(self, tmp_path):
+        from peasoup_tpu.campaign.queue import Job, JobQueue
+
+        q = JobQueue(str(tmp_path))
+        q.add_job(Job(job_id="a", input="x.fil"))
+        q.try_claim("a", "w1")
+        assert q.request_preempt("a", requester="t") is True
+        req = q.preempt_request("a")
+        assert req["trace_id"] == q.get_job("a").trace_id
+
+    def test_doc_round_trip_preserves_trace_id(self):
+        from peasoup_tpu.campaign.queue import Job
+
+        job = Job(job_id="a", input="x.fil", trace_id="f" * 16)
+        assert Job.from_doc(job.to_doc()).trace_id == "f" * 16
+        # older records without the field load as empty (re-minted on
+        # a future enqueue, never a KeyError)
+        doc = job.to_doc()
+        del doc["trace_id"]
+        assert Job.from_doc(doc).trace_id == ""
+
+
+class TestCarriedResilience:
+    """A released (preempted/retired) attempt's survived-fault
+    counters must ride the job record into the resumed run's done
+    record — otherwise the rollup can no longer attribute injected
+    faults whose attempt was revoked (found by the fleet chaos gate
+    when the preempt drill landed on the flaky reader's claim)."""
+
+    def test_queue_carry_accumulates(self, tmp_path):
+        from peasoup_tpu.campaign.queue import Job, JobQueue
+
+        q = JobQueue(str(tmp_path))
+        q.add_job(Job(job_id="a", input="x.fil"))
+        claim = q.try_claim("a", "w1")
+        q.record_carried_resilience(
+            claim, {"retries": {"fil.read": 2},
+                    "faults_injected": {"fil.read": 2}}
+        )
+        q.release(claim)
+        claim2 = q.try_claim("a", "w2")
+        q.record_carried_resilience(
+            claim2, {"retries": {"fil.read": 1}}
+        )
+        assert claim2.job.carried_resilience == {
+            "retries": {"fil.read": 3},
+            "faults_injected": {"fil.read": 2},
+        }
+        # persisted: a fresh read sees it too
+        assert q.get_job("a").carried_resilience["retries"] == {
+            "fil.read": 3
+        }
+
+    def test_empty_delta_is_noop(self, tmp_path):
+        from peasoup_tpu.campaign.queue import Job, JobQueue
+
+        q = JobQueue(str(tmp_path))
+        q.add_job(Job(job_id="a", input="x.fil"))
+        claim = q.try_claim("a", "w1")
+        before = json.load(open(q._p("jobs", "a")))
+        q.record_carried_resilience(claim, {})
+        assert json.load(open(q._p("jobs", "a"))) == before
+
+    def test_doc_round_trip(self):
+        from peasoup_tpu.campaign.queue import Job
+
+        job = Job(
+            job_id="a", input="x.fil",
+            carried_resilience={"retries": {"fil.read": 2}},
+        )
+        assert Job.from_doc(job.to_doc()).carried_resilience == {
+            "retries": {"fil.read": 2}
+        }
+        doc = job.to_doc()
+        del doc["carried_resilience"]  # pre-PR-14 record
+        assert Job.from_doc(doc).carried_resilience == {}
+
+
+# --------------------------------------------------------------------------
+# rollup: throughput decay + clamped ages (ISSUE satellite)
+# --------------------------------------------------------------------------
+
+class TestRollupRates:
+    def _campaign(self, tmp_path, lease_s=1.0):
+        from peasoup_tpu.campaign.queue import JobQueue
+        from peasoup_tpu.campaign.runner import (
+            CampaignConfig,
+            save_campaign_config,
+        )
+
+        root = str(tmp_path / "camp")
+        os.makedirs(root, exist_ok=True)
+        save_campaign_config(root, CampaignConfig(lease_s=lease_s))
+        return root, JobQueue(root, lease_s=lease_s)
+
+    def _done(self, queue, job_id, worker, finished_unix):
+        from peasoup_tpu.campaign.queue import _atomic_write_json
+
+        _atomic_write_json(
+            queue._p("done", job_id),
+            {
+                "job_id": job_id, "worker_id": worker,
+                "finished_unix": finished_unix, "attempts": 1,
+                "n_candidates": 0,
+            },
+        )
+
+    def test_departed_worker_rate_ages_out(self, tmp_path):
+        from peasoup_tpu.campaign.registry import WorkerRegistry
+        from peasoup_tpu.campaign.rollup import build_status
+
+        root, q = self._campaign(tmp_path)
+        now_unix = time.time()
+        # a departed worker that finished two jobs HOURS ago, and a
+        # live one that finished two jobs just now
+        self._done(q, "j1", "ghost", now_unix - 7200.0)
+        self._done(q, "j2", "ghost", now_unix - 7000.0)
+        self._done(q, "j3", "alive", now_unix - 60.0)
+        self._done(q, "j4", "alive", now_unix - 1.0)
+        WorkerRegistry(root, lease_s=60.0).register("alive")
+        st = build_status(root, q)
+        workers = st["fleet"]["workers"]
+        assert workers["alive"]["live"] is True
+        assert workers["alive"]["jobs_per_h"] is not None
+        assert workers["ghost"]["live"] is False
+        assert workers["ghost"]["jobs_per_h"] is None  # aged out
+        assert workers["ghost"]["rate_stale"] is True
+        assert workers["ghost"]["last_done_age_s"] >= 6000
+
+    def test_recently_departed_worker_keeps_rate(self, tmp_path):
+        from peasoup_tpu.campaign.rollup import build_status
+
+        root, q = self._campaign(tmp_path)
+        now_unix = time.time()
+        self._done(q, "j1", "leaver", now_unix - 20.0)
+        self._done(q, "j2", "leaver", now_unix - 5.0)
+        st = build_status(root, q)
+        rec = st["fleet"]["workers"]["leaver"]
+        # within the decay window: history still meaningful
+        assert rec["live"] is False
+        assert rec["jobs_per_h"] is not None
+
+    def test_ages_clamped_under_clock_skew(self, tmp_path):
+        """A skewed peer's done record / heartbeat stamped in OUR
+        future must clamp to zero, never render negative."""
+        from peasoup_tpu.campaign.registry import WorkerRegistry
+        from peasoup_tpu.campaign.rollup import build_status
+
+        root, q = self._campaign(tmp_path)
+        now_unix = time.time()
+        self._done(q, "j1", "skewed", now_unix + 3600.0)
+        reg = WorkerRegistry(root, lease_s=60.0)
+        reg.register("skewed")
+        # lease stamped far in the future (skewed writer clock)
+        reg.beat("skewed", expires_unix=now_unix + 7200.0)
+        path = reg._path("skewed")
+        doc = json.load(open(path))
+        doc["expires_unix"] = now_unix + 7200.0
+        from peasoup_tpu.campaign.registry import _atomic_write_json
+
+        _atomic_write_json(path, doc)
+        st = build_status(root, q)
+        [w] = [
+            x for x in st["fleet"]["live"]
+            if x["worker_id"] == "skewed"
+        ]
+        assert w["last_beat_s"] >= 0.0
+        assert st["fleet"]["workers"]["skewed"]["last_done_age_s"] == 0.0
+
+
+# --------------------------------------------------------------------------
+# mixed-schema tolerance: report --merge + watch (ISSUE satellite)
+# --------------------------------------------------------------------------
+
+def _manifest(version, run_id, **extra):
+    man = {
+        "schema": "peasoup_tpu.telemetry",
+        "version": version,
+        "run_id": run_id,
+        "created_unix": 1700000000.0 + version,
+    }
+    man.update(extra)
+    return man
+
+
+class TestMixedSchemaShards:
+    def test_merge_v1_v2_v3_side_by_side(self, tmp_path):
+        """Shards written by three manifest generations merge without
+        KeyError; hosts missing a stage are skipped AND attributed."""
+        from peasoup_tpu.obs.schema import validate_manifest
+        from peasoup_tpu.tools.report import merge_manifests, render
+
+        v1 = json.load(
+            open(os.path.join(os.path.dirname(__file__), "data",
+                              "manifest_v1.json"))
+        )
+        v2 = _manifest(
+            2, "v2run", process_index=1, process_count=3,
+            hostname="h2", duration_s=4.0,
+            timers={"searching": 2.0, "dedispersion": 1.0},
+            counters={"search.dm_trials_done": 64},
+            events=[{"t": 0.1, "kind": "stage", "name": "searching"}],
+            aborted=True, abort_reason="sigterm",
+        )
+        v3 = _manifest(
+            3, "v3run", process_index=2, process_count=3,
+            hostname="h3", duration_s=5.0,
+            timers={"searching": 3.5, "dedispersion": "garbage"},
+            counters={"search.dm_trials_done": 64},
+            gauges={"memory.peak_bytes": 5.0},
+            events=[],
+            streaming={"chunks_done": 2},
+        )
+        merged = merge_manifests([v1, v2, v3])
+        validate_manifest(merged)  # merged manifest stays schema-valid
+        assert merged["n_hosts"] == 3
+        # dedispersion is numeric on v1 + v2 but garbage on the v3
+        # shard -> straggler stats over 2 hosts with the broken host
+        # attributed as missing, never a KeyError / poisoned ranking
+        strag = merged["straggler"]["timers"]["dedispersion"]
+        assert strag["n_hosts"] == 2
+        assert [m["hostname"] for m in strag["missing"]] == ["h3"]
+        assert merged["timers"]["searching"] == 9.0  # max across hosts
+        assert merged["aborted"] is True
+        render(merged)  # renders without KeyError too
+
+    def test_merge_gang_member_shards(self, tmp_path):
+        """telemetry.proc<rank>.json shards from a gang job (leader +
+        member, different workers/pids) merge into one manifest."""
+        from peasoup_tpu.tools.report import merge_manifests
+
+        shards = [
+            _manifest(
+                3, "gangrun", process_index=r, process_count=2,
+                hostname=f"w{r}", pid=100 + r, duration_s=2.0 + r,
+                timers={"searching": 1.0 + r},
+                counters={"search.dm_trials_done": 32},
+                events=[{"t": 0.0, "kind": "multihost_slice",
+                         "process": r}],
+            )
+            for r in range(2)
+        ]
+        merged = merge_manifests(shards)
+        assert merged["counters"]["search.dm_trials_done"] == 64
+        assert merged["straggler"]["imbalance"]["slowest"][
+            "hostname"
+        ] == "w1"
+        # events carry their host tag
+        assert {e["process_index"] for e in merged["events"]} == {0, 1}
+
+    def test_watch_renders_old_and_new_snapshots(self):
+        """render_status/render_campaign_status over snapshots missing
+        every new-generation key: .get() tolerance, no KeyError."""
+        from peasoup_tpu.tools.watch import (
+            render_campaign_status,
+            render_status,
+        )
+
+        out = render_status({"run_id": "r", "stage": "searching"})
+        assert "searching" in out
+        # a minimal old-schema campaign rollup (no fleet/preemptions/
+        # metrics/autoscale keys at all)
+        out = render_campaign_status(
+            {"root": "/c", "queue": {"total": 2, "done": 1}}
+        )
+        assert "1/2" in out
+        # and a new-schema one with every section populated
+        out = render_campaign_status(
+            {
+                "root": "/c",
+                "queue": {"total": 2, "done": 2},
+                "fleet": {
+                    "live": [{"worker_id": "w1", "jobs_done": 2}],
+                    "workers": {
+                        "w1": {"done": 2, "jobs_per_h": 3.0,
+                               "live": True},
+                        "ghost": {"done": 1, "jobs_per_h": None,
+                                  "rate_stale": True, "live": False},
+                    },
+                },
+                "preemptions": {"jobs": 1, "total": 1,
+                                "outstanding_requests": 0,
+                                "latency_s": {"mean": 1.0, "max": 2.0}},
+                "gang_jobs": 1,
+                "done": True,
+            }
+        )
+        assert "preemptions" in out and "complete" in out
+
+
+# --------------------------------------------------------------------------
+# watch --history + report --timeline
+# --------------------------------------------------------------------------
+
+class TestTimelines:
+    def test_metrics_history_renders_sparklines(self, tmp_path):
+        from peasoup_tpu.tools.watch import render_metrics_history
+
+        p = str(tmp_path / "w.metrics.jsonl")
+        r = MetricsRecorder(p)
+        for depth in (5, 4, 3, 2, 1, 0):
+            r.gauge("queue_depth", depth, state="pending")
+        r.counter("jobs_done_total")
+        r.observe("preemption_latency_seconds", 1.5)
+        out = render_metrics_history({"w": load_series(p)})
+        assert "queue depth [pending]" in out
+        assert "max 5" in out
+        assert "preempt latency" in out
+
+    def test_metrics_history_empty(self):
+        from peasoup_tpu.tools.watch import render_metrics_history
+
+        assert "no metrics samples" in render_metrics_history({})
+
+    def test_report_timeline_gantt(self):
+        from peasoup_tpu.tools.report import render_timeline
+
+        man = _manifest(
+            3, "r1", duration_s=10.0,
+            events=[
+                {"t": 0.0, "kind": "stage", "name": "reading"},
+                {"t": 1.0, "kind": "stage", "name": "searching"},
+                {"t": 9.0, "kind": "stage", "name": "writing"},
+                {"t": 5.0, "kind": "dedisp_plan", "engine": "exact"},
+            ],
+        )
+        out = render_timeline(man)
+        assert "reading" in out and "searching" in out
+        assert "#" in out and "*" in out
+
+    def test_report_timeline_no_stages(self):
+        from peasoup_tpu.tools.report import render_timeline
+
+        out = render_timeline(_manifest(1, "old", events=[]))
+        assert "no stage events" in out
+
+
+# --------------------------------------------------------------------------
+# bowtie diagnostic (ISSUE satellite)
+# --------------------------------------------------------------------------
+
+class TestBowtie:
+    def _bowtie_events(self, n=60, dm0=40.0, t0=5.0):
+        """Synthetic bowtie: S/N peaks at the true DM, fades away from
+        it, detection times constant (one pulse seen at many trials)."""
+        rng = np.random.default_rng(0)
+        dms = np.linspace(dm0 - 10, dm0 + 10, n)
+        snrs = 12.0 * np.exp(-0.5 * ((dms - dm0) / 3.0) ** 2) + 6.0
+        times = np.full(n, t0) + rng.normal(0, 0.01, n)
+        widths = np.full(n, 4, dtype=int)
+        return times, dms, snrs, widths
+
+    def test_svg_renders_events(self):
+        from peasoup_tpu.tools.plotting import render_bowtie_svg
+
+        times, dms, snrs, widths = self._bowtie_events()
+        svg = render_bowtie_svg(times, dms, snrs, widths=widths)
+        assert svg.startswith("<svg")
+        assert svg.count("<circle") == len(times)
+        assert "DM" in svg and "Time (s)" in svg
+        # strongest event drawn with the biggest radius
+        radii = [
+            float(part.split('r="')[1].split('"')[0])
+            for part in svg.split("<circle")[1:]
+        ]
+        assert max(radii) > min(radii)
+
+    def test_svg_empty_events(self):
+        from peasoup_tpu.tools.plotting import render_bowtie_svg
+
+        svg = render_bowtie_svg([], [], [])
+        assert "no single-pulse events" in svg
+
+    def test_min_snr_filter(self):
+        from peasoup_tpu.tools.plotting import render_bowtie_svg
+
+        times, dms, snrs, _ = self._bowtie_events()
+        svg = render_bowtie_svg(times, dms, snrs, min_snr=10.0)
+        assert svg.count("<circle") == int((snrs >= 10.0).sum())
+
+    def test_bowtie_from_singlepulse_table(self, tmp_path):
+        from peasoup_tpu.core.candidates import SinglePulseCandidate
+        from peasoup_tpu.io.output import write_singlepulse
+        from peasoup_tpu.tools.plotting import bowtie_from_singlepulse
+
+        times, dms, snrs, widths = self._bowtie_events(n=10)
+        cands = [
+            SinglePulseCandidate(
+                dm=float(d), snr=float(s), time_s=float(t),
+                sample=int(t / 0.000256), width=int(w), width_idx=0,
+                dm_idx=i, members=3,
+            )
+            for i, (t, d, s, w) in enumerate(
+                zip(times, dms, snrs, widths)
+            )
+        ]
+        path = str(tmp_path / "c.singlepulse")
+        write_singlepulse(path, cands)
+        svg = bowtie_from_singlepulse(path)
+        assert svg.count("<circle") == 10
+
+    def test_bowtie_from_db(self, tmp_path):
+        from peasoup_tpu.campaign.db import CandidateDB
+        from peasoup_tpu.tools.plotting import bowtie_from_db
+
+        db_path = str(tmp_path / "candidates.sqlite")
+        with CandidateDB(db_path) as db:
+            conn = db._conn
+            for i in range(2):
+                conn.execute(
+                    "INSERT INTO observations (job_id, input, "
+                    "source_name, tstart, tsamp, nchans, nsamps, "
+                    "ingested_unix) VALUES (?,?,?,?,?,?,?,?)",
+                    (f"job{i}", f"/o{i}.fil", f"O{i}",
+                     55000.0 + i * 0.01, 0.000256, 8, 4096, 0.0),
+                )
+                for k in range(3):
+                    conn.execute(
+                        "INSERT INTO candidates (job_id, kind, dm, "
+                        "snr, time_s, sample, width, members) VALUES "
+                        "(?, 'single_pulse', ?, ?, ?, ?, 4, 3)",
+                        (f"job{i}", 40.0 + k, 8.0 + k, 0.5 * k,
+                         int(0.5 * k / 0.000256)),
+                    )
+            conn.commit()
+        svg = bowtie_from_db(db_path)
+        assert svg.count("<circle") == 6
+        # one job only
+        svg = bowtie_from_db(db_path, job_id="job0")
+        assert svg.count("<circle") == 3
+
+    def test_sift_report_links_bowtie(self):
+        from peasoup_tpu.sift.report import render_html
+
+        doc = {
+            "schema": "peasoup_tpu.sift_report", "version": 1,
+            "generated_unix": 0.0,
+            "run": {"run_id": "r", "created_unix": 0.0, "config": {},
+                    "n_folded": 0, "n_catalogue": 0, "n_known": 0,
+                    "n_rfi": 0, "n_sp_sources": 0},
+            "observations": 0, "candidates": {},
+            "tiers": {}, "labels": {}, "known_sources": [],
+            "catalogue": [], "sp_sources": [], "campaign": None,
+        }
+        html = render_html(doc, bowtie_href="bowtie.svg")
+        assert 'href=\'bowtie.svg\'' in html or "bowtie.svg" in html
+        assert "bowtie.svg" not in render_html(doc)
+
+
+# --------------------------------------------------------------------------
+# campaign end-to-end (one tiny observation, full stack)
+# --------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def obs_campaign(tmp_path_factory):
+    """One spsearch job through run_worker with metrics+trace on."""
+    from test_campaign import make_obs
+
+    from peasoup_tpu.campaign.queue import Job, JobQueue, job_id_for
+    from peasoup_tpu.campaign.runner import (
+        CampaignConfig,
+        bucket_for_input,
+        run_worker,
+        save_campaign_config,
+    )
+
+    tmp = tmp_path_factory.mktemp("fleetobs")
+    fil = make_obs(str(tmp / "obs0.fil"))
+    root = str(tmp / "camp")
+    os.makedirs(root)
+    save_campaign_config(
+        root,
+        CampaignConfig(
+            pipeline="spsearch",
+            config={"dm_end": 20.0, "min_snr": 7.0, "n_widths": 6},
+            warmup=False, heartbeat_interval=0.2, backoff_base_s=0.05,
+        ),
+    )
+    q = JobQueue(root)
+    jid = job_id_for(fil)
+    q.add_job(
+        Job(job_id=jid, input=fil, pipeline="spsearch",
+            bucket=bucket_for_input(fil))
+    )
+    tally = run_worker(root, worker_id="w1", poll_s=0.05)
+    return root, jid, tally
+
+
+class TestCampaignEndToEnd:
+    def test_job_completes(self, obs_campaign):
+        _, _, tally = obs_campaign
+        assert tally["done"] == 1
+
+    def test_metrics_written_and_valid(self, obs_campaign):
+        root, _, _ = obs_campaign
+        samples = fleet_samples(root, validate=True)
+        assert "w1" in samples
+        names = {r["name"] for r in samples["w1"]}
+        assert {
+            "queue_depth", "jobs_done_total", "job_duration_seconds",
+            "stage_seconds_total", "claim_wait_seconds",
+        } <= names
+        text = prometheus_exposition(samples)
+        assert parse_exposition(text)
+
+    def test_trace_connected_with_expected_spans(self, obs_campaign):
+        root, jid, _ = obs_campaign
+        from peasoup_tpu.campaign.queue import JobQueue
+
+        spans = load_spans(
+            trace_paths(os.path.join(root, "jobs", jid))
+        )
+        summ = trace_summary(spans)
+        assert summ["connected"] and summ["unclosed"] == 0
+        names = set(summ["span_names"])
+        assert {
+            "job_attempt", "claim_wait", "wave", "checkpoint",
+            "stage:dedispersion", "stage:searching",
+        } <= names
+        # the trace id is the one minted at enqueue
+        assert summ["trace_ids"] == [
+            JobQueue(root).get_job(jid).trace_id
+        ]
+
+    def test_chrome_export_of_real_job(self, obs_campaign):
+        root, jid, _ = obs_campaign
+        doc = export_chrome_trace(
+            load_spans(trace_paths(os.path.join(root, "jobs", jid)))
+        )
+        assert len(doc["traceEvents"]) > 5
+        json.dumps(doc)
+
+    def test_rollup_metrics_summary(self, obs_campaign):
+        root, _, _ = obs_campaign
+        from peasoup_tpu.campaign.rollup import build_status
+
+        st = build_status(root)
+        assert st["metrics"]["files"] >= 1
+        assert st["metrics"]["bytes"] > 0
+
+    def test_profile_request_observed_as_cpu_noop(self, obs_campaign):
+        """Plant a profile.request, run the watcher directly: request
+        cleared, capture announced (skipped on CPU) in the metrics."""
+        from peasoup_tpu.campaign.runner import CampaignRunner
+
+        root, _, _ = obs_campaign
+        runner = CampaignRunner(root, worker_id="w1")
+        runner.registry.register("w1")
+        runner.registry.request_profile("w1", seconds=0.2)
+        runner._observe_profile()
+        assert runner._profile_thread is not None
+        runner._profile_thread.join(timeout=10)
+        assert runner.registry.profile_requested("w1") is None
+        samples = load_series(runner.metrics.path)
+        caps = [
+            s for s in samples
+            if s["name"] == "profile_captures_total"
+        ]
+        assert caps and caps[-1]["labels"]["outcome"] == "skipped"
+        runner.registry.deregister("w1")
+
+
+# --------------------------------------------------------------------------
+# CLI surface
+# --------------------------------------------------------------------------
+
+class TestCLI:
+    def test_metrics_command(self, obs_campaign, capsys):
+        from peasoup_tpu.cli.campaign import main
+
+        root, _, _ = obs_campaign
+        assert main(["metrics", "-w", root]) == 0
+        out = capsys.readouterr().out
+        assert "peasoup_jobs_done_total" in out
+        parse_exposition(out)
+
+    def test_metrics_command_no_files(self, tmp_path, capsys):
+        from peasoup_tpu.cli.campaign import main
+
+        assert main(["metrics", "-w", str(tmp_path)]) == 1
+
+    def test_trace_command(self, obs_campaign, tmp_path, capsys):
+        from peasoup_tpu.cli.campaign import main
+
+        root, jid, _ = obs_campaign
+        out_path = str(tmp_path / "t.json")
+        assert main(["trace", "-w", root, "-o", out_path]) == 0
+        doc = json.load(open(out_path))
+        assert doc["traceEvents"]
+        assert jid in capsys.readouterr().out
+
+    def test_trace_command_empty(self, tmp_path):
+        from peasoup_tpu.cli.campaign import main
+
+        assert main(["trace", "-w", str(tmp_path)]) == 1
+
+    def test_profile_command_requires_live_worker(
+        self, obs_campaign, capsys
+    ):
+        from peasoup_tpu.campaign.registry import WorkerRegistry
+        from peasoup_tpu.cli.campaign import main
+
+        root, _, _ = obs_campaign
+        assert main(["profile", "-w", root, "nobody"]) == 1
+        reg = WorkerRegistry(root)
+        reg.register("wlive")
+        try:
+            assert main(
+                ["profile", "-w", root, "wlive", "--seconds", "1"]
+            ) == 0
+            assert reg.profile_requested("wlive") is not None
+        finally:
+            reg.deregister("wlive")
+
+    def test_watch_history_cli(self, obs_campaign, capsys):
+        from peasoup_tpu.tools.watch import main
+
+        root, _, _ = obs_campaign
+        assert main([root, "--history"]) == 0
+        assert "queue depth" in capsys.readouterr().out
+
+    def test_bowtie_cli(self, obs_campaign, tmp_path, capsys):
+        from peasoup_tpu.tools.plotting import bowtie_main
+
+        root, _, _ = obs_campaign
+        out = str(tmp_path / "b.svg")
+        assert bowtie_main(
+            [os.path.join(root, "candidates.sqlite"), "-o", out]
+        ) == 0
+        assert open(out).read().startswith("<svg")
