@@ -1,8 +1,8 @@
 """Static analysis for JAX/TPU hazards: ``peasoup-audit``.
 
-Two engines, one report:
+Four engines, one report:
 
-* **AST lints** (:mod:`.astlint`, rules in :mod:`.rules`): a small
+* **AST lints** (:mod:`.astlint`, PSA rules in :mod:`.rules`): a small
   rule-plugin framework over :mod:`ast` that encodes the hazards this
   codebase stakes runtime guarantees on — host syncs inside jitted
   code, Python control flow on tracers, float64 drift, non-atomic
@@ -10,12 +10,29 @@ Two engines, one report:
   thread-shared state mutated outside a lock, ``time.time()`` where
   ``perf_counter`` is required.
 * **Program contracts** (:mod:`.contracts` over
-  :mod:`peasoup_tpu.ops.registry`): every registered jitted program is
-  abstract-evaled over a tiny representative shape set and its
-  jaxpr/StableHLO linted — no f64 ops (lowered under x64 so silent
-  downcasts become visible), no unexpected host callbacks or custom
-  calls, no oversized baked-in constants, donation matching what the
-  registry declares.
+  :mod:`peasoup_tpu.ops.registry`, PSC rules): every registered jitted
+  program is abstract-evaled and its jaxpr/StableHLO linted — no f64
+  ops (lowered under x64 so silent downcasts become visible), no
+  unexpected host callbacks or custom calls, no oversized baked-in
+  constants, donation matching what the registry declares — at the
+  tiny representative shapes AND at every rung of the campaign bucket
+  ladder (via each program's ShapeCtx hook), so rung-dependent drift
+  surfaces before a campaign hits it (PSC106 gates the coverage).
+* **Concurrency / file protocols** (:mod:`.protocol`, PSP rules): a
+  dataflow-aware pass over the fleet's filesystem and threading
+  protocols — shared-artifact writes must ride a sanctioned atomic
+  idiom (O_EXCL create, tmp + ``os.replace``, append-only), corrupt
+  artifacts quarantine by rename (never delete), durability-marked
+  writers fsync before publishing, every thread body runs under
+  ``guard_thread``, lock-owned attributes never mutate lock-free, and
+  ambient telemetry never crosses a thread boundary uncopied.
+* **Pallas kernel contracts** (:mod:`.kernels` over
+  :mod:`peasoup_tpu.ops.pallas.registry`, PSK rules): every kernel
+  ships its twin/probe/fallback triple (cross-referenced, PSK201/202),
+  lowers under interpret mode at its registered geometry (PSK203) and
+  under Mosaic where the toolchain allows (PSK208), and its tile
+  shapes, scalar-prefetch arity and lane-retile reshapes are linted
+  against the TPU quanta (PSK204-PSK207).
 
 Findings ratchet against a checked-in JSON baseline
 (``audit_baseline.json``): existing debt is tolerated, anything new
@@ -30,7 +47,15 @@ findings, 2 internal error), wired into ``scripts/check.sh``.
 from .findings import Finding, Baseline
 from .astlint import lint_source, lint_path, ModuleContext
 from .rules import all_rules
-from .contracts import ContractConfig, audit_program, audit_programs
+from .contracts import (
+    ContractConfig,
+    audit_program,
+    audit_programs,
+    audit_programs_ladder,
+    ladder_rungs,
+    ladder_shape_ctxs,
+)
+from .kernels import audit_kernel, audit_kernels
 from .runner import AuditResult, run_audit, render_text
 
 __all__ = [
@@ -43,6 +68,11 @@ __all__ = [
     "ContractConfig",
     "audit_program",
     "audit_programs",
+    "audit_programs_ladder",
+    "ladder_rungs",
+    "ladder_shape_ctxs",
+    "audit_kernel",
+    "audit_kernels",
     "AuditResult",
     "run_audit",
     "render_text",

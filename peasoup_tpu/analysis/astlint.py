@@ -426,7 +426,9 @@ def register_rule(cls: type[Rule]) -> type[Rule]:
 
 
 def rule_classes() -> dict[str, type[Rule]]:
-    from . import rules  # noqa: F401  (registration side effect)
+    # registration side effects: PSA (rules), PSP (protocol),
+    # PSK static (kernels)
+    from . import kernels, protocol, rules  # noqa: F401
 
     return dict(_RULES)
 

@@ -22,6 +22,20 @@ registered representative shapes, and the artefacts are linted:
 * **PSC105 trace/lower failure** — a registered program that no
   longer traces over its registered shapes is itself a finding (the
   registry is the contract).
+
+**Bucket-ladder mode** (:func:`audit_programs_ladder`): the same
+artifact lints run at the shapes a CAMPAIGN would trace — each rung of
+the padded-nsamps octave ladder (campaign.runner.bucket_nsamps) is
+turned into production ShapeCtxs with the drivers' own plan machinery
+(perf.warmup.shape_ctx_for_bucket, plus subband/matmul/streaming
+variants so every hook family gets a ctx it accepts), and every
+registered program is rebuilt through its ``param`` hook at every
+rung. Rung-dependent drift — an f64 constant only materialised past a
+shape threshold, a baked table that crosses the size gate at survey
+lengths, a donation that vanishes in a ctx-built variant — surfaces
+here before a campaign hits it. **PSC106** flags any program the
+ladder fails to cover at the required number of rungs: ladder
+coverage is part of the registration contract, not best-effort.
 """
 
 from __future__ import annotations
@@ -59,16 +73,17 @@ class ContractConfig:
     platform: str = "cpu"
 
 
-def _program_finding(spec, rule, message, severity=SEV_ERROR, hint=""):
+def _program_finding(spec, rule, message, severity=SEV_ERROR, hint="",
+                     tag=""):
     return Finding(
         rule=rule,
         severity=severity,
-        path=f"ops-registry/{spec.name}",
+        path=f"ops-registry/{spec.name}{tag}",
         line=0,
         col=0,
         message=message,
         fix_hint=hint,
-        source_line=f"{rule} {spec.name}",
+        source_line=f"{rule} {spec.name}{tag}",
     )
 
 
@@ -120,17 +135,25 @@ def _f64_eqns(closed_jaxpr):
 
 
 def audit_program(spec, cfg: ContractConfig | None = None) -> list[Finding]:
-    """Contract-check one registered program; returns findings."""
+    """Contract-check one registered program at its representative
+    shapes; returns findings."""
+    return _audit_built(spec, spec.build, cfg or ContractConfig())
+
+
+def _audit_built(
+    spec, build, cfg: ContractConfig, tag: str = ""
+) -> list[Finding]:
+    """Trace + lint one build thunk's artifacts. ``tag`` marks ladder
+    builds (``@nsamps=<rung>``) so findings carry their rung."""
     import contextlib
 
     import jax
     from jax.experimental import enable_x64
 
-    cfg = cfg or ContractConfig()
     findings: list[Finding] = []
     x64 = enable_x64() if cfg.check_x64 else contextlib.nullcontext()
     try:
-        fn, args, kwargs = spec.build()
+        fn, args, kwargs = build()
         if not hasattr(fn, "trace"):  # plain function: stage it
             fn = jax.jit(fn)
         with x64:
@@ -142,12 +165,14 @@ def audit_program(spec, cfg: ContractConfig | None = None) -> list[Finding]:
             _program_finding(
                 spec,
                 "PSC105",
-                f"failed to trace/lower over registered shapes: "
+                f"failed to trace/lower over "
+                f"{'ladder' if tag else 'registered'} shapes: "
                 f"{type(e).__name__}: {e}",
                 hint=(
                     "the registry build thunk no longer matches the "
                     "program; fix the registration next to the op"
                 ),
+                tag=tag,
             )
         ]
 
@@ -168,6 +193,7 @@ def audit_program(spec, cfg: ContractConfig | None = None) -> list[Finding]:
                     "pin the offending constants/intermediates to "
                     "float32 (np.float32 / jnp.float32)"
                 ),
+                tag=tag,
             )
         )
 
@@ -187,6 +213,7 @@ def audit_program(spec, cfg: ContractConfig | None = None) -> list[Finding]:
                         "move the host work out of the jitted program "
                         "(or io_callback it explicitly outside ops/)"
                     ),
+                    tag=tag,
                 )
             )
         elif target not in allowed:
@@ -199,6 +226,7 @@ def audit_program(spec, cfg: ContractConfig | None = None) -> list[Finding]:
                         "if intentional, add it to the program's "
                         "allow_custom_calls in its registration"
                     ),
+                    tag=tag,
                 )
             )
 
@@ -219,6 +247,7 @@ def audit_program(spec, cfg: ContractConfig | None = None) -> list[Finding]:
                     "recompile plus resident HBM",
                     severity=cfg.severity_const,
                     hint="pass it as a traced operand instead",
+                    tag=tag,
                 )
             )
 
@@ -235,6 +264,7 @@ def audit_program(spec, cfg: ContractConfig | None = None) -> list[Finding]:
                 "but the lowering aliases no buffers — the driver's "
                 "memory budget assumes in-place reuse",
                 hint="add donate_argnums to the jit wrapper",
+                tag=tag,
             )
         )
     elif donated and not spec.donate:
@@ -247,6 +277,7 @@ def audit_program(spec, cfg: ContractConfig | None = None) -> list[Finding]:
                 "donated operands",
                 severity=SEV_WARNING,
                 hint="declare donate=... in the registration",
+                tag=tag,
             )
         )
     return findings
@@ -274,14 +305,165 @@ def audit_programs(
     return report
 
 
+# --------------------------------------------------------------------------
+# bucket-ladder contracts
+# --------------------------------------------------------------------------
+
+# the synthetic campaign bucket the ladder contracts trace at: small
+# band (tiny DM plan -> fast traces) with a 10 ms sample time so the
+# whitening boundaries (pos5/pos25) land on nonzero bins even at the
+# smallest rungs. (nchans, nbits, tsamp, fch1, foff) — nsamps is the
+# rung.
+LADDER_BASE_BUCKET = (8, 8, 0.01, 1400.0, -16.0)
+LADDER_BASE_NSAMPS = 2048
+LADDER_OVERRIDES = {"dm_end": 20.0, "n_widths": 6}
+DEFAULT_LADDER_RUNGS = 2
+
+
+def ladder_rungs(
+    base_nsamps: int = LADDER_BASE_NSAMPS,
+    count: int = DEFAULT_LADDER_RUNGS,
+) -> list[int]:
+    """The first ``count`` rungs >= ``base_nsamps`` of the campaign's
+    padded-nsamps octave ladder ({2^k, 3*2^(k-1)} —
+    campaign.runner.bucket_nsamps), so contracts walk the exact pad
+    targets jobs bucket to."""
+    from peasoup_tpu.campaign.runner import bucket_nsamps
+
+    rungs: list[int] = []
+    n = int(base_nsamps)
+    while len(rungs) < count:
+        r = bucket_nsamps(n)
+        rungs.append(r)
+        n = r + 1
+    return rungs
+
+
+def ladder_shape_ctxs(rung: int, overrides: dict | None = None) -> list:
+    """Production ShapeCtx variants for one ladder rung: the spsearch
+    and search pipelines via the drivers' own plan machinery, plus the
+    streaming, subband and subband-matmul variants — one ctx family
+    per hook family, so every registered program finds a ctx its hook
+    accepts."""
+    from peasoup_tpu.perf.warmup import shape_ctx_for_bucket
+
+    nchans, nbits, tsamp, fch1, foff = LADDER_BASE_BUCKET
+    bucket = (nchans, nbits, int(rung), tsamp, fch1, foff)
+    ov = dict(LADDER_OVERRIDES if overrides is None else overrides)
+    ctx_sp = shape_ctx_for_bucket(bucket, "spsearch", ov)
+    ctx_search = shape_ctx_for_bucket(bucket, "search", ov)
+    return [
+        ctx_sp,
+        ctx_search,
+        # streaming geometry: the chunk program's hook declines batch
+        # ctxs, so give it the CLI-default chunk at this rung's plan
+        replace(ctx_sp, stream_chunk=1024),
+        # subband engine variants (gather-staged and matmul-staged)
+        replace(ctx_search, subbands=4),
+        replace(ctx_search, subbands=4, subband_matmul=True),
+        # sub-byte bucket: the device unpacker declines byte data, so
+        # its rung coverage rides a 2-bit variant of the same rung
+        replace(ctx_sp, nbits=2),
+    ]
+
+
+@dataclass
+class LadderReport:
+    findings: list[Finding] = field(default_factory=list)
+    rungs: list[int] = field(default_factory=list)
+    # program name -> rungs at which a hook-built variant was traced
+    coverage: dict[str, list[int]] = field(default_factory=dict)
+
+
+def audit_programs_ladder(
+    specs=None,
+    rungs: list[int] | None = None,
+    cfg: ContractConfig | None = None,
+    min_rungs: int | None = None,
+    overrides: dict | None = None,
+) -> LadderReport:
+    """Contract-check all (or the given) registered programs at every
+    rung of the campaign bucket ladder. Each program is rebuilt
+    through its ShapeCtx ``param`` hook with the first ctx variant
+    that accepts it per rung; PSC106 flags programs the ladder covers
+    at fewer than ``min_rungs`` rungs (default: every rung)."""
+    if specs is None:
+        from peasoup_tpu.ops.registry import registered_programs
+
+        specs = registered_programs()
+    cfg = cfg or ContractConfig()
+    rungs = list(rungs) if rungs is not None else ladder_rungs()
+    min_rungs = len(rungs) if min_rungs is None else min(
+        min_rungs, len(rungs)
+    )
+    report = LadderReport(rungs=rungs)
+    ctxs_by_rung = {r: ladder_shape_ctxs(r, overrides) for r in rungs}
+    for spec in specs:
+        covered: list[int] = []
+        for rung in rungs:
+            built = None
+            for ctx in ctxs_by_rung[rung]:
+                try:
+                    built = spec.build_for(ctx)
+                except Exception as exc:
+                    report.findings.append(
+                        _program_finding(
+                            spec,
+                            "PSC105",
+                            f"ShapeCtx hook raised at rung {rung}: "
+                            f"{type(exc).__name__}: {exc}",
+                            hint=(
+                                "hooks must DECLINE (return None) "
+                                "ctxs they cannot build, never raise"
+                            ),
+                            tag=f"@nsamps={rung}",
+                        )
+                    )
+                    built = None
+                    break
+                if built is not None:
+                    break
+            if built is None:
+                continue
+            covered.append(rung)
+            built_spec = built
+            report.findings.extend(
+                _audit_built(
+                    spec,
+                    lambda b=built_spec: b,
+                    cfg,
+                    tag=f"@nsamps={rung}",
+                )
+            )
+        report.coverage[spec.name] = covered
+        if len(covered) < min_rungs:
+            report.findings.append(
+                _program_finding(
+                    spec,
+                    "PSC106",
+                    f"bucket-ladder coverage {len(covered)}/"
+                    f"{min_rungs} rungs (rungs {rungs}): the program "
+                    "has no ShapeCtx hook (or its hook declines every "
+                    "ladder ctx), so campaign-shape drift is invisible "
+                    "to the contract engine",
+                    hint=(
+                        "give the registration a param= ShapeCtx hook "
+                        "that builds at bucket geometry (see "
+                        "_param_dedisperse_block)"
+                    ),
+                )
+            )
+    return report
+
+
 __all__ = [
     "ContractConfig",
     "ContractReport",
     "DEFAULT_CUSTOM_CALL_ALLOWLIST",
+    "LadderReport",
     "audit_program",
     "audit_programs",
+    "audit_programs_ladder",
+    "ladder_rungs",
+    "ladder_shape_ctxs",
 ]
-
-
-# keep dataclasses import surface tidy for mypy
-_ = replace

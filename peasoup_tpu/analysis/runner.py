@@ -1,5 +1,21 @@
-"""Audit orchestration: both engines, the baseline ratchet, and the
-versioned ``audit.json`` report.
+"""Audit orchestration: all four engines, the baseline ratchet, and
+the versioned ``audit.json`` report.
+
+The engines:
+
+1. **AST lints** — the PSA rules (:mod:`.rules`) over every package
+   file.
+2. **Program contracts** (:mod:`.contracts`) — every registered jitted
+   program abstract-evaled at its representative shapes AND at every
+   rung of the campaign bucket ladder (``--no-ladder`` skips the
+   rungs), its jaxpr/StableHLO linted.
+3. **Concurrency / file protocols** — the PSP rules
+   (:mod:`.protocol`); operationally part of the AST pass but
+   separately gated (``--no-protocol``).
+4. **Pallas kernel contracts** (:mod:`.kernels`) — the PSK static
+   rules over ``ops/pallas`` plus the dynamic registry checks
+   (twin/probe cross-reference, interpret-mode lowering, Mosaic where
+   the toolchain allows).
 
 The report is a machine-readable manifest like the telemetry one:
 versioned, schema-pinned by a checked-in JSON Schema
@@ -19,7 +35,7 @@ from .astlint import lint_path, rule_classes
 from .findings import Baseline, Finding
 
 AUDIT_SCHEMA = "peasoup_tpu.audit"
-AUDIT_VERSION = 1
+AUDIT_VERSION = 2  # v2: kernel engine + bucket-ladder contract sections
 
 AUDIT_SCHEMA_PATH = os.path.join(
     os.path.dirname(__file__), "audit.schema.json"
@@ -53,6 +69,9 @@ class AuditResult:
     suppressed: int = 0
     files_scanned: int = 0
     programs_checked: list[str] = field(default_factory=list)
+    kernels_checked: list[str] = field(default_factory=list)
+    ladder_rungs: list[int] = field(default_factory=list)
+    ladder_coverage: dict[str, list[int]] = field(default_factory=dict)
     rules: list[str] = field(default_factory=list)
 
     @property
@@ -70,12 +89,39 @@ class AuditResult:
                 "suppressed": self.suppressed,
                 "files_scanned": self.files_scanned,
                 "programs_checked": len(self.programs_checked),
+                "kernels_checked": len(self.kernels_checked),
+                "ladder_rungs": len(self.ladder_rungs),
             },
             "rules": sorted(self.rules),
             "programs": sorted(self.programs_checked),
+            "kernels": sorted(self.kernels_checked),
+            "ladder": {
+                "rungs": list(self.ladder_rungs),
+                "coverage": {
+                    k: list(v)
+                    for k, v in sorted(self.ladder_coverage.items())
+                },
+            },
             "findings": [f.to_json() for f in self.findings],
             "resolved_fingerprints": sorted(self.resolved),
         }
+
+
+def _engine_rule_ids(rule_ids, protocol: bool, kernels: bool):
+    """Resolve the AST pass's rule set from the explicit ``--rules``
+    filter and the engine toggles (PSP = engine 3, static PSK =
+    engine 4)."""
+    classes = rule_classes()
+    selected = set(classes) if rule_ids is None else set(rule_ids)
+    if rule_ids is not None:
+        unknown = selected - set(classes)
+        if unknown:
+            raise ValueError(f"unknown rule ids: {sorted(unknown)}")
+    if not protocol:
+        selected -= {r for r in selected if r.startswith("PSP")}
+    if not kernels:
+        selected -= {r for r in selected if r.startswith("PSK")}
+    return sorted(selected)
 
 
 def run_audit(
@@ -84,32 +130,69 @@ def run_audit(
     rule_ids=None,
     ast_engine: bool = True,
     contracts: bool = True,
+    protocol: bool = True,
+    kernels: bool = True,
+    ladder: bool = True,
+    ladder_rung_count: int | None = None,
     baseline_path: str | None = None,
     max_const_bytes: int | None = None,
+    kernel_specs=None,
+    program_specs=None,
 ) -> AuditResult:
-    """Run both engines over the repo at ``root`` and apply the
+    """Run the four engines over the repo at ``root`` and apply the
     baseline ratchet. Engine/internal errors propagate (the CLI maps
-    them to exit 2); per-file and per-program problems become
-    findings."""
-    result = AuditResult(rules=sorted(rule_classes()))
+    them to exit 2); per-file, per-program and per-kernel problems
+    become findings. ``kernel_specs``/``program_specs`` override the
+    real registries (tests inject doctored specs)."""
+    result = AuditResult()
     findings: list[Finding] = []
+
+    effective_rules = _engine_rule_ids(rule_ids, protocol, kernels)
+    result.rules = effective_rules
 
     if ast_engine:
         for abspath, relpath in package_files(root):
-            file_findings, nsup = lint_path(abspath, relpath, rule_ids)
+            file_findings, nsup = lint_path(
+                abspath, relpath, effective_rules
+            )
             findings.extend(file_findings)
             result.suppressed += nsup
             result.files_scanned += 1
 
     if contracts:
-        from .contracts import ContractConfig, audit_programs
+        from .contracts import (
+            ContractConfig,
+            audit_programs,
+            audit_programs_ladder,
+        )
 
         cfg = ContractConfig()
         if max_const_bytes is not None:
             cfg.max_const_bytes = max_const_bytes
-        report = audit_programs(cfg=cfg)
+        report = audit_programs(specs=program_specs, cfg=cfg)
         findings.extend(report.findings)
         result.programs_checked = report.programs
+        if ladder:
+            from .contracts import ladder_rungs as _rungs
+
+            rungs = (
+                _rungs(count=ladder_rung_count)
+                if ladder_rung_count
+                else None
+            )
+            lrep = audit_programs_ladder(
+                specs=program_specs, rungs=rungs, cfg=cfg
+            )
+            findings.extend(lrep.findings)
+            result.ladder_rungs = lrep.rungs
+            result.ladder_coverage = lrep.coverage
+
+    if kernels:
+        from .kernels import audit_kernels
+
+        krep = audit_kernels(specs=kernel_specs)
+        findings.extend(krep.findings)
+        result.kernels_checked = krep.kernels
 
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     result.findings = findings
@@ -164,5 +247,11 @@ def render_text(result: AuditResult, verbose: bool = False) -> str:
         f"{result.suppressed} suppressed; "
         f"{result.files_scanned} files, "
         f"{len(result.programs_checked)} programs"
+        + (
+            f" (+{len(result.ladder_rungs)} ladder rungs)"
+            if result.ladder_rungs
+            else ""
+        )
+        + f", {len(result.kernels_checked)} kernels"
     )
     return "\n".join(lines)

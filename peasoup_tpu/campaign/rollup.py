@@ -228,6 +228,26 @@ def build_status(root: str, queue: JobQueue | None = None) -> dict:
             recursive=True,
         )
     )
+    # on-demand device-profile captures (obs/profiler.py): capture
+    # dirs accumulate under <root>/profiles/ until
+    # `peasoup-campaign prune --profiles` reclaims them
+    pdir = os.path.join(os.path.abspath(root), "profiles")
+    profile_dirs = 0
+    profile_bytes = 0
+    if os.path.isdir(pdir):
+        for name in os.listdir(pdir):
+            cap = os.path.join(pdir, name)
+            if not os.path.isdir(cap):
+                continue
+            profile_dirs += 1
+            for dp, _, fns in os.walk(cap):
+                for fn in fns:
+                    try:
+                        profile_bytes += os.path.getsize(
+                            os.path.join(dp, fn)
+                        )
+                    except OSError:
+                        pass
     # fleet time-series summary (obs/metrics.py): how much history is
     # on disk and where to point `peasoup-campaign metrics`
     from ..obs.metrics import metrics_paths
@@ -274,6 +294,9 @@ def build_status(root: str, queue: JobQueue | None = None) -> dict:
         "corrupt_artifact_files": corrupt_files,
         # per-worker time-series on disk (peasoup-campaign metrics)
         "metrics": {"files": len(mpaths), "bytes": mbytes},
+        # device-profile captures on disk (prune with
+        # `peasoup-campaign prune --profiles`)
+        "profiles": {"captures": profile_dirs, "bytes": profile_bytes},
         # priority preemption: revoked/resumed jobs + revoke latency
         "preemptions": preemptions,
         # gang-scheduled (nprocs > 1) completions

@@ -254,18 +254,23 @@ def build_parser() -> argparse.ArgumentParser:
                     help="capture duration (bounded at 60s; default 5)")
 
     pr = sub.add_parser(
-        "prune", help="delete quarantined artifacts (the *.corrupt "
-        "forensics renamed aside by the resilience layer accumulate "
-        "forever otherwise)",
+        "prune", help="delete accumulated campaign artifacts: "
+        "*.corrupt quarantine forensics (--corrupt) and on-demand "
+        "jax.profiler capture directories (--profiles) — both grow "
+        "forever otherwise",
     )
     pr.add_argument("-w", "--workdir", required=True)
     pr.add_argument("--corrupt", action="store_true",
-                    help="prune *.corrupt quarantine files (the only "
-                    "prunable class today; the flag keeps the verb "
-                    "explicit)")
+                    help="prune *.corrupt quarantine files (the flag "
+                    "keeps the verb explicit)")
+    pr.add_argument("--profiles", action="store_true",
+                    help="prune on-demand device-profile capture "
+                    "directories under <workdir>/profiles/ "
+                    "(peasoup-campaign profile output; counted in the "
+                    "rollup's profiles section)")
     pr.add_argument("--older-than-days", type=float, default=0.0,
-                    help="only prune quarantine files older than N "
-                    "days (default 0 = all)")
+                    help="only prune artifacts older than N days "
+                    "(default 0 = all)")
     pr.add_argument("--dry-run", action="store_true",
                     help="list what would be deleted without deleting")
     return p
@@ -569,9 +574,12 @@ def _cmd_trace(args) -> int:
         ]
     doc = export_chrome_trace(spans, extra_instants=extra)
     out = args.output or os.path.join(args.workdir, "trace.json")
-    with open(out, "w") as f:
-        json.dump(doc, f, indent=2)
-        f.write("\n")
+    # atomic publish: the default path lands inside the campaign dir,
+    # where a watcher (or a second trace invocation) may read it while
+    # a soak is still running (PSP101)
+    from ..campaign.queue import _atomic_write_json
+
+    _atomic_write_json(out, doc)
     for jid in job_ids:
         summ = trace_summary(
             load_spans(trace_paths(os.path.join(jobs_dir, jid)))
@@ -616,38 +624,61 @@ def _cmd_profile(args) -> int:
 
 
 def _cmd_prune(args) -> int:
+    import shutil
     import time
 
-    if not args.corrupt:
+    if not args.corrupt and not args.profiles:
         print(
-            "prune: nothing selected (pass --corrupt to prune the "
-            "*.corrupt quarantine files)"
+            "prune: nothing selected (pass --corrupt for *.corrupt "
+            "quarantine files and/or --profiles for device-profile "
+            "capture directories)"
         )
         return 1
     root = os.path.abspath(args.workdir)
     now_unix = time.time()
     cutoff = now_unix - args.older_than_days * 86400.0
-    selected = []
-    for path in sorted(
-        glob.glob(os.path.join(root, "**", "*.corrupt"), recursive=True)
-    ):
-        try:
-            mtime = os.path.getmtime(path)
-        except OSError:
-            continue  # pruned by a racing invocation
-        if mtime <= cutoff:
-            selected.append(path)
+    selected: list[tuple[str, bool]] = []  # (path, is_dir)
+    if args.corrupt:
+        for path in sorted(
+            glob.glob(os.path.join(root, "**", "*.corrupt"),
+                      recursive=True)
+        ):
+            try:
+                mtime = os.path.getmtime(path)
+            except OSError:
+                continue  # pruned by a racing invocation
+            if mtime <= cutoff:
+                selected.append((path, False))
+    if args.profiles:
+        pdir = os.path.join(root, "profiles")
+        for name in sorted(os.listdir(pdir)) if os.path.isdir(
+            pdir
+        ) else []:
+            path = os.path.join(pdir, name)
+            if not os.path.isdir(path):
+                continue
+            try:
+                mtime = os.path.getmtime(path)
+            except OSError:
+                continue
+            if mtime <= cutoff:
+                selected.append((path, True))
     verb = "would delete" if args.dry_run else "deleted"
-    for path in selected:
+    pruned = 0
+    for path, is_dir in selected:
         if not args.dry_run:
             try:
-                os.unlink(path)
+                if is_dir:
+                    shutil.rmtree(path)
+                else:
+                    os.unlink(path)
             except OSError as exc:
                 print(f"prune: {path}: {exc}")
                 continue
+        pruned += 1
         print(f"prune: {verb} {path}")
     print(
-        f"prune: {verb} {len(selected)} quarantined artifact(s)"
+        f"prune: {verb} {pruned} artifact(s)"
         + (
             f" older than {args.older_than_days:g} day(s)"
             if args.older_than_days else ""

@@ -30,8 +30,25 @@ def coincidence_mask(
 
 
 # --- audit registry: thresh/beam_thresh traced as scalars (they are
-# data in the sharded driver too) ---
+# data in the sharded driver too); the ShapeCtx hook rebuilds over a
+# bucket's dedispersed trial length (the multibeam veto consumes the
+# single-pulse stream at exactly that geometry) ---
 from .registry import register_program, sds  # noqa: E402
+
+
+def _param_coincidence(ctx):
+    if ctx.out_nsamps <= 0:
+        return None
+    return (
+        coincidence_mask,
+        (
+            sds((4, ctx.out_nsamps), "float32"),
+            sds((), "float32"),
+            sds((), "int32"),
+        ),
+        {},
+    )
+
 
 register_program(
     "ops.coincidence.coincidence_mask",
@@ -40,4 +57,5 @@ register_program(
         (sds((3, 64), "float32"), sds((), "float32"), sds((), "int32")),
         {},
     ),
+    param=_param_coincidence,
 )

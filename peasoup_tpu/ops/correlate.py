@@ -93,8 +93,22 @@ def find_delays(beams, max_delay: int) -> DelayResult:
     return DelayResult(pairs=pairs, distance=distance, lag=lag, power=power)
 
 
-# --- audit registry ---
+# --- audit registry: representative shape plus a ShapeCtx hook at a
+# bucket's trial length (beam delay correlation runs over the same
+# per-beam series the coincidencer consumes) ---
 from .registry import register_program, sds  # noqa: E402
+
+
+def _param_find_delays(ctx):
+    n = ctx.out_nsamps
+    if n <= 8:
+        return None
+    return (
+        _find_delays,
+        (sds((3, n), "float32"), sds((3, 2), "int32")),
+        {"max_delay": max(1, min(256, n // 2))},
+    )
+
 
 register_program(
     "ops.correlate.find_delays",
@@ -103,4 +117,5 @@ register_program(
         (sds((3, 64), "float32"), sds((3, 2), "int32")),
         {"max_delay": 4},
     ),
+    param=_param_find_delays,
 )

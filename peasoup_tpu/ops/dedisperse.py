@@ -962,6 +962,47 @@ register_program(
     ),
     param=_param_subband_stage1,
 )
+def _param_stage1_batched(ctx):
+    # the gather-staged subband engine's group-batched stage 1; the
+    # matmul-staged variant has its own hooks below
+    if ctx.subbands <= 0 or ctx.subband_matmul:
+        return None
+    c = ctx.nchans
+    w = -(-c // max(1, min(ctx.subbands, c)))
+    nsub = -(-c // w)
+    nb1 = -(-ctx.out_nsamps // 128) + 2
+    tpad = (-(-ctx.nsamps // 128) + 3) * 128
+    return (
+        _stage1_batched(nb1),
+        (
+            sds((nsub, w, tpad), "uint8"),
+            sds((nsub, w), "float32"),
+            sds((4, nsub, w), "int32"),  # vmapped over DM groups
+        ),
+        {},
+    )
+
+
+def _param_stage2_batched(ctx):
+    if ctx.subbands <= 0 or ctx.subband_matmul:
+        return None
+    c = ctx.nchans
+    w = -(-c // max(1, min(ctx.subbands, c)))
+    nsub = -(-c // w)
+    nb1 = -(-ctx.out_nsamps // 128) + 2
+    d = max(1, min(ctx.dedisp_block, ctx.ndm))
+    return (
+        _stage2_batched(
+            ctx.out_nsamps, True, output_scale(ctx.nbits, ctx.nchans)
+        ),
+        (
+            sds((4, nsub, nb1, 128), "float32"),
+            sds((4, d, nsub), "int32"),
+        ),
+        {},
+    )
+
+
 register_program(
     "ops.dedisperse.subband_stage1_batched",
     lambda: (
@@ -973,6 +1014,7 @@ register_program(
         ),
         {},
     ),
+    param=_param_stage1_batched,
 )
 register_program(
     "ops.dedisperse.subband_stage2",
@@ -984,6 +1026,7 @@ register_program(
         ),
         {},
     ),
+    param=_param_stage2_batched,
 )
 
 

@@ -331,10 +331,25 @@ def collapse_periods(
     return out
 
 
-# --- audit registry: one octave program over a tiny fold grid ---
+# --- audit registry: one octave program over a tiny fold grid, plus
+# a ShapeCtx hook at the FIRST octave's geometry for a bucket's
+# dedispersed trial length (the staircase downsamples by 2 per octave,
+# so the first octave is the largest program the bucket traces) ---
 from .registry import register_program, sds  # noqa: E402
+
+
+def _param_octave(ctx):
+    n = ctx.out_nsamps
+    if n < 2 * _PMIN:
+        return None
+    m_pad = 1 << max(1, int(np.ceil(np.log2(max(2, n // _PMIN)))))
+    widths = duty_cycle_widths(0.01)
+    d = max(1, min(2, ctx.dm_block))
+    return (_octave_fn(m_pad, widths), (sds((d, n), "float32"),), {})
+
 
 register_program(
     "ops.ffa.octave",
     lambda: (_octave_fn(8, (1, 2, 4)), (sds((2048,), "float32"),), {}),
+    param=_param_octave,
 )

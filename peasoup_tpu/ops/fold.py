@@ -75,8 +75,24 @@ def fold_time_series_np(
     return (sums / counts).reshape(nints, nbins)
 
 
-# --- audit registry ---
+# --- audit registry: representative shape plus a ShapeCtx hook at a
+# bucket's fold geometry (pipeline.folder.fold_geometry rides the ctx
+# as fold_nsamps/fold_nbins/fold_nints) ---
 from .registry import register_program, sds  # noqa: E402
+
+
+def _param_fold_time_series(ctx):
+    if ctx.fold_nsamps <= 0:
+        return None
+    used = ctx.fold_nints * (ctx.fold_nsamps // ctx.fold_nints)
+    if used <= 0:
+        return None
+    return (
+        fold_time_series,
+        (sds((used,), "float32"), sds((used,), "int32")),
+        {"nbins": ctx.fold_nbins, "nints": ctx.fold_nints},
+    )
+
 
 register_program(
     "ops.fold.fold_time_series",
@@ -85,4 +101,5 @@ register_program(
         (sds((1024,), "float32"), sds((1024,), "int32")),
         {"nbins": 16, "nints": 4},
     ),
+    param=_param_fold_time_series,
 )

@@ -195,16 +195,15 @@ class FoldOptimiser:
 from .registry import register_program  # noqa: E402
 
 
-def _example_optimise():
+def _example_optimise(batch: int = 2, nbins: int = 32, nints: int = 8):
     import jax
 
-    nbins, nints = 32, 8
     shiftar = _shift_array(nbins, nints)
     templates, _ = _templates_fft(nbins)
     return (
         _optimise_device,
         (
-            jax.ShapeDtypeStruct((2, nints, nbins), np.float32),
+            jax.ShapeDtypeStruct((batch, nints, nbins), np.float32),
             shiftar.real.astype(np.float32),
             shiftar.imag.astype(np.float32),
             templates.real.astype(np.float32),
@@ -214,4 +213,19 @@ def _example_optimise():
     )
 
 
-register_program("ops.fold_optimise.optimise_device", _example_optimise)
+def _param_optimise(ctx):
+    # candidate-level program: the fold bucket sets its geometry; the
+    # candidate batch is rung-independent but bounded by fold_batch
+    if ctx.fold_batch <= 0 or ctx.fold_nsamps <= 0:
+        return None
+    return _example_optimise(
+        batch=max(2, min(ctx.fold_batch, 64)),
+        nbins=ctx.fold_nbins,
+        nints=ctx.fold_nints,
+    )
+
+
+register_program(
+    "ops.fold_optimise.optimise_device", _example_optimise,
+    param=_param_optimise,
+)

@@ -107,8 +107,38 @@ def whiten_fseries(x: jnp.ndarray, *, pos5: int, pos25: int) -> jnp.ndarray:
     return deredden(fser, med)
 
 
-# --- audit registry ---
+# --- audit registry: representative shapes plus ShapeCtx hooks at a
+# periodicity bucket's spectrum length and whitening boundaries (the
+# driver's pos5/pos25 ride the ctx so bucket-ladder contracts trace
+# the exact static configuration the search would compile) ---
 from .registry import register_program, sds  # noqa: E402
+
+
+def _param_running_median(ctx):
+    if ctx.fft_size <= 0 or ctx.pos25 <= 0:
+        return None
+    m = ctx.fft_size // 2 + 1
+    if ctx.pos25 >= m:
+        return None
+    return (
+        running_median,
+        (sds((m,), "float32"),),
+        {"pos5": ctx.pos5, "pos25": ctx.pos25},
+    )
+
+
+def _param_whiten_fseries(ctx):
+    if ctx.fft_size <= 0 or ctx.pos25 <= 0:
+        return None
+    if ctx.pos25 >= ctx.fft_size // 2 + 1:
+        return None
+    pos5, pos25 = ctx.pos5, ctx.pos25
+    return (
+        lambda x: whiten_fseries(x, pos5=pos5, pos25=pos25),
+        (sds((ctx.fft_size,), "float32"),),
+        {},
+    )
+
 
 register_program(
     "ops.rednoise.running_median",
@@ -117,6 +147,7 @@ register_program(
         (sds((1024,), "float32"),),
         {"pos5": 32, "pos25": 256},
     ),
+    param=_param_running_median,
 )
 register_program(
     "ops.rednoise.whiten_fseries",
@@ -127,4 +158,5 @@ register_program(
         (sds((512,), "float32"),),
         {},
     ),
+    param=_param_whiten_fseries,
 )

@@ -113,6 +113,12 @@ class ShapeCtx:
     accel_pad: int = 0  # padded accel-trial columns per DM row
     max_peaks: int = 128
     select_smax: int = 0  # gather-free resample span (0 = gather path)
+    # rednoise whitening boundaries in spectrum bins (the driver's
+    # boundary_5_freq/boundary_25_freq over the bucket's bin width) —
+    # static args of running_median/whiten_fseries, so part of the
+    # compiled program's identity; 0 = not a periodicity ctx
+    pos5: int = 0
+    pos25: int = 0
     # survey-fold geometry (peasoup_tpu/sift/fold.py): candidates per
     # fixed batch and the bucket's power-of-two series length; 0 = not
     # a fold ctx, so the survey_fold hook declines it
@@ -279,8 +285,9 @@ def _jit_entry_points_in(path: str, modname: str) -> list[str]:
 
 
 def unregistered_entry_points() -> list[str]:
-    """Top-level jitted entry points in ops/ (Pallas kernels excluded —
-    their contract/warmup story is a ROADMAP item) with no registry
+    """Top-level jitted entry points in ops/ (Pallas kernels excluded
+    here — they have their own registry, ops/pallas/registry.py, whose
+    completeness is gated by the audit's PSK201) with no registry
     coverage: neither a same-name registration (modulo a leading
     underscore) nor a REGISTRY_ALIASES mapping. Empty means every
     program is warmed, contract-checked and benchmarked."""

@@ -265,6 +265,18 @@ register_program(
     lambda: (resample_accel, (sds((256,), "float32"), sds((4,), "float32")), {}),
     param=_param_resample_accel,
 )
+def _param_resample_quadratic(ctx):
+    # the jerk-trial variant resamples one series per scalar adot at
+    # the same fft tile as the linear path
+    if ctx.fft_size <= 0:
+        return None
+    return (
+        resample_accel_quadratic,
+        (sds((ctx.fft_size,), "float32"), sds((), "float32")),
+        {},
+    )
+
+
 register_program(
     "ops.resample.resample_accel_quadratic",
     lambda: (
@@ -272,6 +284,7 @@ register_program(
         (sds((256,), "float32"), sds((), "float32")),
         {},
     ),
+    param=_param_resample_quadratic,
 )
 register_program(
     "ops.resample.resample_select",

@@ -44,10 +44,25 @@ def zap_birdies(fseries: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(mask, jnp.asarray(1.0 + 0.0j, dtype=fseries.dtype), fseries)
 
 
-# --- audit registry ---
+# --- audit registry: representative shape plus a ShapeCtx hook at a
+# periodicity bucket's spectrum length (the mask is plan-static, so
+# the traced shape is all that varies per rung) ---
 from .registry import register_program, sds  # noqa: E402
+
+
+def _param_zap_birdies(ctx):
+    if ctx.fft_size <= 0:
+        return None
+    m = ctx.fft_size // 2 + 1
+    return (
+        zap_birdies,
+        (sds((m,), "complex64"), sds((m,), "bool")),
+        {},
+    )
+
 
 register_program(
     "ops.zap.zap_birdies",
     lambda: (zap_birdies, (sds((128,), "complex64"), sds((128,), "bool")), {}),
+    param=_param_zap_birdies,
 )

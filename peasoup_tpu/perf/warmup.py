@@ -297,6 +297,7 @@ def shape_ctx_for_bucket(bucket, pipeline: str, overrides: dict):
     accel_pad = 0
     max_peaks = 128
     select_smax = 0
+    pos5 = pos25 = 0
     if pipeline == "spsearch":
         search = SinglePulseSearch(cfg)
         widths = search.widths_for(plan.out_nsamps)
@@ -331,6 +332,11 @@ def shape_ctx_for_bucket(bucket, pipeline: str, overrides: dict):
         fft_size = choose_fft_size(int(nsamps), cfg.size)
         nharms = int(cfg.nharmonics)
         max_peaks = int(cfg.max_peaks)
+        # the driver's whitening boundaries in bins (search.py:
+        # bin_width = 1/tobs) — static args of the rednoise programs
+        tobs = fft_size * float(tsamp)
+        pos5 = int(cfg.boundary_5_freq * tobs)
+        pos25 = int(cfg.boundary_25_freq * tobs)
         acc_plan = AccelerationPlan(
             acc_lo=cfg.acc_start, acc_hi=cfg.acc_end, tol=cfg.acc_tol,
             pulse_width=cfg.acc_pulse_width, nsamps=fft_size,
@@ -390,6 +396,8 @@ def shape_ctx_for_bucket(bucket, pipeline: str, overrides: dict):
         accel_pad=int(accel_pad),
         max_peaks=int(max_peaks),
         select_smax=int(select_smax),
+        pos5=int(pos5),
+        pos25=int(pos25),
         fold_batch=(
             int(overrides.get("fold_batch", 64)) if fold_size else 0
         ),

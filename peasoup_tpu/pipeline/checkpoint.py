@@ -161,6 +161,12 @@ class SearchCheckpoint:
             try:
                 with os.fdopen(fd, "wb") as f:
                     np.savez(f, **arrays)
+                    # durability, not just atomicity: a preempted job's
+                    # bitwise-equal resume rides this file, so it must
+                    # survive a HOST crash — flush the data blocks
+                    # before the rename publishes the name (PSP103)
+                    f.flush()
+                    os.fsync(f.fileno())
                 os.replace(tmp, self.write_path)
             except BaseException:
                 if os.path.exists(tmp):

@@ -1,8 +1,10 @@
 """``peasoup-audit`` — the static-analysis gate.
 
-Runs both engines (AST lints + jitted-program contracts) over the
-repo, applies the baseline ratchet, prints a human report and
-optionally writes the versioned ``audit.json``.
+Runs the four engines over the repo — AST JAX-hazard lints (PSA),
+jitted-program contracts at representative AND campaign-bucket-ladder
+shapes (PSC), concurrency/file-protocol lints (PSP), and Pallas
+kernel contracts (PSK) — applies the baseline ratchet, prints a human
+report and optionally writes the versioned ``audit.json``.
 
 Exit codes (scripts/check.sh relies on these):
 
@@ -71,12 +73,37 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--no-contracts",
         action="store_true",
-        help="skip engine 2 (program contract checks)",
+        help="skip engine 2 (program contract checks, ladder included)",
     )
     p.add_argument(
         "--no-ast",
         action="store_true",
-        help="skip engine 1 (AST lints)",
+        help="skip engine 1 (AST lints; also disables the PSP/PSK "
+        "static rules)",
+    )
+    p.add_argument(
+        "--no-protocol",
+        action="store_true",
+        help="skip engine 3 (PSP concurrency/file-protocol rules)",
+    )
+    p.add_argument(
+        "--no-kernels",
+        action="store_true",
+        help="skip engine 4 (PSK Pallas kernel rules + registry "
+        "contract checks)",
+    )
+    p.add_argument(
+        "--no-ladder",
+        action="store_true",
+        help="skip the bucket-ladder contract pass (representative "
+        "shapes still checked)",
+    )
+    p.add_argument(
+        "--ladder-rungs",
+        type=int,
+        default=None,
+        metavar="N",
+        help="number of bucket-ladder rungs to trace (default 2)",
     )
     p.add_argument(
         "--max-const-bytes",
@@ -111,9 +138,15 @@ def _list_rules() -> int:
         if cls.fix_hint:
             print(f"        hint: {cls.fix_hint}")
     print(
-        "PSC101-PSC105 (contract engine): f64 ops, host callbacks / "
+        "PSC101-PSC106 (contract engine): f64 ops, host callbacks / "
         "unexpected custom calls, oversized baked-in constants, "
-        "donation mismatch, trace failure"
+        "donation mismatch, trace failure, missing bucket-ladder "
+        "coverage (representative + ladder-rung shapes)"
+    )
+    print(
+        "PSK202/PSK203/PSK208 (kernel engine, dynamic): registry "
+        "drift (deleted probe / unreferenced twin), interpret-mode "
+        "lowering failure, Mosaic lowering failure (TPU toolchains)"
     )
     return 0
 
@@ -138,6 +171,10 @@ def main(argv=None) -> int:
             rule_ids=rule_ids,
             ast_engine=not args.no_ast,
             contracts=not args.no_contracts,
+            protocol=not args.no_protocol,
+            kernels=not args.no_kernels,
+            ladder=not args.no_ladder,
+            ladder_rung_count=args.ladder_rungs,
             baseline_path=args.baseline,
             max_const_bytes=args.max_const_bytes,
         )

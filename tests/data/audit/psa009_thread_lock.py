@@ -1,5 +1,8 @@
 # audit-path: peasoup_tpu/obs/fixture_thread_lock.py
-"""Fixture: PSA009 — thread-shared mutation outside a lock."""
+"""Fixture: PSA009 — thread-shared mutation outside a lock (the
+PSP deepenings fire on the same hazards: the unguarded thread
+target is PSP104, and the lock-owned attributes mutated lock-free
+are PSP105)."""
 import threading
 
 
@@ -11,12 +14,12 @@ class Worker:
         self._thread = None
 
     def start(self):
-        self._thread = threading.Thread(target=self._run)
+        self._thread = threading.Thread(target=self._run)  # expect[PSP104]
         self._thread.start()
 
     def _run(self):
-        self._count += 1  # expect[PSA009]
-        self._items.append(1)  # expect[PSA009]
+        self._count += 1  # expect[PSA009] expect[PSP105]
+        self._items.append(1)  # expect[PSA009] expect[PSP105]
         with self._lock:
             self._count += 1  # ok: guarded
             self._items.append(2)  # ok: guarded

@@ -1,0 +1,16 @@
+# audit-path: peasoup_tpu/ops/pallas/psk204.py
+"""Fixture: PSK204/PSK205 — tile shapes vs the TPU quanta (static
+lint only: no pallas_call, so PSK201 stays quiet)."""
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+GOOD = pl.BlockSpec((8, 128), memory_space=pltpu.VMEM)  # ok: on-quanta
+WIDE = pl.BlockSpec((16, 256), memory_space=pltpu.VMEM)  # ok: multiples
+UNIT = pl.BlockSpec((1, 128), memory_space=pltpu.VMEM)  # ok: unit dim
+SMEM = pl.BlockSpec((1, 1), memory_space=pltpu.SMEM)  # ok: untiled scalars
+BAD_LANE = pl.BlockSpec((8, 96), memory_space=pltpu.VMEM)  # expect[PSK204]
+BAD_SUB = pl.BlockSpec((6, 128), memory_space=pltpu.VMEM)  # expect[PSK204]
+SCRATCH_OK = pltpu.VMEM((16, 128), jnp.bfloat16)  # ok: 16-row bf16 quantum
+SCRATCH_BAD = pltpu.VMEM((8, 128), jnp.bfloat16)  # expect[PSK205]
+SCRATCH_F32 = pltpu.VMEM((8, 128), jnp.float32)  # ok: 8-row f32 quantum

@@ -1,0 +1,45 @@
+# audit-path: peasoup_tpu/ops/pallas/psk206.py
+"""Fixture: PSK201 (unregistered kernel module) + PSK206 (scalar
+prefetch vs kernel arity)."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(w_ref, x_ref, o_ref, win_ref, sem):
+    o_ref[:] = x_ref[:]
+
+
+def build_bad(n):
+    grid_spec = pltpu.PrefetchScalarGridSpec(  # expect[PSK206]
+        num_scalar_prefetch=2,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=[pl.BlockSpec((8, 128), memory_space=pltpu.VMEM)],
+        scratch_shapes=[
+            pltpu.VMEM((1024,), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    return pl.pallas_call(  # expect[PSK201]
+        partial(_kernel),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+    )
+
+
+def build_good(n):
+    grid_spec = pltpu.PrefetchScalarGridSpec(  # ok: 1+1+1+2 == 5 refs
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=[pl.BlockSpec((8, 128), memory_space=pltpu.VMEM)],
+        scratch_shapes=[
+            pltpu.VMEM((1024,), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    return grid_spec

@@ -1,0 +1,21 @@
+# audit-path: peasoup_tpu/ops/pallas/psk207.py
+"""Fixture: PSK207 — lane-retiling reshape in a kernel without a
+declared retile-fallback ladder (the module is unregistered, so
+PSK201 fires on the pallas_call too)."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _kernel(x_ref, o_ref):
+    chunk = x_ref[...].reshape(1, 256)  # ok: unit-row keeps the lanes
+    flat = chunk.reshape(-1)  # ok: flatten
+    tile = flat.reshape(8, 32)  # expect[PSK207]
+    o_ref[:] = tile
+
+
+def build():
+    return pl.pallas_call(  # expect[PSK201]
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct((8, 32), jnp.float32),
+    )

@@ -1,0 +1,42 @@
+# audit-path: peasoup_tpu/campaign/psp101.py
+"""Fixture: PSP101 — non-atomic writes to shared artifact paths."""
+import os
+import tempfile
+
+
+def bad_queue_write(doc):
+    path = os.path.join("campaign", "queue", "jobs", "a.json")
+    with open(path, "w") as f:  # expect[PSP101]
+        f.write("x")
+
+
+def bad_status_rewrite(text, root):
+    status = root + "/status.json"
+    with open(status, "w") as f:  # expect[PSP101]
+        f.write(text)
+
+
+def good_atomic_rewrite(path, text):
+    d = os.path.dirname(path)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    with os.fdopen(fd, "w") as f:  # ok: fd write of a mkstemp tmp file
+        f.write(text)
+    os.replace(tmp, path)
+
+
+def good_tmp_suffix(path, text):
+    tmp = path + ".tmp"  # ok: the tmp half of the atomic idiom
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+
+
+def good_append_recorder(root, line):
+    log = os.path.join(root, "queue", "workers", "w.metrics.jsonl")
+    with open(log, "a") as f:  # ok: append-only recorder
+        f.write(line)
+
+
+def good_private_scratch(text):
+    with open("scratch.txt", "w") as f:  # ok: not a shared artifact
+        f.write(text)

@@ -1,0 +1,36 @@
+# audit-path: peasoup_tpu/campaign/psp102.py
+"""Fixture: PSP102 — delete where the quarantine policy requires
+rename."""
+import json
+import os
+import tempfile
+
+
+def bad_delete_on_parse_error(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except json.JSONDecodeError:
+        os.remove(path)  # expect[PSP102]
+        return None
+
+
+def good_quarantine_rename(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except json.JSONDecodeError:
+        os.rename(path, path + ".corrupt")  # ok: rename keeps forensics
+        return None
+
+
+def good_tmp_cleanup(path, text):
+    d = os.path.dirname(path)
+    fd, tmp = tempfile.mkstemp(dir=d)
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(text)
+        os.replace(tmp, path)
+    except OSError:
+        os.unlink(tmp)  # ok: tmp cleanup on the write error path
+        raise

@@ -1,0 +1,30 @@
+# audit-path: peasoup_tpu/pipeline/psp103.py
+"""Fixture: PSP103 — fsync before rename in durability-marked
+helpers."""
+import os
+import tempfile
+
+
+def save_checkpoint(path, blob):
+    d = os.path.dirname(path)
+    fd, tmp = tempfile.mkstemp(dir=d)
+    with os.fdopen(fd, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)  # expect[PSP103]
+
+
+def save_checkpoint_durably(path, blob):
+    d = os.path.dirname(path)
+    fd, tmp = tempfile.mkstemp(dir=d)
+    with os.fdopen(fd, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)  # ok: data blocks flushed before the rename
+
+
+def rewrite_snapshot(path, text):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)  # ok: not durability-marked (reconstructible)

@@ -1,0 +1,35 @@
+# audit-path: peasoup_tpu/obs/psp104.py
+"""Fixture: PSP104 — thread bodies must run under guard_thread."""
+import threading
+
+from peasoup_tpu.resilience import guard_thread
+
+
+def work():
+    return 1
+
+
+def spawn_bad():
+    t = threading.Thread(target=work, daemon=True)  # expect[PSP104]
+    t.start()
+    return t
+
+
+def _guarded():
+    guard_thread("worker", work)
+
+
+def spawn_good():
+    t = threading.Thread(target=_guarded, daemon=True)  # ok: guarded
+    t.start()
+    return t
+
+
+class BadLoop(threading.Thread):
+    def run(self):  # expect[PSP104]
+        work()
+
+
+class GoodLoop(threading.Thread):
+    def run(self):  # ok: run body under the crash guard
+        guard_thread("loop", work)

@@ -1,0 +1,28 @@
+# audit-path: peasoup_tpu/stream/psp105.py
+"""Fixture: PSP105 — lock-owned attributes never mutate lock-free."""
+import threading
+
+from peasoup_tpu.resilience import guard_thread
+
+
+class Recorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events = []  # ok: no thread exists during __init__
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        guard_thread("recorder", self._loop)
+
+    def _loop(self):
+        with self._lock:
+            self._events.append("tick")  # ok: owning lock held
+
+    def drain(self):
+        with self._lock:
+            out = list(self._events)
+            self._events.clear()  # ok: same lock as the appender
+        return out
+
+    def reset(self):
+        self._events = []  # expect[PSP105]

@@ -1,0 +1,45 @@
+# audit-path: peasoup_tpu/stream/psp106.py
+"""Fixture: PSP106 — ambient telemetry does not cross thread
+boundaries uncopied."""
+import contextvars
+import threading
+
+from peasoup_tpu.obs.telemetry import current as current_telemetry
+from peasoup_tpu.resilience import guard_thread
+
+
+def _noop():
+    return None
+
+
+def _bad_body():
+    guard_thread("x", _noop)
+    current_telemetry().event("tick")  # expect[PSP106]
+
+
+def spawn_bad():
+    t = threading.Thread(target=_bad_body, daemon=True)
+    t.start()
+
+
+def _good_body(tel):
+    guard_thread("x", _noop, telemetry=tel)
+    tel.event("tick")  # ok: telemetry handed in explicitly
+
+
+def spawn_good(tel):
+    t = threading.Thread(target=lambda: _good_body(tel), daemon=True)
+    t.start()
+
+
+def _copied_body():
+    guard_thread("x", _noop)
+    current_telemetry().event("tick")  # ok: context copied at spawn
+
+
+def spawn_copied():
+    ctx = contextvars.copy_context()
+    t = threading.Thread(
+        target=lambda: ctx.run(_copied_body), daemon=True
+    )
+    t.start()

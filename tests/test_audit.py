@@ -12,6 +12,7 @@ Three layers:
   callback, trace failure) plus the real ops registry.
 """
 
+import functools
 import json
 import re
 import subprocess
@@ -44,7 +45,7 @@ from peasoup_tpu.tools.audit import main as audit_main
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
 FIXTURE_DIR = Path(__file__).parent / "data" / "audit"
-FIXTURES = sorted(FIXTURE_DIR.glob("psa*.py"))
+FIXTURES = sorted(FIXTURE_DIR.glob("ps[apk]*.py"))
 
 _PATH_RE = re.compile(r"#\s*audit-path:\s*(\S+)")
 _EXPECT_RE = re.compile(r"expect\[([A-Z]{3}\d{3})\]")
@@ -370,9 +371,11 @@ class TestOpsRegistry:
             assert mod in prefixes, f"no registered programs from {mod}"
 
     def test_real_registry_is_contract_clean(self):
-        report = audit_programs()
-        assert len(report.programs) >= 15
-        assert not report.findings, render_text_findings(report.findings)
+        # asserted off the shared four-engine pass (one trace of the
+        # registry per test session, not one per test)
+        result = _full_audit_result()
+        assert len(result.programs_checked) >= 15
+        assert result.clean, render_text(result, verbose=True)
 
 
 def render_text_findings(findings):
@@ -389,13 +392,17 @@ class TestRunnerAndCLI:
 
     def test_exit_0_on_clean_tree(self, tmp_path, capsys):
         root = self._mini_repo(tmp_path, violate=False)
-        rc = audit_main(["--root", str(root), "--no-contracts"])
+        rc = audit_main(
+            ["--root", str(root), "--no-contracts", "--no-kernels"]
+        )
         assert rc == 0
         assert "0 new" in capsys.readouterr().out
 
     def test_exit_1_on_new_finding(self, tmp_path, capsys):
         root = self._mini_repo(tmp_path)
-        rc = audit_main(["--root", str(root), "--no-contracts"])
+        rc = audit_main(
+            ["--root", str(root), "--no-contracts", "--no-kernels"]
+        )
         assert rc == 1
         assert "PSA007" in capsys.readouterr().out
 
@@ -405,7 +412,7 @@ class TestRunnerAndCLI:
         bad.write_text("{not json")
         rc = audit_main(
             [
-                "--root", str(root), "--no-contracts",
+                "--root", str(root), "--no-contracts", "--no-kernels",
                 "--baseline", str(bad),
             ]
         )
@@ -415,7 +422,7 @@ class TestRunnerAndCLI:
         root = self._mini_repo(tmp_path)
         baseline = tmp_path / "baseline.json"
         args = [
-            "--root", str(root), "--no-contracts",
+            "--root", str(root), "--no-contracts", "--no-kernels",
             "--baseline", str(baseline),
         ]
         assert audit_main(args) == 1  # new finding
@@ -438,7 +445,7 @@ class TestRunnerAndCLI:
         self, tmp_path
     ):
         root = self._mini_repo(tmp_path)
-        result = run_audit(str(root), contracts=False)
+        result = run_audit(str(root), contracts=False, kernels=False)
         out = tmp_path / "audit.json"
         write_report(result, str(out))
         doc = json.loads(out.read_text())
@@ -454,11 +461,15 @@ class TestRunnerAndCLI:
     def test_rule_filter(self, tmp_path):
         root = self._mini_repo(tmp_path)
         result = run_audit(
-            str(root), contracts=False, rule_ids=["PSA006"]
+            str(root), contracts=False, kernels=False,
+            rule_ids=["PSA006"],
         )
         assert not result.findings  # PSA007 filtered out
         with pytest.raises(ValueError, match="unknown rule ids"):
-            run_audit(str(root), contracts=False, rule_ids=["NOPE"])
+            run_audit(
+                str(root), contracts=False, kernels=False,
+                rule_ids=["NOPE"],
+            )
 
     def test_list_rules(self, capsys):
         assert audit_main(["--list-rules"]) == 0
@@ -469,7 +480,7 @@ class TestRunnerAndCLI:
 
     def test_render_text_summarises_baselined(self):
         result = run_audit(
-            str(REPO_ROOT), contracts=False,
+            str(REPO_ROOT), contracts=False, kernels=False,
             baseline_path=str(REPO_ROOT / "audit_baseline.json"),
         )
         text = render_text(result)
@@ -485,6 +496,7 @@ class TestRepoIsClean:
         result = run_audit(
             str(REPO_ROOT),
             contracts=False,
+            kernels=False,
             baseline_path=str(REPO_ROOT / "audit_baseline.json"),
         )
         assert result.clean, render_text(result, verbose=True)
@@ -497,10 +509,305 @@ class TestRepoIsClean:
                 sys.executable, "-m", "peasoup_tpu.tools.audit",
                 "--root", str(REPO_ROOT),
                 "--baseline", str(REPO_ROOT / "audit_baseline.json"),
-                "--no-contracts",
+                "--no-contracts", "--no-kernels",
             ],
             capture_output=True,
             text=True,
             cwd=str(REPO_ROOT),
         )
         assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# --------------------------------------------------------------------------
+# bucket-ladder contracts (engine 2, ladder mode)
+# --------------------------------------------------------------------------
+
+
+def _ladder_toy(name, leak_rung=None, hook=True):
+    """Toy spec whose ShapeCtx hook builds a tiny program at the ctx's
+    nsamps — optionally leaking f64 at exactly one ladder rung (the
+    rung-dependent drift class the ladder pass exists to catch)."""
+
+    def param(ctx):
+        if ctx.fft_size <= 0:  # accept one ctx variant per rung
+            return None
+        n = int(ctx.nsamps)
+        factor = (
+            np.float64(2.0) if n == leak_rung else np.float32(2.0)
+        )
+        return (lambda x: x * factor, (sds((n,), "float32"),), {})
+
+    return ProgramSpec(
+        name=name,
+        build=lambda: (
+            lambda x: x * jnp.float32(2.0),
+            (sds((64,), "float32"),),
+            {},
+        ),
+        param=param if hook else None,
+    )
+
+
+class TestLadderContracts:
+    def test_rungs_walk_the_campaign_ladder(self):
+        from peasoup_tpu.analysis.contracts import ladder_rungs
+        from peasoup_tpu.campaign.runner import bucket_nsamps
+
+        rungs = ladder_rungs(2048, 3)
+        assert rungs == [2048, 3072, 4096]
+        assert all(bucket_nsamps(r) == r for r in rungs)
+
+    def test_ladder_ctxs_cover_every_hook_family(self):
+        from peasoup_tpu.analysis.contracts import ladder_shape_ctxs
+
+        ctxs = ladder_shape_ctxs(2048)
+        assert any(c.widths for c in ctxs)  # spsearch
+        assert any(c.fft_size > 0 for c in ctxs)  # search
+        assert any(c.stream_chunk > 0 for c in ctxs)  # streaming
+        assert any(c.subbands > 0 for c in ctxs)  # subband
+        assert any(c.subband_matmul for c in ctxs)  # subband matmul
+        assert any(c.nbits < 8 for c in ctxs)  # sub-byte unpacker
+        assert any(c.pos25 > c.pos5 >= 0 for c in ctxs)  # rednoise
+
+    def test_clean_toy_covers_all_rungs(self):
+        from peasoup_tpu.analysis.contracts import audit_programs_ladder
+
+        rep = audit_programs_ladder(specs=[_ladder_toy("toy.clean")])
+        assert not rep.findings
+        assert rep.coverage["toy.clean"] == rep.rungs
+
+    def test_rung_only_f64_leak_is_caught_and_tagged(self):
+        """The acceptance fixture: clean at the representative shapes
+        AND at rung 2048, f64 at rung 3072 only — invisible to the
+        representative pass, pinned by the ladder."""
+        from peasoup_tpu.analysis.contracts import audit_programs_ladder
+
+        toy = _ladder_toy("toy.rung_leak", leak_rung=3072)
+        assert not audit_program(toy)  # representative shapes: clean
+        rep = audit_programs_ladder(specs=[toy], rungs=[2048, 3072])
+        assert [f.rule for f in rep.findings] == ["PSC101"]
+        assert rep.findings[0].path == (
+            "ops-registry/toy.rung_leak@nsamps=3072"
+        )
+
+    def test_missing_hook_is_a_coverage_finding(self):
+        from peasoup_tpu.analysis.contracts import audit_programs_ladder
+
+        rep = audit_programs_ladder(
+            specs=[_ladder_toy("toy.nohook", hook=False)]
+        )
+        assert [f.rule for f in rep.findings] == ["PSC106"]
+        assert rep.coverage["toy.nohook"] == []
+
+    def test_raising_hook_is_a_finding_not_a_crash(self):
+        from peasoup_tpu.analysis.contracts import audit_programs_ladder
+
+        def bad_hook(ctx):
+            raise RuntimeError("boom")
+
+        spec = ProgramSpec(
+            name="toy.raises",
+            build=lambda: (lambda x: x, (sds((8,), "float32"),), {}),
+            param=bad_hook,
+        )
+        rep = audit_programs_ladder(specs=[spec], rungs=[2048])
+        assert any(f.rule == "PSC105" for f in rep.findings)
+        assert any(f.rule == "PSC106" for f in rep.findings)
+
+    def test_real_registry_is_ladder_clean(self):
+        """Every registered program is covered at >= 2 rungs and no
+        rung-dependent drift exists (the check.sh gate's ladder half;
+        asserted off the shared four-engine pass)."""
+        result = _full_audit_result()
+        assert result.clean
+        assert len(result.ladder_rungs) >= 2
+        assert set(result.ladder_coverage) == {
+            s.name for s in registered_programs()
+        }
+        assert all(
+            len(covered) >= 2
+            for covered in result.ladder_coverage.values()
+        ), result.ladder_coverage
+
+
+# --------------------------------------------------------------------------
+# Pallas kernel contracts (engine 4)
+# --------------------------------------------------------------------------
+
+
+class TestKernelEngine:
+    def _spec(self, **overrides):
+        import dataclasses
+
+        from peasoup_tpu.ops.pallas.registry import kernel_specs
+
+        spec = next(
+            s for s in kernel_specs() if s.name == "pallas.boxcar"
+        )
+        return dataclasses.replace(spec, **overrides)
+
+    def test_real_kernel_registry_is_clean(self):
+        result = _full_audit_result()
+        assert len(result.kernels_checked) >= 9
+        assert result.clean
+
+    def test_registry_covers_every_pallas_module(self):
+        """Every ops/pallas module that builds a kernel has a spec —
+        the PSK201 cross-reference from the registry side."""
+        from peasoup_tpu.ops.pallas.registry import kernel_specs
+
+        pallas_dir = REPO_ROOT / "peasoup_tpu" / "ops" / "pallas"
+        modules = {
+            p.stem
+            for p in pallas_dir.glob("*.py")
+            if p.stem not in ("__init__", "registry")
+            and "pallas_call" in p.read_text()
+        }
+        registered = {
+            s.module.rsplit(".", 1)[-1] for s in kernel_specs()
+        }
+        assert modules == registered
+
+    def test_deleted_probe_is_flagged(self):
+        """The acceptance fixture: a kernel whose probe was deleted
+        must fail the gate (PSK202), and run_audit maps it to new
+        findings (CLI exit 1)."""
+        from peasoup_tpu.analysis.kernels import audit_kernels
+
+        doctored = self._spec(probe="probe_pallas_deleted")
+        rep = audit_kernels(specs=[doctored])
+        assert [f.rule for f in rep.findings] == ["PSK202"]
+        assert "deleted" in rep.findings[0].message
+        result = run_audit(
+            str(REPO_ROOT), ast_engine=False, contracts=False,
+            kernel_specs=[doctored],
+        )
+        assert not result.clean  # exit 1 through the CLI mapping
+
+    def test_unreferenced_twin_is_flagged(self):
+        from peasoup_tpu.analysis.kernels import audit_kernels
+
+        doctored = self._spec(
+            twin="peasoup_tpu.ops.spectrum.spectrum_stats"
+        )
+        rep = audit_kernels(specs=[doctored])
+        assert [f.rule for f in rep.findings] == ["PSK202"]
+        assert "vacuous" in rep.findings[0].message
+
+    def test_broken_build_is_flagged(self):
+        from peasoup_tpu.analysis.kernels import audit_kernels
+
+        def broken_build(interpret=True):
+            raise ValueError("geometry drifted")
+
+        doctored = self._spec(build=broken_build)
+        rep = audit_kernels(specs=[doctored])
+        assert [f.rule for f in rep.findings] == ["PSK203"]
+
+    def test_mosaic_skipped_off_tpu_and_forced_flag(self):
+        from peasoup_tpu.analysis.kernels import audit_kernel
+
+        spec = self._spec()
+        # mosaic=False: interpret-only (the CPU CI path) — clean
+        assert not audit_kernel(spec, mosaic=False)
+
+
+# --------------------------------------------------------------------------
+# the four-engine acceptance gate
+# --------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=1)
+def _full_audit_result():
+    """ONE four-engine pass over the repo, shared by the acceptance
+    tests below (the engines are deterministic and read-only; running
+    them once keeps the suite inside the tier-1 wall budget)."""
+    return run_audit(
+        str(REPO_ROOT),
+        baseline_path=str(REPO_ROOT / "audit_baseline.json"),
+    )
+
+
+class TestFourEngineAcceptance:
+    def test_full_audit_is_clean_with_empty_baseline(self):
+        """The exact check.sh gate: all four engines over the repo,
+        EMPTY checked-in baseline, exit 0."""
+        baseline = REPO_ROOT / "audit_baseline.json"
+        assert json.loads(baseline.read_text())["fingerprints"] == {}
+        result = _full_audit_result()
+        assert result.clean, render_text(result, verbose=True)
+        assert result.files_scanned > 100
+        assert len(result.programs_checked) >= 30
+        assert len(result.kernels_checked) >= 9
+        assert len(result.ladder_rungs) >= 2
+        assert all(
+            len(v) >= 2 for v in result.ladder_coverage.values()
+        ), result.ladder_coverage
+        # the manifest round-trips the checked-in v2 schema
+        man = result.to_manifest()
+        assert man["version"] >= 2
+        with open(AUDIT_SCHEMA_PATH) as f:
+            validate(man, json.load(f))
+
+    def _mini_repo(self, tmp_path, relpath, body):
+        mod = tmp_path / "peasoup_tpu" / relpath
+        mod.parent.mkdir(parents=True, exist_ok=True)
+        mod.write_text(body)
+        return tmp_path
+
+    def test_injected_nonatomic_queue_write_exits_1(self, tmp_path):
+        root = self._mini_repo(
+            tmp_path, "campaign/writer.py",
+            "import os\n"
+            "def publish(doc, root):\n"
+            "    path = os.path.join(root, 'queue', 'jobs', 'j.json')\n"
+            "    with open(path, 'w') as f:\n"
+            "        f.write(doc)\n",
+        )
+        rc = audit_main(
+            ["--root", str(root), "--no-contracts", "--no-kernels"]
+        )
+        assert rc == 1
+
+    def test_injected_unguarded_thread_exits_1(self, tmp_path):
+        root = self._mini_repo(
+            tmp_path, "obs/spawn.py",
+            "import threading\n"
+            "def tick():\n"
+            "    pass\n"
+            "def go():\n"
+            "    threading.Thread(target=tick, daemon=True).start()\n",
+        )
+        rc = audit_main(
+            ["--root", str(root), "--no-contracts", "--no-kernels"]
+        )
+        assert rc == 1
+
+    def test_engine_toggles_silence_their_rules(self, tmp_path):
+        root = self._mini_repo(
+            tmp_path, "obs/spawn.py",
+            "import threading\n"
+            "def tick():\n"
+            "    pass\n"
+            "def go():\n"
+            "    threading.Thread(target=tick, daemon=True).start()\n",
+        )
+        rc = audit_main(
+            [
+                "--root", str(root), "--no-contracts", "--no-kernels",
+                "--no-protocol",
+            ]
+        )
+        assert rc == 0  # PSP104 is engine 3's; toggled off
+
+    def test_rung_only_f64_leak_fails_the_gate(self):
+        toy = _ladder_toy("toy.gate_leak", leak_rung=3072)
+        result = run_audit(
+            str(REPO_ROOT), ast_engine=False, kernels=False,
+            program_specs=[toy],
+        )
+        assert not result.clean
+        assert any(
+            f.rule == "PSC101" and f.path.endswith("@nsamps=3072")
+            for f in result.new
+        )

@@ -678,3 +678,72 @@ class TestPruneCorrupt:
 
         assert main(["prune", "-w", str(tmp_path)]) == 1
         assert "--corrupt" in capsys.readouterr().out
+
+
+class TestPruneProfiles:
+    """ISSUE 15 satellite: profile-capture retention —
+    `peasoup-campaign prune --profiles --older-than-days N` over the
+    on-demand jax.profiler capture dirs, counted in the rollup."""
+
+    def _plant_capture(self, root, name, age_days=0.0, nbytes=64):
+        cap = os.path.join(root, "profiles", name)
+        os.makedirs(cap, exist_ok=True)
+        with open(os.path.join(cap, "trace.json.gz"), "wb") as f:
+            f.write(b"x" * nbytes)
+        if age_days:
+            old = time.time() - age_days * 86400
+            os.utime(cap, (old, old))
+        return cap
+
+    def test_rollup_counts_capture_dirs(self, tmp_path):
+        root = str(tmp_path)
+        self._plant_capture(root, "w1-100", nbytes=100)
+        self._plant_capture(root, "w2-200", nbytes=50)
+        q = JobQueue(root)
+        q.add_job(Job(job_id="j", input="x.fil"))
+        st = build_status(root, q)
+        assert st["profiles"] == {"captures": 2, "bytes": 150}
+
+    def test_prune_profiles_respects_age_and_dry_run(
+        self, tmp_path, capsys
+    ):
+        from peasoup_tpu.cli.campaign import main
+
+        root = str(tmp_path)
+        old = self._plant_capture(root, "w1-100", age_days=10)
+        fresh = self._plant_capture(root, "w1-200")
+        rc = main(
+            [
+                "prune", "-w", root, "--profiles",
+                "--older-than-days", "7", "--dry-run",
+            ]
+        )
+        assert rc == 0
+        assert os.path.isdir(old) and os.path.isdir(fresh)
+        assert "would delete 1" in capsys.readouterr().out
+        rc = main(
+            [
+                "prune", "-w", root, "--profiles",
+                "--older-than-days", "7",
+            ]
+        )
+        assert rc == 0
+        assert not os.path.exists(old)
+        assert os.path.isdir(fresh)  # younger than the cutoff
+        q = JobQueue(root)
+        q.add_job(Job(job_id="j", input="x.fil"))
+        st = build_status(root, q)
+        assert st["profiles"]["captures"] == 1
+
+    def test_prune_both_selectors_compose(self, tmp_path, capsys):
+        from peasoup_tpu.cli.campaign import main
+
+        root = str(tmp_path)
+        cap = self._plant_capture(root, "w1-100", age_days=2)
+        bad = os.path.join(root, "x.json.corrupt")
+        with open(bad, "w") as f:
+            f.write("{torn")
+        rc = main(["prune", "-w", root, "--profiles", "--corrupt"])
+        assert rc == 0
+        assert not os.path.exists(cap) and not os.path.exists(bad)
+        assert "deleted 2" in capsys.readouterr().out

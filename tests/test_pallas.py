@@ -449,8 +449,17 @@ class TestPallasDedisperse:
         dms = np.linspace(0.0, dm_max, d)
         return np.rint(dms[:, None] * k[None, :]).astype(np.int32)
 
+    # the large/odd-row cases cost ~15 s each in the interpreter; one
+    # even and one odd geometry stay in the fast run, the rest ride
+    # the slow marker (the kernel itself is identical across them)
     @pytest.mark.parametrize(
-        "d,c,t", [(6, 16, 4096), (24, 32, 8192), (8, 16, 1500), (9, 17, 3000)]
+        "d,c,t",
+        [
+            (6, 16, 4096),
+            pytest.param(24, 32, 8192, marks=pytest.mark.slow),
+            (8, 16, 1500),
+            pytest.param(9, 17, 3000, marks=pytest.mark.slow),
+        ],
     )
     def test_matches_jnp_bitwise(self, rng, d, c, t):
         from peasoup_tpu.ops.dedisperse import dedisperse
