@@ -120,6 +120,9 @@ class Job:
     # vanish and the campaign rollup could no longer attribute every
     # injected fault to its recovery path — the chaos soak's invariant
     carried_resilience: dict = field(default_factory=dict)
+    # synthetic injection sentinel (obs/health.py): excluded from the
+    # campaign's data-quality baselines and flagged in the rollup
+    sentinel: bool = False
 
     def to_doc(self) -> dict:
         return {
@@ -138,6 +141,7 @@ class Job:
             "preemptions": self.preemptions,
             "preempt_latency_s": self.preempt_latency_s,
             "carried_resilience": self.carried_resilience,
+            "sentinel": self.sentinel,
         }
 
     @classmethod
@@ -161,6 +165,7 @@ class Job:
                 float(x) for x in (doc.get("preempt_latency_s") or [])
             ],
             carried_resilience=doc.get("carried_resilience") or {},
+            sentinel=bool(doc.get("sentinel", False)),
         )
 
 

@@ -259,6 +259,53 @@ def build_status(root: str, queue: JobQueue | None = None) -> dict:
             mbytes += os.path.getsize(p)
         except OSError:
             pass
+    # survey health (obs/alerts.py + obs/health.py): the alerts
+    # snapshot (schema-validated; a torn/invalid snapshot is reported,
+    # never raised — the rollup must always publish) and the
+    # data-quality baselines/outliers over the done records
+    from ..obs.alerts import load_alerts, validate_snapshot
+    from ..obs.health import data_quality_summary, sentinel_status
+
+    alerts_snapshot = load_alerts(root)
+    alerts_section: dict = {"firing": 0, "pending": 0, "resolved": 0}
+    try:
+        validate_snapshot(alerts_snapshot)
+        for a in alerts_snapshot.get("alerts", []):
+            st = a.get("state")
+            if st in alerts_section:
+                alerts_section[st] += 1
+        alerts_section["updated_unix"] = alerts_snapshot.get(
+            "updated_unix", 0.0
+        )
+        alerts_section["active"] = [
+            {
+                "rule": a.get("rule"),
+                "state": a.get("state"),
+                "severity": a.get("severity"),
+                "labels": a.get("labels") or {},
+                "value": a.get("value"),
+                "message": a.get("message", ""),
+                "since_unix": a.get("since_unix"),
+            }
+            for a in alerts_snapshot.get("alerts", [])
+            if a.get("state") in ("pending", "firing")
+        ]
+    except Exception as exc:
+        alerts_section = {"invalid": f"{exc!s:.200}"}
+    data_quality = data_quality_summary(done)
+    sentinels = sentinel_status(root, queue)
+    data_quality["sentinels"] = {
+        "total": len(sentinels),
+        "pending": sum(
+            1 for s in sentinels if s.get("status") == "pending"
+        ),
+        "recovered": sum(
+            1 for s in sentinels if s.get("status") == "recovered"
+        ),
+        "missed": sum(
+            1 for s in sentinels if s.get("status") == "missed"
+        ),
+    }
     return {
         "schema": CAMPAIGN_SCHEMA,
         "version": CAMPAIGN_VERSION,
@@ -304,6 +351,11 @@ def build_status(root: str, queue: JobQueue | None = None) -> dict:
         # autoscale controller decision log (None when no controller
         # has acted on this campaign)
         "autoscale": autoscale,
+        # survey health: alert lifecycle counts + active alerts
+        # (obs/alerts.py snapshot) and the scientific data-quality
+        # baselines/outliers/sentinels (obs/health.py)
+        "alerts": alerts_section,
+        "data_quality": data_quality,
     }
 
 

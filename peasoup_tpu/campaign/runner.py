@@ -554,6 +554,25 @@ def run_observation(
         n_cands = len(result.candidates)
 
     tel.gauge("candidates.written", n_cands)
+    # scientific data-quality gauges (obs/health.py) over the block
+    # already in memory: advisory — a failure degrades to "no gauges",
+    # never to a failed job
+    quality: dict = {}
+    try:
+        from ..obs.health import observation_quality
+
+        quality = observation_quality(
+            fil.data[:orig_nsamps],
+            n_candidates=n_cands,
+            n_dm_trials=len(result.dm_list),
+            nbits=fil.nbits,
+        )
+        for qk, qv in quality.items():
+            tel.gauge(f"dq.{qk}", qv)
+    except Exception:
+        log.warning(
+            "quality gauges failed for %s", job.job_id, exc_info=True
+        )
     info = {
         "n_candidates": n_cands,
         "pipeline": job.pipeline,
@@ -561,6 +580,10 @@ def run_observation(
         "duration_s": round(time.perf_counter() - t0, 3),
         "padded_from": orig_nsamps if fil.nsamps != orig_nsamps else None,
     }
+    if quality:
+        info["quality"] = quality
+    if job.sentinel:
+        info["sentinel"] = True
     if warmup_stats is not None:
         info["warmup_s"] = float(warmup_stats["seconds"])
         info["warmup"] = warmup_stats
@@ -851,6 +874,7 @@ class CampaignRunner:
         )
         self._profile_thread: threading.Thread | None = None
         self._last_queue_sample = 0.0
+        self._last_alert_eval = 0.0
         # the persistent XLA cache backs the in-process caches across
         # worker restarts (utils/cache.py)
         from ..utils.cache import enable_compilation_cache
@@ -1361,6 +1385,11 @@ class CampaignRunner:
                 m.counter("gang_jobs_total")
             if info.get("degraded"):
                 m.counter("degraded_jobs_total")
+            # scientific data-quality gauges (obs/health.py): the last
+            # job's values as worker-level series for the sparklines;
+            # campaign baselines read the done records, not these
+            for qk, qv in sorted((info.get("quality") or {}).items()):
+                m.gauge(f"dq_{qk}", float(qv))
         except Exception:  # metrics must never fail a completed job
             log.debug("job metrics recording failed", exc_info=True)
 
@@ -1383,8 +1412,35 @@ class CampaignRunner:
                     "queue_depth", counts.get(state, 0), state=state
                 )
             self.metrics.gauge("queue_jobs_total", counts.get("total", 0))
+            # liveness series for the heartbeat-absence alert rule
+            now_unix = time.time()
+            self.metrics.gauge("worker_heartbeat_unix", now_unix)
         except Exception:
             log.debug("queue metrics sampling failed", exc_info=True)
+
+    def _evaluate_alerts(self, min_interval_s: float = 5.0) -> None:
+        """Throttled survey-health round (obs/alerts.py) beside the
+        status rollup. Any worker may run it; concurrent evaluators
+        serialise on the engine's lock file. Never fails the worker."""
+        now_mono = time.monotonic()
+        if now_mono - self._last_alert_eval < min_interval_s:
+            return
+        self._last_alert_eval = now_mono
+        try:
+            from ..obs.alerts import default_rules, evaluate_campaign
+
+            evaluate_campaign(
+                self.root,
+                rules=default_rules(
+                    heartbeat_s=max(
+                        float(self.campaign.heartbeat_interval), 0.1
+                    )
+                ),
+                queue=self.queue,
+                registry=self.registry,
+            )
+        except Exception:
+            log.debug("alert evaluation failed", exc_info=True)
 
     def _observe_profile(self) -> None:
         """The worker side of on-demand profiling: observe a
@@ -1485,6 +1541,7 @@ class CampaignRunner:
                 if claim is None:
                     self.registry.reap()
                     write_status(self.root, self.queue)
+                    self._evaluate_alerts()
                     if self.queue.drained() or not drain:
                         break
                     counts = self.queue.counts()
@@ -1523,11 +1580,13 @@ class CampaignRunner:
                     ),
                 )
                 write_status(self.root, self.queue)
+                self._evaluate_alerts()
             # dead peers' membership entries expire within one lease;
             # reap them on the way out so a drained campaign leaves a
             # clean registry (the fleet soak's zero-leak invariant)
             self.registry.reap()
             write_status(self.root, self.queue)
+            self._evaluate_alerts(min_interval_s=0.0)
         except WorkerKilled:
             # the simulated SIGKILL: a real kill runs no cleanup, so
             # the membership entry must stay behind for peers to reap

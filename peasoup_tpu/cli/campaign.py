@@ -273,6 +273,54 @@ def build_parser() -> argparse.ArgumentParser:
                     "(default 0 = all)")
     pr.add_argument("--dry-run", action="store_true",
                     help="list what would be deleted without deleting")
+
+    sv = sub.add_parser(
+        "serve", help="serve the per-campaign live status portal "
+        "(stdlib HTTP, read-only): /metrics (Prometheus exposition "
+        "incl. the ALERTS series), /status, /alerts, /jobs/<id>, the "
+        "sift report and bowtie plot",
+    )
+    sv.add_argument("-w", "--workdir", required=True)
+    sv.add_argument("--port", type=int, default=9100)
+    sv.add_argument("--host", default="127.0.0.1")
+    sv.add_argument("--max-requests", type=int, default=None,
+                    help="serve N requests then exit (for tests/gates; "
+                    "default: serve forever)")
+
+    al = sub.add_parser(
+        "alerts", help="print the campaign's alerts snapshot "
+        "(obs/alerts.py); --evaluate runs one evaluation round of the "
+        "default SLO/data-quality/sentinel rules first",
+    )
+    al.add_argument("-w", "--workdir", required=True)
+    al.add_argument("--evaluate", action="store_true",
+                    help="evaluate the rules against the current "
+                    "metrics before printing (workers also do this "
+                    "continuously while running)")
+    al.add_argument("--json", action="store_true",
+                    help="print the raw alerts.json snapshot")
+
+    se = sub.add_parser(
+        "sentinel", help="enqueue a synthetic-pulsar injection "
+        "sentinel at low priority: the campaign searches it like any "
+        "observation, and the alert engine pages when the known "
+        "candidate is NOT recovered — an end-to-end scientific "
+        "validity probe",
+    )
+    se.add_argument("-w", "--workdir", required=True)
+    se.add_argument("--check", action="store_true",
+                    help="report recovery status of existing sentinels "
+                    "instead of enqueueing a new one")
+    se.add_argument("--min-snr", type=float, default=7.0,
+                    help="S/N the recovered candidate must reach "
+                    "(default 7)")
+    se.add_argument("--dm-tol", type=float, default=5.0,
+                    help="DM match tolerance in pc/cm^3 (default 5)")
+    se.add_argument("--time-tol", type=float, default=0.05,
+                    help="arrival-time match tolerance in seconds "
+                    "(default 0.05)")
+    se.add_argument("--nsamps", type=int, default=1 << 12,
+                    help="synthetic observation length (default 4096)")
     return p
 
 
@@ -687,6 +735,88 @@ def _cmd_prune(args) -> int:
     return 0
 
 
+def _cmd_serve(args) -> int:
+    from ..obs.portal import serve_portal
+
+    try:
+        serve_portal(
+            args.workdir,
+            port=args.port,
+            host=args.host,
+            max_requests=args.max_requests,
+        )
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def _cmd_alerts(args) -> int:
+    from ..obs.alerts import evaluate_campaign, load_alerts
+
+    if args.evaluate:
+        snap = evaluate_campaign(args.workdir)
+    else:
+        snap = load_alerts(args.workdir)
+    if args.json:
+        print(json.dumps(snap, indent=2))
+        return 0
+    alerts = snap.get("alerts") or []
+    if not alerts:
+        print("no alerts (campaign healthy, or never evaluated)")
+        return 0
+    firing = 0
+    for a in alerts:
+        labels = a.get("labels") or {}
+        lbl = " ".join(f"{k}={v}" for k, v in sorted(labels.items()))
+        if a.get("state") == "firing":
+            firing += 1
+        line = (
+            f"[{a.get('state'):>8}] {a.get('severity', '?'):<4} "
+            f"{a.get('rule')}"
+        )
+        if lbl:
+            line += f"  {lbl}"
+        if a.get("message"):
+            line += f"  {a['message']}"
+        print(line)
+    return 2 if firing else 0
+
+
+def _cmd_sentinel(args) -> int:
+    from ..obs.health import enqueue_sentinel, sentinel_status
+
+    if args.check:
+        rows = sentinel_status(args.workdir)
+        if not rows:
+            print("no sentinels enqueued")
+            return 0
+        missed = 0
+        for r in rows:
+            if r["status"] == "missed":
+                missed += 1
+            print(
+                f"[{r['status']:>9}] {r['job_id']}  "
+                f"dm={r.get('dm', 0):g} t={r.get('time_s', 0):g}s  "
+                f"{r.get('detail', '')}"
+            )
+        return 2 if missed else 0
+    doc = enqueue_sentinel(
+        args.workdir,
+        min_snr=args.min_snr,
+        dm_tol=args.dm_tol,
+        time_tol_s=args.time_tol,
+        nsamps=args.nsamps,
+    )
+    print(
+        f"sentinel enqueued as {doc['job_id']} (priority -1): "
+        f"injected DM {doc['dm']:g} at t={doc['time_s']:g}s; recovery "
+        "is checked after the job completes and ingests "
+        "(`peasoup-campaign sentinel --check`, or the "
+        "sentinel_unrecovered alert)"
+    )
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     return {
@@ -701,6 +831,9 @@ def main(argv: list[str] | None = None) -> int:
         "trace": _cmd_trace,
         "profile": _cmd_profile,
         "prune": _cmd_prune,
+        "serve": _cmd_serve,
+        "alerts": _cmd_alerts,
+        "sentinel": _cmd_sentinel,
     }[args.cmd](args)
 
 

@@ -1,0 +1,215 @@
+"""Per-campaign live status portal (stdlib HTTP, read-only).
+
+One scrape target and one operator URL per campaign: the GSP-style
+serving layer the survey-as-a-service direction needs, with zero new
+dependencies. The server only ever READS the campaign tree's atomic
+artifacts (every one is published via tmp + ``os.replace`` or
+append-only JSONL), so it can run beside any number of workers — or on
+a different host sharing the campaign filesystem — without joining any
+protocol.
+
+Endpoints:
+
+- ``/metrics`` — Prometheus exposition over every worker's time series
+  plus the ``ALERTS`` convention series from the alerts snapshot.
+- ``/status`` — the campaign rollup JSON (the ``campaign_status.json``
+  the workers maintain; rebuilt in-memory when absent).
+- ``/alerts`` — the alerts snapshot JSON.
+- ``/jobs/<id>`` — one job's queue record, done record, quarantine
+  record and trace summary.
+- ``/report`` and ``/bowtie.svg`` — the sift HTML report and bowtie
+  plot when the campaign has been sifted.
+- ``/`` — a small HTML index linking the above.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import os
+
+from .log import get_logger
+
+log = get_logger("obs.portal")
+
+_JOB_ID_OK = frozenset(
+    "abcdefghijklmnopqrstuvwxyz"
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789._-"
+)
+
+
+def _read_json(path: str) -> dict | None:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _metrics_body(root: str) -> bytes:
+    from .alerts import alerts_exposition, load_alerts
+    from .metrics import fleet_samples, prometheus_exposition
+
+    body = prometheus_exposition(fleet_samples(root))
+    body += alerts_exposition(load_alerts(root))
+    return body.encode()
+
+
+def _status_body(root: str) -> bytes:
+    doc = _read_json(os.path.join(root, "campaign_status.json"))
+    if doc is None:
+        from ..campaign.rollup import build_status
+
+        doc = build_status(root)
+    return (json.dumps(doc, indent=2) + "\n").encode()
+
+
+def _alerts_body(root: str) -> bytes:
+    from .alerts import load_alerts
+
+    return (json.dumps(load_alerts(root), indent=2) + "\n").encode()
+
+
+def _job_body(root: str, job_id: str) -> bytes | None:
+    if not job_id or any(c not in _JOB_ID_OK for c in job_id):
+        return None
+    job = _read_json(
+        os.path.join(root, "queue", "jobs", f"{job_id}.json")
+    )
+    if job is None:
+        return None
+    from .trace import load_spans, trace_paths, trace_summary
+
+    doc = {
+        "job": job,
+        "done": _read_json(
+            os.path.join(root, "queue", "done", f"{job_id}.json")
+        ),
+        "quarantine": _read_json(
+            os.path.join(root, "queue", "quarantine", f"{job_id}.json")
+        ),
+        "trace": trace_summary(
+            load_spans(trace_paths(os.path.join(root, "jobs", job_id)))
+        ),
+    }
+    return (json.dumps(doc, indent=2) + "\n").encode()
+
+
+def _file_body(path: str) -> bytes | None:
+    try:
+        with open(path, "rb") as f:
+            return f.read()
+    except OSError:
+        return None
+
+
+def _index_body(root: str) -> bytes:
+    from .alerts import load_alerts
+
+    snap = load_alerts(root)
+    by_state: dict[str, int] = {}
+    for a in snap.get("alerts", []):
+        by_state[a["state"]] = by_state.get(a["state"], 0) + 1
+    st = _read_json(os.path.join(root, "campaign_status.json")) or {}
+    queue = st.get("queue") or {}
+    rows = "".join(
+        f"<tr><td>{html.escape(str(k))}</td>"
+        f"<td>{html.escape(str(v))}</td></tr>"
+        for k, v in sorted(queue.items())
+    )
+    alert_line = ", ".join(
+        f"{by_state.get(s, 0)} {s}"
+        for s in ("firing", "pending", "resolved")
+    )
+    doc = (
+        "<!DOCTYPE html><html><head><title>peasoup campaign</title>"
+        "</head><body>"
+        f"<h1>campaign {html.escape(os.path.basename(root) or root)}"
+        "</h1>"
+        f"<p>alerts: {alert_line}</p>"
+        f"<table>{rows}</table>"
+        '<ul><li><a href="/metrics">/metrics</a></li>'
+        '<li><a href="/status">/status</a></li>'
+        '<li><a href="/alerts">/alerts</a></li>'
+        '<li><a href="/report">sift report</a></li>'
+        '<li><a href="/bowtie.svg">bowtie</a></li></ul>'
+        "</body></html>"
+    )
+    return doc.encode()
+
+
+def serve_portal(
+    root: str,
+    port: int = 9100,
+    host: str = "127.0.0.1",
+    max_requests: int | None = None,
+) -> None:
+    """Serve the campaign portal. Blocks; ``max_requests`` bounds it
+    for tests and the check gate."""
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    root = os.path.abspath(root)
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self) -> None:  # noqa: N802 (http.server contract)
+            try:
+                body, ctype = self._route(self.path)
+            except Exception as exc:
+                self.send_error(500, f"{type(exc).__name__}: {exc}")
+                return
+            if body is None:
+                self.send_error(404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _route(self, path: str):
+            path = path.split("?", 1)[0].rstrip("/") or "/"
+            if path == "/":
+                return _index_body(root), "text/html; charset=utf-8"
+            if path == "/metrics":
+                return _metrics_body(root), "text/plain; version=0.0.4"
+            if path == "/status":
+                return _status_body(root), "application/json"
+            if path == "/alerts":
+                return _alerts_body(root), "application/json"
+            if path.startswith("/jobs/"):
+                return (
+                    _job_body(root, path[len("/jobs/"):]),
+                    "application/json",
+                )
+            if path == "/report":
+                return (
+                    _file_body(
+                        os.path.join(root, "sift", "report.html")
+                    ),
+                    "text/html; charset=utf-8",
+                )
+            if path == "/bowtie.svg":
+                return (
+                    _file_body(
+                        os.path.join(root, "sift", "bowtie.svg")
+                    ),
+                    "image/svg+xml",
+                )
+            return None, ""
+
+        def log_message(self, fmt, *args) -> None:
+            log.debug("portal http: " + fmt, *args)
+
+    server = HTTPServer((host, port), _Handler)
+    log.info(
+        "serving campaign portal at http://%s:%d/ (root %s)",
+        host, server.server_address[1], root,
+    )
+    try:
+        if max_requests is None:
+            server.serve_forever()
+        else:
+            for _ in range(max_requests):
+                server.handle_request()
+    finally:
+        server.server_close()

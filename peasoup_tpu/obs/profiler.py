@@ -95,6 +95,7 @@ def start_profile_capture(
     duration_s: float,
     metrics=None,
     telemetry=None,
+    allow_cpu: bool = False,
 ):
     """Run :func:`capture_device_profile` on a daemon helper thread
     (under the resilience crash guard) so the caller's beat/claim loop
@@ -105,7 +106,8 @@ def start_profile_capture(
 
     def _capture() -> None:
         outcome = capture_device_profile(
-            outdir, duration_s=duration_s, telemetry=telemetry
+            outdir, duration_s=duration_s, telemetry=telemetry,
+            allow_cpu=allow_cpu,
         )
         if metrics is not None:
             metrics.counter(

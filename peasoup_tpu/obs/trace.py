@@ -39,6 +39,7 @@ import os
 import threading
 import time
 import uuid
+import zlib
 
 from .log import get_logger
 
@@ -69,7 +70,7 @@ def current_tracer() -> "Tracer | None":
 
 
 @contextlib.contextmanager
-def job_span(name: str, cat: str = "job", **args):
+def job_span(name: str, cat: str = "job", flow_id=None, **args):
     """Span on the ambient tracer (no-op when none is active) — how
     deep pipeline code marks waves/checkpoints without threading a
     tracer through every signature."""
@@ -77,8 +78,17 @@ def job_span(name: str, cat: str = "job", **args):
     if tracer is None or not tracer.enabled:
         yield
         return
-    with tracer.span(name, cat=cat, **args):
+    with tracer.span(name, cat=cat, flow_id=flow_id, **args):
         yield
+
+
+def flow_id_for(*parts) -> int:
+    """Deterministic Perfetto flow id from shared coordinates — every
+    rank of a gang computes the SAME id for the same (gang, context,
+    round) without any extra exchange, which is what lets the
+    leader's barrier-wait span link to each member's wave span."""
+    key = "|".join(str(p) for p in parts).encode()
+    return zlib.crc32(key) & 0xFFFFFFFF
 
 
 def job_instant(name: str, **args) -> None:
@@ -127,7 +137,9 @@ class Tracer:
         except OSError:
             log.debug("trace append failed: %s", self.path, exc_info=True)
 
-    def _base(self, name: str, cat: str, args: dict) -> dict:
+    def _base(
+        self, name: str, cat: str, args: dict, flow_id=None
+    ) -> dict:
         rec: dict = {
             "trace_id": self.trace_id,
             "span_id": new_span_id(),
@@ -137,15 +149,22 @@ class Tracer:
             "pid": self.pid,
             "tid": threading.current_thread().name,
         }
+        if flow_id is not None:
+            # cross-process link: spans sharing a flow id (e.g. a gang
+            # barrier round computed identically on every rank) render
+            # as connected arrows in Perfetto
+            rec["flow_id"] = int(flow_id)
         if args:
             rec["args"] = args
         return rec
 
-    def begin(self, name: str, cat: str = "job", **args) -> str:
+    def begin(
+        self, name: str, cat: str = "job", flow_id=None, **args
+    ) -> str:
         """Open a span; returns its id for :meth:`end`."""
         if not self.enabled:
             return ""
-        rec = self._base(name, cat, args)
+        rec = self._base(name, cat, args, flow_id=flow_id)
         now_unix = time.time()  # span walls are epochs shared across hosts
         rec["ts_unix"] = now_unix
         rec["_t0"] = time.perf_counter()
@@ -166,8 +185,8 @@ class Tracer:
         self._write(rec)
 
     @contextlib.contextmanager
-    def span(self, name: str, cat: str = "job", **args):
-        sid = self.begin(name, cat=cat, **args)
+    def span(self, name: str, cat: str = "job", flow_id=None, **args):
+        sid = self.begin(name, cat=cat, flow_id=flow_id, **args)
         try:
             yield
         finally:
@@ -325,6 +344,15 @@ def trace_summary(spans: list[dict]) -> dict:
         1 for s in spans
         if not isinstance(s.get("dur_s"), (int, float))
     )
+    # flow linkage: a flow id is "linked" when spans from more than
+    # one worker process carry it (the gang-barrier invariant)
+    flow_workers: dict[int, set] = {}
+    for s in spans:
+        fid = s.get("flow_id")
+        if isinstance(fid, int):
+            flow_workers.setdefault(fid, set()).add(
+                s.get("worker") or f"pid{s.get('pid', 0)}"
+            )
     return {
         "n_spans": len(spans),
         "trace_ids": trace_ids,
@@ -333,6 +361,10 @@ def trace_summary(spans: list[dict]) -> dict:
         "unclosed": unclosed,
         "forced_ends": sum(1 for s in spans if s.get("forced_end")),
         "span_names": sorted({s.get("name", "") for s in spans}),
+        "n_flows": len(flow_workers),
+        "flows_linked": sum(
+            1 for ws in flow_workers.values() if len(ws) > 1
+        ),
     }
 
 
@@ -364,6 +396,7 @@ def export_chrome_trace(
                 "tid": 0, "args": {"name": w},
             }
         )
+    flow_members: dict[int, list[dict]] = {}
     for s in spans:
         w = s.get("worker") or f"pid{s.get('pid', 0)}"
         ts_us = (float(s.get("ts_unix", t0)) - t0) * 1e6
@@ -389,6 +422,29 @@ def export_chrome_trace(
                     ),
                 }
             )
+            fid = s.get("flow_id")
+            if isinstance(fid, int):
+                flow_members.setdefault(fid, []).append(base)
+    # flow arrows: one s → t... → f chain per flow id, each event
+    # bound to (same pid/tid/ts as) the slice that carries the id
+    for fid, members in sorted(flow_members.items()):
+        if len(members) < 2:
+            continue
+        members.sort(key=lambda b: b["ts"])
+        for i, b in enumerate(members):
+            ph = "s" if i == 0 else ("f" if i == len(members) - 1 else "t")
+            fev = {
+                "name": b["name"],
+                "cat": b["cat"],
+                "ph": ph,
+                "id": fid,
+                "pid": b["pid"],
+                "tid": b["tid"],
+                "ts": b["ts"],
+            }
+            if ph == "f":
+                fev["bp"] = "e"  # bind to enclosing slice
+            events.append(fev)
     if extra:
         apid = len(workers) + 1
         events.append(

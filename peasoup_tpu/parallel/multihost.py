@@ -35,7 +35,7 @@ from jax.sharding import Mesh
 
 from ..obs import get_logger
 from ..obs.telemetry import current as current_telemetry
-from ..obs.trace import job_span
+from ..obs.trace import flow_id_for, job_span
 from ..resilience import TransientIOError, faults
 from .mesh import make_mesh
 
@@ -256,9 +256,15 @@ class GangComm:
         last_beat = 0.0
         # the barrier wait is a span in the job's connected trace (a
         # no-op when the campaign runner has no tracer active): gang
-        # stragglers become visible as long gang_barrier spans
+        # stragglers become visible as long gang_barrier spans. Every
+        # rank derives the SAME flow id from shared coordinates, so
+        # Perfetto draws arrows linking the leader's barrier wait to
+        # each member's span for the same round.
         with job_span(
             "gang_barrier", cat="sched",
+            flow_id=flow_id_for(
+                os.path.basename(self.gang_dir), context or "barrier", rnd
+            ),
             context=context or "barrier", round=rnd, rank=self.rank,
         ):
             return self._await_round(rnd, context, deadline, last_beat)

@@ -579,6 +579,11 @@ class StreamingSearch:
                     misses=miss,
                 )
             metrics.observe("chunk_latency_seconds", lat)
+            # the chunk-latency SLO feed (obs/alerts.py burn-rate
+            # rules): cumulative traffic + miss counters
+            metrics.counter("chunks_total")
+            if miss:
+                metrics.counter("chunk_slo_miss_total")
 
             # --- compile accounting (the zero-recompile contract) -----
             from ..campaign.runner import jit_programs_compiled

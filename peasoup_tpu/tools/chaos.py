@@ -56,7 +56,9 @@ REPORT_SCHEMA = "peasoup_tpu.chaos_report"
 # v3: preempt/gang/autoscale in the fleet schedule
 # v4: fleet "observability" section — schema-valid metrics series,
 #     exposition round-trip, per-job trace connectivity/unclosed spans
-REPORT_VERSION = 4
+# v5: on-demand profile drill over the request protocol, gang barrier
+#     flow-id linkage, and the survey-health alerts snapshot
+REPORT_VERSION = 5
 
 DEFAULT_CAMPAIGN_FAULTS = (
     "fil.read:p=0.25:n=4,db.ingest:at=1,worker.kill:at=obs0"
@@ -746,6 +748,10 @@ def run_fleet_soak(
     claims_dir = os.path.join(root, "queue", "claims")
     done_dir = os.path.join(root, "queue", "done")
     timed_out = False
+    profile_drilled: dict | None = None
+    from ..campaign.registry import WorkerRegistry as _Registry
+
+    soak_registry = _Registry(root, lease_s=lease_s)
     while True:
         if time.perf_counter() - t0 > timeout_s:
             timed_out = True
@@ -837,6 +843,29 @@ def run_fleet_soak(
                 spawn(role)
                 joins.append(role["worker_id"])
             late_pending = []
+        # profile drill: once the fleet has made first progress, ask a
+        # live, non-victim worker for an on-demand capture through the
+        # real request protocol — on CPU backends the capture is a
+        # guarded no-op, but the worker must still observe the marker,
+        # clear it and announce the outcome in its metrics stream
+        if profile_drilled is None and os.listdir(done_dir):
+            for ent in soak_registry.live():
+                wid = ent.get("worker_id")
+                if not wid or wid in pending_victims:
+                    continue
+                proc_ent = procs.get(wid)
+                if proc_ent is None or proc_ent["proc"].poll() is not None:
+                    continue
+                if proc_ent["role"].get("max_jobs"):
+                    # early leavers may exit before observing the
+                    # marker; drill a stayer so the check is sound
+                    continue
+                soak_registry.request_profile(
+                    wid, seconds=0.2, requester="chaos-soak"
+                )
+                profile_drilled = {"worker_id": wid, "seconds": 0.2}
+                log.info("fleet: profile drill requested on %s", wid)
+                break
         # kills: a victim dies by REAL SIGKILL the moment it holds a
         # claim (plus a beat so the job is genuinely under way) — the
         # worst case for exactly-once, recovered only by lease reaping
@@ -1128,6 +1157,37 @@ def run_fleet_soak(
             obs_section["metrics"]["preemption_latency_max_s"] = round(
                 max(r["value"] for r in plat), 4
             )
+    # profile drill attribution: the worker must have observed the
+    # request (marker cleared) and announced the capture outcome —
+    # captured on a device backend, skipped on the CPU guard, either
+    # way a profile_captures_total sample with an outcome label
+    if profile_drilled is not None:
+        pcaps = obs_metrics.series(
+            fleet_metrics, "profile_captures_total", "counter"
+        )
+        outcomes = sorted(
+            {
+                (r.get("labels") or {}).get("outcome", "")
+                for r in pcaps
+            }
+        )
+        obs_section["profile"] = {
+            "drilled": profile_drilled,
+            "samples": len(pcaps),
+            "outcomes": outcomes,
+        }
+        if not pcaps:
+            violations.append(
+                "profile drill requested on "
+                f"{profile_drilled['worker_id']} but no "
+                "profile_captures_total metric was announced"
+            )
+        wid = profile_drilled["worker_id"]
+        if soak_registry.profile_requested(wid) is not None:
+            violations.append(
+                f"profile drill: request marker for {wid} never "
+                "cleared (worker did not observe it)"
+            )
     preempted_ids = {
         d.get("job_id") for d in done if d.get("preemptions")
     }
@@ -1140,6 +1200,8 @@ def run_fleet_soak(
             "connected": summ["connected"],
             "workers": summ["workers"],
             "unclosed": summ["unclosed"],
+            "n_flows": summ["n_flows"],
+            "flows_linked": summ["flows_linked"],
             "attempts": sum(
                 1 for s in spans if s.get("name") == "job_attempt"
             ),
@@ -1176,6 +1238,45 @@ def run_fleet_soak(
                 f"{summ['workers']} — expected both members' "
                 "processes in one connected trace"
             )
+        if (
+            j in gang_job_ids
+            and len(summ["workers"]) >= 2
+            and not summ["flows_linked"]
+        ):
+            violations.append(
+                f"gang job {j}: no flow id links the members' "
+                "gang_barrier spans (expected the same deterministic "
+                "flow id on every rank of each barrier round)"
+            )
+
+    # --- survey-health alerts over the settled tree -------------------
+    # the workers evaluated the default SLO/data-quality rules while
+    # running; the snapshot must exist and validate (what fired is
+    # campaign-dependent — the lifecycle itself is drilled by
+    # scripts/check.sh with a controlled clock)
+    try:
+        from ..obs.alerts import load_alerts, validate_snapshot
+
+        alerts_snap = load_alerts(root)
+        validate_snapshot(alerts_snap)
+        by_state: dict[str, int] = {}
+        for a in alerts_snap.get("alerts", []):
+            by_state[a["state"]] = by_state.get(a["state"], 0) + 1
+        obs_section["alerts"] = {
+            "states": by_state,
+            "updated_unix": alerts_snap.get("updated_unix"),
+        }
+        if not os.path.exists(
+            os.path.join(root, "queue", "alerts.json")
+        ):
+            violations.append(
+                "fleet workers never wrote an alerts snapshot "
+                "(queue/alerts.json missing after the soak)"
+            )
+    except Exception as exc:
+        violations.append(
+            f"alerts snapshot invalid after the soak: {exc!s:.200}"
+        )
 
     # --- autoscale attribution ----------------------------------------
     scale_section = None

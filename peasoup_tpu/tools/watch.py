@@ -219,6 +219,69 @@ def render_status(st: dict, stale_after: float = 0.0) -> str:
     return "\n".join(lines) + "\n"
 
 
+def render_alerts(sec: dict) -> list[str]:
+    """Lines for a campaign rollup's ``alerts`` section (written by
+    peasoup_tpu/obs/alerts.py via the rollup): active alerts loud,
+    resolved as a tally, nothing when the campaign is healthy."""
+    lines: list[str] = []
+    if sec.get("invalid"):
+        return [f"  *** alerts snapshot invalid: {sec['invalid']} ***"]
+    firing = sec.get("firing", 0)
+    pending = sec.get("pending", 0)
+    resolved = sec.get("resolved", 0)
+    if firing or pending or resolved:
+        lines.append(
+            f"  alerts: {firing} firing  {pending} pending  "
+            f"{resolved} resolved"
+        )
+    for a in sec.get("active") or []:
+        labels = a.get("labels") or {}
+        lbl = " ".join(f"{k}={v}" for k, v in sorted(labels.items()))
+        mark = "***" if a.get("state") == "firing" else "  -"
+        line = (
+            f"  {mark} [{a.get('severity', '?')}] {a.get('rule', '?')}"
+            f" ({a.get('state')})"
+        )
+        if lbl:
+            line += f"  {lbl}"
+        if a.get("message"):
+            line += f": {a['message']}"
+        lines.append(line)
+    return lines
+
+
+def render_data_quality(sec: dict) -> list[str]:
+    """Lines for a campaign rollup's ``data_quality`` section
+    (obs/health.py summaries): baselines + outliers + injection
+    sentinel tallies; quiet when there is nothing to say."""
+    lines: list[str] = []
+    base = sec.get("baselines") or {}
+    if base and sec.get("jobs"):
+        bits = [f"  data quality over {sec['jobs']} job(s):"]
+        for metric, rec in sorted(base.items()):
+            bits.append(
+                f"{metric} med {rec.get('median', 0):.3g}"
+            )
+        lines.append("  ".join(bits))
+    outliers = sec.get("outliers") or []
+    for o in outliers:
+        labels = o.get("labels") or {}
+        lines.append(
+            f"  *** DQ outlier: job {labels.get('job', '?')} "
+            f"{labels.get('metric', '?')} z={o.get('value', '?')} ***"
+        )
+    sent = sec.get("sentinels") or {}
+    if sent.get("total"):
+        line = (
+            f"  sentinels: {sent.get('recovered', 0)} recovered  "
+            f"{sent.get('pending', 0)} pending"
+        )
+        if sent.get("missed"):
+            line += f"  *** {sent['missed']} MISSED ***"
+        lines.append(line)
+    return lines
+
+
 def render_campaign_status(st: dict, stale_after: float = 0.0) -> str:
     """One compact text block for a campaign_status.json rollup."""
     q = st.get("queue") or {}
@@ -317,6 +380,10 @@ def render_campaign_status(st: dict, stale_after: float = 0.0) -> str:
                 + f" block={plan.get('dedisp_block', '?')} "
                 f"[{plan.get('source', '?')}]"
             )
+    if isinstance(st.get("alerts"), dict):
+        lines.extend(render_alerts(st["alerts"]))
+    if isinstance(st.get("data_quality"), dict):
+        lines.extend(render_data_quality(st["data_quality"]))
     if isinstance(st.get("resilience"), dict) and st["resilience"]:
         lines.extend(render_resilience(st["resilience"]))
     for rj in st.get("running_jobs") or []:

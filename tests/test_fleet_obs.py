@@ -1109,3 +1109,140 @@ class TestCLI:
             [os.path.join(root, "candidates.sqlite"), "-o", out]
         ) == 0
         assert open(out).read().startswith("<svg")
+
+
+# --------------------------------------------------------------------------
+# trace span links (Perfetto flow ids)
+# --------------------------------------------------------------------------
+
+class TestFlowLinks:
+    def _linked_spans(self, tmp_path):
+        """Two processes' span files carrying one shared flow id (the
+        gang-barrier shape) plus an unrelated span."""
+        from peasoup_tpu.obs.trace import flow_id_for
+
+        tid = new_trace_id()
+        fid = flow_id_for("gang-e1", "merge", 0)
+        for w in ("leader", "member"):
+            tr = Tracer(
+                str(tmp_path / f"trace-{w}.jsonl"), tid, worker=w
+            )
+            with tr.span("gang_barrier", cat="sched", flow_id=fid):
+                pass
+            with tr.span("wave"):
+                pass
+            tr.close()
+        return load_spans(
+            [str(tmp_path / f"trace-{w}.jsonl")
+             for w in ("leader", "member")]
+        )
+
+    def test_flow_id_deterministic_across_ranks(self):
+        from peasoup_tpu.obs.trace import flow_id_for
+
+        a = flow_id_for("gang-e1", "merge", 3)
+        b = flow_id_for("gang-e1", "merge", 3)
+        c = flow_id_for("gang-e1", "merge", 4)
+        assert a == b != c
+        assert 0 <= a <= 0xFFFFFFFF
+
+    def test_summary_counts_linked_flows(self, tmp_path):
+        spans = self._linked_spans(tmp_path)
+        summ = trace_summary(spans)
+        assert summ["n_flows"] == 1
+        assert summ["flows_linked"] == 1
+        # spans without a flow id stay plain
+        assert sum("flow_id" in s for s in spans) == 2
+
+    def test_single_worker_flow_not_linked(self, tmp_path):
+        from peasoup_tpu.obs.trace import flow_id_for
+
+        tr = Tracer(str(tmp_path / "trace-w.jsonl"), new_trace_id(),
+                    worker="w")
+        with tr.span("gang_barrier", flow_id=flow_id_for("g", "b", 0)):
+            pass
+        tr.close()
+        summ = trace_summary(load_spans(str(tmp_path / "trace-w.jsonl")))
+        assert summ["n_flows"] == 1 and summ["flows_linked"] == 0
+
+    def test_export_emits_flow_event_chain(self, tmp_path):
+        spans = self._linked_spans(tmp_path)
+        doc = export_chrome_trace(spans)
+        flows = [
+            e for e in doc["traceEvents"] if e["ph"] in ("s", "t", "f")
+        ]
+        assert {e["ph"] for e in flows} == {"s", "f"}
+        assert len({e["id"] for e in flows}) == 1
+        ends = [e for e in flows if e["ph"] == "f"]
+        assert all(e["bp"] == "e" for e in ends)
+        # flow events bind to their slices: same pid appears in both
+        slice_pids = {
+            e["pid"] for e in doc["traceEvents"]
+            if e["ph"] == "X" and e["name"] == "gang_barrier"
+        }
+        assert {e["pid"] for e in flows} == slice_pids
+        json.dumps(doc)
+
+    def test_gang_comm_ranks_share_flow_id(self, tmp_path):
+        """Both GangComm ranks tag the same barrier round with the
+        same flow id, independently computed."""
+        from peasoup_tpu.parallel.multihost import GangComm
+
+        gdir = str(tmp_path / "gang-e0")
+        tracers, threads = [], []
+
+        def member(rank: int) -> None:
+            tr = Tracer(
+                str(tmp_path / f"trace-r{rank}.jsonl"),
+                "t" * 16, worker=f"r{rank}",
+            )
+            tracers.append(tr)
+            comm = GangComm(gdir, nprocs=2, rank=rank, timeout_s=20.0)
+            with tr.activate():
+                blobs = comm.allgather(
+                    f"blob{rank}".encode(), context="merge"
+                )
+            assert blobs == [b"blob0", b"blob1"]
+            tr.close()
+
+        for rank in (0, 1):
+            t = threading.Thread(target=member, args=(rank,))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=30)
+        spans = load_spans(
+            [str(tmp_path / f"trace-r{r}.jsonl") for r in (0, 1)]
+        )
+        barriers = [s for s in spans if s["name"] == "gang_barrier"]
+        assert len(barriers) == 2
+        assert barriers[0]["flow_id"] == barriers[1]["flow_id"]
+        summ = trace_summary(spans)
+        assert summ["flows_linked"] == 1
+
+
+@pytest.mark.slow
+class TestProfilerRealCapture:
+    """Real (non-guarded) jax.profiler capture through the worker's
+    request protocol — the TPU-soak coverage the roadmap carried. On
+    CPU runs the capture path is exercised via allow_cpu; on an
+    accelerator backend it captures for real with no override."""
+
+    def test_start_profile_capture_end_to_end(self, tmp_path):
+        import jax
+
+        from peasoup_tpu.obs.profiler import start_profile_capture
+
+        backend = jax.default_backend()
+        rec = MetricsRecorder(str(tmp_path / "w.metrics.jsonl"))
+        out = str(tmp_path / "prof")
+        th = start_profile_capture(
+            out, 0.3, metrics=rec, allow_cpu=(backend == "cpu")
+        )
+        th.join(timeout=30)
+        caps = [
+            s for s in load_series(rec.path)
+            if s["name"] == "profile_captures_total"
+        ]
+        assert caps and caps[-1]["labels"]["outcome"] == "captured"
+        assert os.path.isdir(out) and os.listdir(out)
