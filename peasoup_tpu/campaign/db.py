@@ -52,7 +52,9 @@ DB_FILENAME = "candidates.sqlite"
 #:     before explicit versioning; detected by table presence.
 #: 2 — observations gain beam/src_raj/src_dej provenance and the
 #:     ``sift_*`` tables arrive (the peasoup-sift product).
-SCHEMA_VERSION = 2
+#: 3 — observations gain the ``tenant`` stamp (multi-tenant usage
+#:     accounting + per-tenant sift slices).
+SCHEMA_VERSION = 3
 
 
 class SchemaVersionError(RuntimeError):
@@ -191,9 +193,25 @@ def _migrate_1_to_2(conn: sqlite3.Connection) -> None:
     _exec_script(conn, _SCHEMA_SIFT)
 
 
+# column added to observations in version 3: the tenant stamp
+_OBS_V3_COLUMNS = (("tenant", "TEXT"),)
+
+
+def _migrate_2_to_3(conn: sqlite3.Connection) -> None:
+    """v2 -> v3: the observations.tenant stamp."""
+    existing = {
+        r[1] for r in conn.execute("PRAGMA table_info(observations)")
+    }
+    for col, typ in _OBS_V3_COLUMNS:
+        if col not in existing:
+            conn.execute(
+                f"ALTER TABLE observations ADD COLUMN {col} {typ}"
+            )
+
+
 #: in-place upgrades, keyed by FROM-version; applied in sequence until
 #: the file reads :data:`SCHEMA_VERSION`
-MIGRATIONS = {1: _migrate_1_to_2}
+MIGRATIONS = {1: _migrate_1_to_2, 2: _migrate_2_to_3}
 
 
 def _fnum(v, cast=float, default=None):
@@ -265,6 +283,7 @@ class CandidateDB:
             if v == 0:
                 _exec_script(self._conn, _SCHEMA_V1)
                 _migrate_1_to_2(self._conn)
+                _migrate_2_to_3(self._conn)
             else:
                 for step in range(v, SCHEMA_VERSION):
                     MIGRATIONS[step](self._conn)
@@ -289,7 +308,13 @@ class CandidateDB:
         self.close()
 
     # --- ingest -------------------------------------------------------
-    def ingest_job(self, job_id: str, job_dir: str, input_path: str = "") -> dict:
+    def ingest_job(
+        self,
+        job_id: str,
+        job_dir: str,
+        input_path: str = "",
+        tenant: str = "",
+    ) -> dict:
         """Ingest one completed job's outputs (idempotent: any prior
         rows for ``job_id`` are replaced in the same transaction).
         Returns counts of ingested rows per kind."""
@@ -331,8 +356,8 @@ class CandidateDB:
                 self._conn.execute(
                     "INSERT OR REPLACE INTO observations (job_id, "
                     "input, source_name, tstart, tsamp, nchans, nsamps, "
-                    "ingested_unix, beam, src_raj, src_dej) VALUES "
-                    "(?,?,?,?,?,?,?,?,?,?,?)",
+                    "ingested_unix, beam, src_raj, src_dej, tenant) "
+                    "VALUES (?,?,?,?,?,?,?,?,?,?,?,?)",
                     (
                         job_id,
                         input_path or hdr.get("rawdatafile", ""),
@@ -345,6 +370,7 @@ class CandidateDB:
                         _fnum(hdr.get("ibeam"), int, 0),
                         _fnum(hdr.get("src_raj"), float, 0.0),
                         _fnum(hdr.get("src_dej"), float, 0.0),
+                        tenant or "",
                     ),
                 )
                 self._conn.executemany(
@@ -408,6 +434,17 @@ class CandidateDB:
         return self._query(
             "SELECT * FROM observations ORDER BY tstart, job_id"
         )
+
+    def max_observation_rowid(self) -> int:
+        """High-water mark over ingested observations — the
+        incremental-sift watermark (``peasoup-sift run --incremental``
+        re-sifts only when this moved past the last run's recorded
+        value). A re-ingested job bumps its rowid (INSERT OR REPLACE),
+        which correctly reads as new data."""
+        rows = self._query(
+            "SELECT COALESCE(MAX(rowid), 0) AS hi FROM observations"
+        )
+        return int(rows[0]["hi"]) if rows else 0
 
     def all_candidates(self, kind: str | None = None) -> list[dict]:
         """Every candidate joined with its observation's provenance —

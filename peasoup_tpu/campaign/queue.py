@@ -100,6 +100,10 @@ class Job:
     bucket: tuple | None = None
     priority: int = 0  # higher claims sooner; outranks bucket affinity
     nprocs: int = 1  # >1: gang-scheduled across a named process group
+    # multi-tenant stamp (campaign/tenants.py): which tenant submitted
+    # this observation; empty = operator-owned (quota-exempt). Rides
+    # into done records, metrics labels and the usage ledger
+    tenant: str = ""
     # trace correlation (obs/trace.py): minted at enqueue, propagated
     # through claim docs / preempt requests / gang invitations, so a
     # preempted-and-resumed or gang-scheduled job renders as ONE
@@ -133,6 +137,7 @@ class Job:
             "bucket": list(self.bucket) if self.bucket else None,
             "priority": self.priority,
             "nprocs": self.nprocs,
+            "tenant": self.tenant,
             "trace_id": self.trace_id,
             "attempts": self.attempts,
             "next_eligible_unix": self.next_eligible_unix,
@@ -155,6 +160,7 @@ class Job:
             bucket=tuple(b) if b else None,
             priority=int(doc.get("priority", 0)),
             nprocs=int(doc.get("nprocs", 1)),
+            tenant=str(doc.get("tenant") or ""),
             trace_id=str(doc.get("trace_id") or ""),
             attempts=int(doc.get("attempts", 0)),
             next_eligible_unix=float(doc.get("next_eligible_unix", 0.0)),
@@ -200,6 +206,11 @@ class JobQueue:
         self.backoff_base_s = float(backoff_base_s)
         for sub in (_JOBS, _CLAIMS, _DONE, _QUARANTINE):
             os.makedirs(os.path.join(self.qdir, sub), exist_ok=True)
+        # tenant throttle-map cache: (valid_until_unix, map). The map
+        # is an O(jobs + claims + done) artifact scan; state() asks per
+        # job, so without the short TTL counts()/claim_next would go
+        # quadratic. Claim-time revalidation bypasses it (fresh=True)
+        self._throttle_cache: tuple[float, dict] = (0.0, {})
 
     # --- paths --------------------------------------------------------
     def _p(self, sub: str, job_id: str) -> str:
@@ -239,9 +250,29 @@ class JobQueue:
         doc = _read_json(self._p(_JOBS, job_id))
         return Job.from_doc(doc) if doc else None
 
+    def tenant_throttles(
+        self, now: float | None = None, fresh: bool = False
+    ) -> dict[str, dict]:
+        """Currently over-quota tenants (tenants.throttle_map), cached
+        for ~0.5s so per-job state() queries stay linear. ``fresh``
+        bypasses and refills the cache — the claim-time revalidation
+        path, where a stale admission would over-run a quota."""
+        now = time.time() if now is None else now
+        until, cached = self._throttle_cache
+        if not fresh and now < until:
+            return cached
+        # lazy import: tenants.py is pure stdlib, but keeping the
+        # dependency one-way (tenants never imports queue) needs the
+        # import at call time, mirroring add_job's obs.trace import
+        from .tenants import throttle_map
+
+        m = throttle_map(self.root, now=now)
+        self._throttle_cache = (now + 0.5, m)
+        return m
+
     def state(self, job_id: str, now: float | None = None) -> str:
         """Derived state: done | quarantined | running | stale |
-        backoff | pending | unknown."""
+        throttled | backoff | pending | unknown."""
         now = time.time() if now is None else now
         if os.path.exists(self._p(_DONE, job_id)):
             return "done"
@@ -257,12 +288,17 @@ class JobQueue:
         job = self.get_job(job_id)
         if job is None:
             return "unknown"
+        if job.tenant and job.tenant in self.tenant_throttles(now):
+            # over-quota tenants' jobs PARK (visible in counts, the
+            # rollup and watch) rather than claim — and rather than
+            # being dropped; the state clears when the quota releases
+            return "throttled"
         return "backoff" if job.next_eligible_unix > now else "pending"
 
     def counts(self) -> dict[str, int]:
         out = {
             "total": 0, "pending": 0, "backoff": 0, "running": 0,
-            "stale": 0, "done": 0, "quarantined": 0,
+            "stale": 0, "done": 0, "quarantined": 0, "throttled": 0,
         }
         now = time.time()
         for jid in self.job_ids():
@@ -297,6 +333,8 @@ class JobQueue:
         job = self.get_job(job_id)
         if job is None or job.next_eligible_unix > now:
             return None
+        if job.tenant and job.tenant in self.tenant_throttles(now):
+            return None  # tenant over quota: the job parks as throttled
         path = self._p(_CLAIMS, job_id)
 
         def _create_claim():
@@ -330,6 +368,21 @@ class JobQueue:
             # released — without this re-check a second worker would
             # re-run a terminal job (exactly-once violation seen as a
             # duplicate under load in the two-worker race test)
+            os.close(fd)
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+            return None
+        if job.tenant and job.tenant in self.tenant_throttles(
+            now, fresh=True
+        ):
+            # claim-time quota REVALIDATION: between the cached
+            # pre-check and winning the O_EXCL race another worker may
+            # have filled the tenant's last max_running slot. Our own
+            # claim file exists but its document is still unwritten, so
+            # the fresh scan (which skips unparsable claims) naturally
+            # excludes us — only OTHER holders count against the quota
             os.close(fd)
             try:
                 os.unlink(path)

@@ -292,6 +292,64 @@ def build_status(root: str, queue: JobQueue | None = None) -> dict:
         ]
     except Exception as exc:
         alerts_section = {"invalid": f"{exc!s:.200}"}
+    # multi-tenant view (campaign/tenants.py + usage.py): per-tenant
+    # queue-state tallies, quota spec, windowed device-seconds vs
+    # budget and the active throttle reason — plus the usage ledger
+    # (also written to queue/usage.json by write_status)
+    tenants_section: dict = {}
+    usage_section: dict = {}
+    try:
+        from .tenants import TenantRegistry, throttle_map
+        from .usage import build_usage
+
+        tenant_entries = TenantRegistry(root).entries()
+        if tenant_entries:
+            throttles = throttle_map(root, now=now)
+            usage_doc = build_usage(root, queue=queue, now=now)
+            usage_section = usage_doc.get("tenants", {})
+            per_tenant: dict[str, dict] = {
+                t.name: {
+                    "queued": 0, "running": 0, "throttled": 0,
+                    "done": 0, "quarantined": 0,
+                }
+                for t in tenant_entries
+            }
+            for jid in queue.job_ids():
+                job = queue.get_job(jid)
+                if job is None or not job.tenant:
+                    continue
+                tally = per_tenant.setdefault(job.tenant, {
+                    "queued": 0, "running": 0, "throttled": 0,
+                    "done": 0, "quarantined": 0,
+                })
+                st = queue.state(jid, now)
+                if st in ("pending", "backoff"):
+                    tally["queued"] += 1
+                elif st in ("running", "stale"):
+                    tally["running"] += 1
+                elif st in tally:
+                    tally[st] += 1
+            quotas = {t.name: t for t in tenant_entries}
+            for name, tally in sorted(per_tenant.items()):
+                t = quotas.get(name)
+                u = usage_section.get(name) or {}
+                tenants_section[name] = {
+                    **tally,
+                    "quota": t.quota_doc() if t else None,
+                    "window_device_s": (
+                        (u.get("window") or {}).get("device_seconds")
+                    ),
+                    "device_s_budget": (
+                        t.device_seconds if t and t.device_seconds
+                        else None
+                    ),
+                    "throttle": (
+                        (throttles.get(name) or {}).get("reason")
+                    ),
+                }
+    except Exception as exc:
+        tenants_section = {}
+        usage_section = {"invalid": f"{exc!s:.200}"}
     data_quality = data_quality_summary(done)
     sentinels = sentinel_status(root, queue)
     data_quality["sentinels"] = {
@@ -356,6 +414,11 @@ def build_status(root: str, queue: JobQueue | None = None) -> dict:
         # baselines/outliers/sentinels (obs/health.py)
         "alerts": alerts_section,
         "data_quality": data_quality,
+        # multi-tenant view: per-tenant queue tallies + quota/throttle
+        # state, and the usage ledger (device-seconds, jobs, bytes,
+        # compiles per tenant — campaign/usage.py)
+        "tenants": tenants_section,
+        "usage": usage_section,
     }
 
 
@@ -373,6 +436,15 @@ def write_status(root: str, queue: JobQueue | None = None) -> dict:
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
+    if doc.get("tenants"):
+        # the standalone usage ledger beside the snapshot: portal
+        # /usage and external accounting read the file, not the rollup
+        try:
+            from .usage import write_usage
+
+            write_usage(root, queue=queue)
+        except Exception:
+            pass  # usage must never fail the status write
     return doc
 
 

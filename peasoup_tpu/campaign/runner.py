@@ -283,6 +283,7 @@ def enqueue_entries(
     ladder: list[int] | None = None,
     priority: int = 0,
     nprocs: int = 1,
+    tenant: str = "",
 ) -> int:
     """Idempotently enqueue manifest entries; returns how many were
     new. ``priority`` is the default priority class; a per-entry
@@ -290,7 +291,11 @@ def enqueue_entries(
     sooner — queue.claim_next ranks priority above bucket affinity).
     ``nprocs`` (default / per-entry ``"nprocs"``) > 1 gang-schedules
     the job across a worker process group via the multi-host drivers —
-    supported for the search and spsearch pipelines."""
+    supported for the search and spsearch pipelines. ``tenant``
+    (default / per-entry ``"tenant"``) stamps jobs for the
+    multi-tenant quota + usage accounting (campaign/tenants.py) —
+    quota-validated submissions should instead go through
+    campaign/ingest.submit_observation, which journals the decision."""
     added = 0
     for e in entries:
         inp = e["input"]
@@ -302,6 +307,7 @@ def enqueue_entries(
             bucket=bucket_for_input(inp, ladder),
             priority=int(e.get("priority", priority)),
             nprocs=int(e.get("nprocs", nprocs)),
+            tenant=str(e.get("tenant", tenant) or ""),
         )
         if job.pipeline not in PIPELINES:
             raise ValueError(
@@ -580,6 +586,14 @@ def run_observation(
         "duration_s": round(time.perf_counter() - t0, 3),
         "padded_from": orig_nsamps if fil.nsamps != orig_nsamps else None,
     }
+    if job.tenant:
+        # tenant provenance rides the done record into the usage
+        # ledger (campaign/usage.py), quota windows and metric labels
+        info["tenant"] = job.tenant
+        try:
+            info["bytes_read"] = os.path.getsize(job.input)
+        except OSError:
+            pass
     if quality:
         info["quality"] = quality
     if job.sentinel:
@@ -1058,7 +1072,8 @@ class CampaignRunner:
                         os.path.join(self.root, DB_FILENAME)
                     ) as db:
                         info["ingested"] = db.ingest_job(
-                            job.job_id, job_dir, job.input
+                            job.job_id, job_dir, job.input,
+                            tenant=job.tenant,
                         )
                     # per-job resilience accounting: what THIS job
                     # survived (retries, degradations, injected
@@ -1176,7 +1191,12 @@ class CampaignRunner:
                     state = self.queue.fail(
                         claim, f"{type(exc).__name__}: {exc}"
                     )
-                    self.metrics.counter("jobs_failed_total", state=state)
+                    fail_labels = {"state": state}
+                    if job.tenant:
+                        fail_labels["tenant"] = job.tenant
+                    self.metrics.counter(
+                        "jobs_failed_total", **fail_labels
+                    )
                     log.warning(
                         "job %s failed -> %s: %s", job.job_id, state, exc
                     )
@@ -1357,10 +1377,20 @@ class CampaignRunner:
         if not m.enabled:
             return
         try:
-            m.counter("jobs_done_total", pipeline=info.get("pipeline", ""))
+            # tenant label on the per-job series: Prometheus exposition
+            # and series(labels=...) queries slice usage by tenant
+            tlab = (
+                {"tenant": info["tenant"]} if info.get("tenant") else {}
+            )
+            m.counter(
+                "jobs_done_total", pipeline=info.get("pipeline", ""),
+                **tlab,
+            )
             dur = float(info.get("duration_s") or 0.0)
             if dur:
-                m.observe("job_duration_seconds", dur)
+                m.observe("job_duration_seconds", dur, **tlab)
+            if tlab and dur:
+                m.counter("tenant_device_seconds_total", dur, **tlab)
             for stage, secs in sorted(tel.timers.items()):
                 m.counter("stage_seconds_total", float(secs), stage=stage)
             trials = float(tel.counters.get("search.dm_trials_done", 0))
@@ -1380,6 +1410,7 @@ class CampaignRunner:
             m.counter(
                 "jit_programs_compiled_total",
                 int(info.get("jit_programs_compiled", 0)),
+                **tlab,
             )
             if info.get("gang"):
                 m.counter("gang_jobs_total")
@@ -1406,7 +1437,7 @@ class CampaignRunner:
             counts = self.queue.counts()
             for state in (
                 "pending", "running", "backoff", "stale", "done",
-                "quarantined",
+                "quarantined", "throttled",
             ):
                 self.metrics.gauge(
                     "queue_depth", counts.get(state, 0), state=state

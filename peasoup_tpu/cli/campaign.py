@@ -268,6 +268,15 @@ def build_parser() -> argparse.ArgumentParser:
                     "directories under <workdir>/profiles/ "
                     "(peasoup-campaign profile output; counted in the "
                     "rollup's profiles section)")
+    pr.add_argument("--journals", action="store_true",
+                    help="rotate the append-only journals (alerts, "
+                    "per-tenant alert routes, submissions) down to a "
+                    "size cap, keeping the newest complete lines; "
+                    "restart-safe — alert state lives in the snapshot, "
+                    "not the journal")
+    pr.add_argument("--max-bytes", type=int, default=1 << 20,
+                    help="journal size cap for --journals (rotate when "
+                    "larger, keep roughly half; default 1 MiB)")
     pr.add_argument("--older-than-days", type=float, default=0.0,
                     help="only prune artifacts older than N days "
                     "(default 0 = all)")
@@ -321,6 +330,59 @@ def build_parser() -> argparse.ArgumentParser:
                     "(default 0.05)")
     se.add_argument("--nsamps", type=int, default=1 << 12,
                     help="synthetic observation length (default 4096)")
+
+    te = sub.add_parser(
+        "tenant", help="manage the multi-tenant registry "
+        "(queue/tenants/<name>.json): add mints a bearer token, list "
+        "shows quotas and live throttle state",
+    )
+    te.add_argument("-w", "--workdir", required=True)
+    te.add_argument("action", choices=["add", "list", "show", "remove"])
+    te.add_argument("name", nargs="?", default="",
+                    help="tenant name (add/show/remove)")
+    te.add_argument("--token", default="",
+                    help="bearer token (default: minted)")
+    te.add_argument("--max-queued", type=int, default=0,
+                    help="max non-terminal jobs (0 = unlimited)")
+    te.add_argument("--max-running", type=int, default=0,
+                    help="max concurrent running jobs (0 = unlimited)")
+    te.add_argument("--device-seconds", type=float, default=0.0,
+                    help="device-seconds budget per rolling window "
+                    "(0 = unlimited)")
+    te.add_argument("--window-s", type=float, default=3600.0,
+                    help="rolling budget window (default 3600)")
+    te.add_argument("--priority-max", type=int, default=None,
+                    help="priority ceiling; higher submissions are "
+                    "clamped (default: none)")
+    te.add_argument("--watch-dir", default="",
+                    help="folder polled by `ingest-folder`; dropped "
+                    ".fil/.fbk files are auto-submitted")
+
+    sm = sub.add_parser(
+        "submit", help="submit one observation as a tenant: "
+        "quota-checked admission, journaled append-only to "
+        "queue/submissions.jsonl whether accepted or rejected",
+    )
+    sm.add_argument("-w", "--workdir", required=True)
+    sm.add_argument("tenant", help="tenant name")
+    sm.add_argument("input", help="observation file (.fil/.fbk)")
+    sm.add_argument("--priority", type=int, default=0)
+    sm.add_argument("--pipeline", default="spsearch")
+    sm.add_argument("--config", default=None,
+                    help="per-job config overrides (JSON or @file)")
+
+    inf = sub.add_parser(
+        "ingest-folder", help="poll every tenant's watch folder once "
+        "and submit fresh .fil/.fbk drops through the same "
+        "quota-checked admission as HTTP/CLI submissions",
+    )
+    inf.add_argument("-w", "--workdir", required=True)
+    inf.add_argument("--pipeline", default="spsearch")
+    inf.add_argument("--poll", type=float, default=0.0,
+                     help="keep polling every N seconds (default 0 = "
+                     "one pass)")
+    inf.add_argument("--max-runtime", type=float, default=None,
+                     help="stop polling after N seconds")
     return p
 
 
@@ -675,14 +737,44 @@ def _cmd_prune(args) -> int:
     import shutil
     import time
 
-    if not args.corrupt and not args.profiles:
+    if not args.corrupt and not args.profiles and not args.journals:
         print(
             "prune: nothing selected (pass --corrupt for *.corrupt "
-            "quarantine files and/or --profiles for device-profile "
-            "capture directories)"
+            "quarantine files, --profiles for device-profile capture "
+            "directories, and/or --journals to rotate the append-only "
+            "journals)"
         )
         return 1
     root = os.path.abspath(args.workdir)
+    if args.journals:
+        from ..obs.metrics import rotate_journal
+
+        qdir = os.path.join(root, "queue")
+        paths = [
+            os.path.join(qdir, "alerts.jsonl"),
+            os.path.join(qdir, "submissions.jsonl"),
+        ]
+        paths.extend(sorted(
+            glob.glob(os.path.join(qdir, "alerts.*.jsonl"))
+        ))
+        for path in paths:
+            if not os.path.exists(path):
+                continue
+            before = os.path.getsize(path)
+            if args.dry_run:
+                if before > args.max_bytes:
+                    print(
+                        f"prune: would rotate {path} "
+                        f"({before} > {args.max_bytes} bytes)"
+                    )
+                continue
+            if rotate_journal(path, args.max_bytes):
+                print(
+                    f"prune: rotated {path} "
+                    f"({before} -> {os.path.getsize(path)} bytes)"
+                )
+        if not args.corrupt and not args.profiles:
+            return 0
     now_unix = time.time()
     cutoff = now_unix - args.older_than_days * 86400.0
     selected: list[tuple[str, bool]] = []  # (path, is_dir)
@@ -817,6 +909,117 @@ def _cmd_sentinel(args) -> int:
     return 0
 
 
+def _cmd_tenant(args) -> int:
+    from ..campaign.tenants import Tenant, TenantRegistry, throttle_map
+
+    reg = TenantRegistry(args.workdir)
+    if args.action in ("add", "show", "remove") and not args.name:
+        print(f"tenant {args.action}: a tenant name is required",
+              file=sys.stderr)
+        return 2
+    if args.action == "add":
+        try:
+            t = reg.create(Tenant(
+                name=args.name,
+                token=args.token,
+                max_queued=args.max_queued,
+                max_running=args.max_running,
+                device_seconds=args.device_seconds,
+                window_s=args.window_s,
+                priority_max=args.priority_max,
+                watch_dir=args.watch_dir,
+            ))
+        except FileExistsError:
+            print(f"tenant add: {args.name!r} already exists",
+                  file=sys.stderr)
+            return 1
+        except ValueError as exc:
+            print(f"tenant add: {exc}", file=sys.stderr)
+            return 2
+        print(f"tenant {t.name} created; token: {t.token}")
+        return 0
+    if args.action == "remove":
+        if reg.remove(args.name):
+            print(f"tenant {args.name} removed (historical usage and "
+                  "done records keep their stamp)")
+            return 0
+        print(f"tenant remove: no such tenant {args.name!r}",
+              file=sys.stderr)
+        return 1
+    if args.action == "show":
+        t = reg.get(args.name)
+        if t is None:
+            print(f"tenant show: no such tenant {args.name!r}",
+                  file=sys.stderr)
+            return 1
+        print(json.dumps(t.to_doc(), indent=2))
+        return 0
+    throttles = throttle_map(args.workdir)
+    entries = reg.entries()
+    if not entries:
+        print("no tenants (peasoup-campaign tenant add <name> ...)")
+        return 0
+    for t in entries:
+        quota = ", ".join(
+            f"{k}={v}" for k, v in sorted(t.quota_doc().items())
+            if v not in (0, 0.0, None) or k == "window_s"
+        )
+        line = f"{t.name}  {quota or 'unlimited'}"
+        thr = throttles.get(t.name)
+        if thr:
+            line += f"  *** THROTTLED: {thr['reason']} ***"
+        print(line)
+    return 0
+
+
+def _cmd_submit(args) -> int:
+    from ..campaign.ingest import submit_observation
+    from .peasoup import apply_platform_env
+
+    apply_platform_env()
+    entry = submit_observation(
+        args.workdir,
+        args.tenant,
+        args.input,
+        priority=args.priority,
+        config=_load_config_arg(args.config) or None,
+        pipeline=args.pipeline,
+        via="cli",
+    )
+    if entry["accepted"]:
+        print(f"submitted {entry['job_id']} for tenant {args.tenant}"
+              + ("  (priority clamped to tenant ceiling)"
+                 if entry.get("priority_capped") else ""))
+        return 0
+    print(f"submit rejected: {entry['reason']}", file=sys.stderr)
+    return 1
+
+
+def _cmd_ingest_folder(args) -> int:
+    from ..campaign.ingest import ingest_watch_folders
+    from .peasoup import apply_platform_env
+
+    apply_platform_env()
+    t0 = time.perf_counter()
+    while True:
+        entries = ingest_watch_folders(
+            args.workdir, pipeline=args.pipeline
+        )
+        for e in entries:
+            state = "accepted" if e["accepted"] else (
+                f"rejected ({e['reason']})"
+            )
+            print(f"ingest-folder: {e['tenant']}: {e['input']} {state}")
+        if not args.poll:
+            return 0
+        if (
+            args.max_runtime is not None
+            and time.perf_counter() - t0 >= args.max_runtime
+        ):
+            return 0
+        time.sleep(args.poll)
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     return {
@@ -834,6 +1037,9 @@ def main(argv: list[str] | None = None) -> int:
         "serve": _cmd_serve,
         "alerts": _cmd_alerts,
         "sentinel": _cmd_sentinel,
+        "tenant": _cmd_tenant,
+        "submit": _cmd_submit,
+        "ingest-folder": _cmd_ingest_folder,
     }[args.cmd](args)
 
 

@@ -65,6 +65,10 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--no-fold", action="store_true",
                      help="skip the batched survey folding pass "
                      "(cross-match/dedup then use the search periods)")
+    run.add_argument("--incremental", action="store_true",
+                     help="no-op (exit 0) unless new observations "
+                          "landed in the campaign DB since the last "
+                          "sift run's watermark")
     run.add_argument("--fold-batch", type=int, default=None,
                      help="candidates per fixed fold batch "
                      "(default 64)")
@@ -125,6 +129,37 @@ def _cmd_run(args) -> int:
     if args.fold_batch:
         overrides["fold_batch"] = args.fold_batch
     cfg = SiftConfig(**overrides)
+
+    if args.incremental:
+        # Before any side effect (makedirs, telemetry): if no new
+        # observations landed since the last run's watermark, exit 0
+        # without touching anything.
+        import json as _json
+
+        from ..campaign.db import CandidateDB
+
+        db_path = cfg.resolved_db()
+        if os.path.exists(db_path):
+            with CandidateDB(db_path) as db:
+                latest = db.latest_sift_run()
+                prev_wm = None
+                if latest:
+                    try:
+                        prev_wm = _json.loads(
+                            latest.get("config") or "{}"
+                        ).get("watermark_rowid")
+                    except ValueError:
+                        prev_wm = None
+                if (
+                    prev_wm is not None
+                    and db.max_observation_rowid() <= int(prev_wm)
+                ):
+                    print(
+                        "peasoup-sift run: no new observations since "
+                        f"run {latest['run_id']} (watermark rowid "
+                        f"{int(prev_wm)}); nothing to do"
+                    )
+                    return 0
 
     sift_dir = os.path.join(args.workdir, "sift")
     os.makedirs(sift_dir, exist_ok=True)

@@ -156,7 +156,44 @@ def default_rules(heartbeat_s: float = 2.0) -> list[dict]:
          "severity": "warn"},
         {"name": "sentinel_unrecovered", "kind": "sentinel",
          "severity": "page"},
+        {
+            # a tenant parked at its quota ceiling (max_running or
+            # device-seconds window) — findings computed by
+            # campaign/tenants.throttle_map, routed to the tenant's
+            # own journal so THEIR operator sees it without grepping
+            # the fleet's
+            "name": "tenant_quota_exhausted",
+            "kind": "tenant_quota",
+            "severity": "warn",
+            "route": "tenant",
+        },
+        {
+            # the fleet-wide job_failure_burn_rate above says "the
+            # survey is failing"; this one says WHOSE jobs are — the
+            # same SLO evaluated per tenant label value
+            "name": "tenant_job_failure_burn_rate",
+            "kind": "burn_rate",
+            "bad": "jobs_failed_total",
+            "good": "jobs_done_total",
+            "objective": 0.9,
+            "windows": [[300.0, 6.0], [1800.0, 3.0]],
+            "by": "tenant",
+            "severity": "page",
+            "route": "tenant",
+        },
     ]
+
+
+def tenant_journal_path(root: str, tenant: str) -> str:
+    """The per-tenant alert journal a ``route: "tenant"`` rule's
+    transitions are copied to (tenant value sanitised: it becomes a
+    file name)."""
+    safe = "".join(
+        c if c.isalnum() or c in "-_" else "_" for c in str(tenant)
+    )[:48] or "_"
+    return os.path.join(
+        os.path.abspath(root), "queue", f"alerts.{safe}.jsonl"
+    )
 
 
 # --------------------------------------------------------------------------
@@ -317,7 +354,50 @@ def _eval_absence(
     return out
 
 
+def _counter_label_values(
+    samples: dict, names: set, label: str
+) -> list[str]:
+    """Every value the ``label`` takes across the named counters."""
+    vals: set[str] = set()
+    for ss in samples.values():
+        for rec in ss:
+            if rec.get("name") in names and rec.get("kind") == "counter":
+                v = (rec.get("labels") or {}).get(label)
+                if v:
+                    vals.add(str(v))
+    return sorted(vals)
+
+
+def _filter_by_label(samples: dict, label: str, value: str) -> dict:
+    return {
+        src: [
+            r for r in ss
+            if (r.get("labels") or {}).get(label) == value
+        ]
+        for src, ss in samples.items()
+    }
+
+
 def _eval_burn_rate(rule: dict, samples: dict, now: float) -> list:
+    by = rule.get("by")
+    if by:
+        # per-label-value grouping: the same SLO evaluated over each
+        # slice of the counters (e.g. ``by: "tenant"`` — one alert per
+        # burning tenant, labelled so routing can fan it out)
+        names = {
+            n for n in (
+                rule.get("bad"), rule.get("good"), rule.get("total")
+            ) if n
+        }
+        inner = {k: v for k, v in rule.items() if k != "by"}
+        out = []
+        for val in _counter_label_values(samples, names, by):
+            sub = _filter_by_label(samples, by, val)
+            for labels, value, msg in _eval_burn_rate(inner, sub, now):
+                out.append((
+                    {**labels, by: val}, value, f"{msg} [{by}={val}]",
+                ))
+        return out
     budget = 1.0 - float(rule["objective"])
     first_ratio = None
     for window_s, factor in rule.get("windows", [[300.0, 6.0]]):
@@ -446,6 +526,42 @@ class AlertEngine:
         )
         with open(self.log_path, "a") as f:
             f.write(lines)
+        self._route_transitions(transitions)
+
+    def _route_transitions(self, transitions: list[dict]) -> None:
+        """Fan transitions of ``route:``-scoped rules out to per-value
+        journals: a rule with ``route: "tenant"`` copies each of its
+        transitions to ``queue/alerts.<labels[tenant]>.jsonl`` — the
+        tenant's own audit trail, beside (never instead of) the
+        fleet-wide journal."""
+        routes = {
+            r["name"]: r["route"]
+            for r in self.rules if r.get("route")
+        }
+        if not routes:
+            return
+        by_journal: dict[str, list[str]] = {}
+        for t in transitions:
+            label = routes.get(t.get("rule"))
+            if not label:
+                continue
+            val = (t.get("labels") or {}).get(label)
+            if not val:
+                continue
+            by_journal.setdefault(str(val), []).append(
+                json.dumps(t, separators=(",", ":")) + "\n"
+            )
+        for val, lines in by_journal.items():
+            try:
+                with open(
+                    tenant_journal_path(self.root, val), "a"
+                ) as f:
+                    f.write("".join(lines))
+            except OSError:
+                log.debug(
+                    "per-tenant alert journal append failed (%s)",
+                    val, exc_info=True,
+                )
 
     def _write_snapshot(self, doc: dict) -> None:
         d = os.path.dirname(self.snapshot_path)
@@ -469,6 +585,7 @@ class AlertEngine:
         dq_findings: list[dict] | None = None,
         sentinel_findings: list[dict] | None = None,
         live_sources: list[str] | None = None,
+        tenant_findings: list[dict] | None = None,
     ) -> dict:
         """Run one evaluation round and return the new snapshot (or
         the current one when another evaluator holds the lock)."""
@@ -480,14 +597,14 @@ class AlertEngine:
         try:
             return self._evaluate_locked(
                 samples, now, dq_findings, sentinel_findings,
-                live_sources,
+                live_sources, tenant_findings,
             )
         finally:
             self._release_lock()
 
     def _evaluate_locked(
         self, samples, now, dq_findings, sentinel_findings,
-        live_sources,
+        live_sources, tenant_findings=None,
     ) -> dict:
         prev_doc = self.load_snapshot()
         prev = {
@@ -510,6 +627,8 @@ class AlertEngine:
                     found = _eval_findings(dq_findings)
                 elif kind == "sentinel":
                     found = _eval_findings(sentinel_findings)
+                elif kind == "tenant_quota":
+                    found = _eval_findings(tenant_findings)
                 else:
                     log.warning("unknown alert rule kind: %r", kind)
                     continue
@@ -713,12 +832,31 @@ def evaluate_campaign(
             e.get("worker_id", "")
             for e in registry.live()
         )
+        tenant_findings: list[dict] = []
+        try:
+            from ..campaign.tenants import throttle_map
+
+            tenant_findings = [
+                {
+                    "labels": {"tenant": name},
+                    "value": 1.0,
+                    "message": str(f.get("reason", "over quota")),
+                }
+                for name, f in sorted(
+                    throttle_map(root, now=now).items()
+                )
+            ]
+        except Exception:
+            log.warning(
+                "tenant quota findings failed", exc_info=True
+            )
         return engine.evaluate(
             samples=samples,
             now=now,
             dq_findings=quality_findings(queue.done_records()),
             sentinel_findings=sentinel_findings(root, queue),
             live_sources=[w for w in live if w],
+            tenant_findings=tenant_findings,
         )
     except Exception:
         log.warning("alert evaluation failed", exc_info=True)

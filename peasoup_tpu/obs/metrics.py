@@ -196,6 +196,53 @@ class MetricsRecorder:
         )
 
 
+def rotate_journal(
+    path: str, max_bytes: int, keep_bytes: int | None = None
+) -> bool:
+    """The recorder's tail-keeping rotation as a standalone operation
+    for any append-only jsonl journal (``queue/alerts.jsonl``,
+    ``queue/submissions.jsonl``, the per-tenant alert journals —
+    ``peasoup-campaign prune --journals``): when ``path`` exceeds
+    ``max_bytes``, atomically rewrite it keeping the newest whole
+    lines that fit ``keep_bytes`` (default half of ``max_bytes``).
+    Returns True when a rotation happened. Alert-engine state restores
+    from the SNAPSHOT (``queue/alerts.json``), never the journal, so
+    truncating journal history can never re-fire an alert — the
+    restart-no-refire regression test pins that."""
+    keep = int(keep_bytes or max(4096, int(max_bytes) // 2))
+    try:
+        if os.path.getsize(path) <= int(max_bytes):
+            return False
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError:
+        return False
+    kept: list[str] = []
+    total = 0
+    for ln in reversed(lines):
+        total += len(ln)
+        if total > keep:
+            break
+        kept.append(ln)
+    kept.reverse()
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            f.writelines(kept)
+        os.replace(tmp, path)
+    except OSError:
+        log.debug("journal rotation failed: %s", path, exc_info=True)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+    log.info(
+        "rotated %s: kept %d of %d lines", path, len(kept), len(lines)
+    )
+    return True
+
+
 # --------------------------------------------------------------------------
 # reading + fleet aggregation
 # --------------------------------------------------------------------------
@@ -258,10 +305,13 @@ def series(
     samples_by_source: dict[str, list[dict]],
     name: str,
     kind: str | None = None,
+    labels: dict | None = None,
 ) -> list[dict]:
     """All samples of one metric across the fleet, time-ordered, each
     tagged with its source — the "queue depth over the last hour"
-    query shape."""
+    query shape. ``labels`` filters to samples whose label set
+    CONTAINS every given pair (``labels={"tenant": "alice"}`` slices
+    one tenant's series out of the fleet's)."""
     out = []
     for src, samples in samples_by_source.items():
         for rec in samples:
@@ -269,6 +319,12 @@ def series(
                 continue
             if kind is not None and rec.get("kind") != kind:
                 continue
+            if labels:
+                have = rec.get("labels") or {}
+                if any(
+                    have.get(k) != str(v) for k, v in labels.items()
+                ):
+                    continue
             out.append({**rec, "source": src})
     out.sort(key=lambda r: r.get("t", 0.0))
     return out

@@ -19,7 +19,19 @@ Endpoints:
   record and trace summary.
 - ``/report`` and ``/bowtie.svg`` — the sift HTML report and bowtie
   plot when the campaign has been sifted.
+- ``/tenants`` and ``/tenants/<name>`` — the multi-tenant view: per
+  tenant queue tallies, quota vs windowed device-seconds, usage
+  ledger, firing alerts, per-tenant sift/bowtie links.
+- ``/usage`` — the usage ledger JSON (``queue/usage.json`` content,
+  rebuilt in-memory when absent).
 - ``/`` — a small HTML index linking the above.
+
+One WRITE endpoint: ``POST /submit`` — the tenant submission front
+end. Authenticated by bearer token (``Authorization: Bearer <token>``
+or ``X-Peasoup-Token``) against the tenant registry; the JSON body
+``{"input": ..., "priority"?, "config"?, "pipeline"?}`` is admitted
+through campaign/ingest.submit_observation (quota-checked, journaled
+append-only to ``queue/submissions.jsonl``).
 """
 
 from __future__ import annotations
@@ -103,6 +115,137 @@ def _file_body(path: str) -> bytes | None:
         return None
 
 
+def _tenant_sections(root: str) -> tuple[dict, dict]:
+    """(tenants, usage) rollup sections — from the workers' snapshot
+    when it carries them, rebuilt in-memory otherwise (pre-tenant
+    snapshots lack the keys)."""
+    st = _read_json(os.path.join(root, "campaign_status.json"))
+    if not st or "tenants" not in st:
+        from ..campaign.rollup import build_status
+
+        st = build_status(root)
+    return (st.get("tenants") or {}), (st.get("usage") or {})
+
+
+def _tenant_alerts(root: str, name: str | None = None) -> list[dict]:
+    """Active alerts labelled with a tenant (optionally one tenant)."""
+    from .alerts import load_alerts
+
+    out = []
+    for a in load_alerts(root).get("alerts", []):
+        if a.get("state") not in ("pending", "firing"):
+            continue
+        t = (a.get("labels") or {}).get("tenant")
+        if not t or (name is not None and t != name):
+            continue
+        out.append(a)
+    return out
+
+
+def _usage_body(root: str) -> bytes:
+    from ..campaign.usage import build_usage, load_usage
+
+    doc = load_usage(root) or build_usage(root)
+    return (json.dumps(doc, indent=2) + "\n").encode()
+
+
+def _tenants_body(root: str) -> bytes:
+    tenants, usage = _tenant_sections(root)
+    firing: dict[str, int] = {}
+    for a in _tenant_alerts(root):
+        t = (a.get("labels") or {}).get("tenant", "")
+        firing[t] = firing.get(t, 0) + 1
+    rows = []
+    for name in sorted(tenants):
+        rec = tenants[name] or {}
+        u = usage.get(name) or {}
+        budget = rec.get("device_s_budget")
+        wdev = rec.get("window_device_s")
+        budget_cell = (
+            f"{wdev:.1f} / {budget:.0f}s"
+            if budget and wdev is not None
+            else (f"{wdev:.1f}s" if wdev is not None else "-")
+        )
+        safe = html.escape(str(name))
+        rows.append(
+            f'<tr><td><a href="/tenants/{safe}">{safe}</a></td>'
+            f"<td>{rec.get('queued', 0)}</td>"
+            f"<td>{rec.get('running', 0)}</td>"
+            f"<td>{rec.get('throttled', 0)}</td>"
+            f"<td>{rec.get('done', 0)}</td>"
+            f"<td>{html.escape(budget_cell)}</td>"
+            f"<td>{u.get('jit_programs_compiled', 0)}</td>"
+            f"<td>{firing.get(name, 0)}</td>"
+            f"<td>{html.escape(str(rec.get('throttle') or '-'))}</td>"
+            "</tr>"
+        )
+    doc = (
+        "<!DOCTYPE html><html><head><title>tenants</title></head>"
+        "<body><h1>tenants</h1>"
+        "<table border=1><tr><th>tenant</th><th>queued</th>"
+        "<th>running</th><th>throttled</th><th>done</th>"
+        "<th>device-s (window/budget)</th><th>compiles</th>"
+        "<th>alerts</th><th>throttle</th></tr>"
+        + "".join(rows)
+        + '</table><p><a href="/usage">usage ledger (JSON)</a> · '
+        '<a href="/">index</a></p></body></html>'
+    )
+    return doc.encode()
+
+
+def _tenant_page_body(root: str, name: str) -> bytes | None:
+    if not name or any(c not in _JOB_ID_OK for c in name):
+        return None
+    tenants, usage = _tenant_sections(root)
+    if name not in tenants and name not in usage:
+        return None
+    rec = tenants.get(name) or {}
+    u = usage.get(name) or {}
+    safe = html.escape(name)
+
+    def _table(d: dict) -> str:
+        return "<table border=1>" + "".join(
+            f"<tr><td>{html.escape(str(k))}</td>"
+            f"<td>{html.escape(json.dumps(v))}</td></tr>"
+            for k, v in sorted(d.items())
+        ) + "</table>"
+
+    alerts = _tenant_alerts(root, name)
+    alert_lines = "".join(
+        f"<li>{html.escape(a.get('rule', ''))} "
+        f"[{html.escape(a.get('state', ''))}] "
+        f"{html.escape(a.get('message', ''))}</li>"
+        for a in alerts
+    ) or "<li>none</li>"
+    from ..campaign.ingest import read_submissions
+
+    subs = [
+        s for s in read_submissions(root) if s.get("tenant") == name
+    ][-20:]
+    sub_lines = "".join(
+        f"<li>{html.escape(str(s.get('input', '')))} via "
+        f"{html.escape(str(s.get('via', '')))}: "
+        f"{'accepted' if s.get('accepted') else 'rejected'}"
+        f"{' (' + html.escape(str(s['reason'])) + ')' if s.get('reason') else ''}"
+        "</li>"
+        for s in subs
+    ) or "<li>none</li>"
+    doc = (
+        f"<!DOCTYPE html><html><head><title>tenant {safe}</title>"
+        f"</head><body><h1>tenant {safe}</h1>"
+        f"<h2>queue</h2>{_table({k: v for k, v in rec.items() if k != 'quota'})}"
+        f"<h2>quota</h2>{_table(rec.get('quota') or {})}"
+        f"<h2>usage</h2>{_table(u)}"
+        f"<h2>alerts</h2><ul>{alert_lines}</ul>"
+        f"<h2>recent submissions</h2><ul>{sub_lines}</ul>"
+        '<p><a href="/report">sift report</a> · '
+        '<a href="/bowtie.svg">bowtie</a> · '
+        '<a href="/tenants">all tenants</a></p>'
+        "</body></html>"
+    )
+    return doc.encode()
+
+
 def _index_body(root: str) -> bytes:
     from .alerts import load_alerts
 
@@ -131,6 +274,8 @@ def _index_body(root: str) -> bytes:
         '<ul><li><a href="/metrics">/metrics</a></li>'
         '<li><a href="/status">/status</a></li>'
         '<li><a href="/alerts">/alerts</a></li>'
+        '<li><a href="/tenants">/tenants</a></li>'
+        '<li><a href="/usage">/usage</a></li>'
         '<li><a href="/report">sift report</a></li>'
         '<li><a href="/bowtie.svg">bowtie</a></li></ul>'
         "</body></html>"
@@ -176,6 +321,15 @@ def serve_portal(
                 return _status_body(root), "application/json"
             if path == "/alerts":
                 return _alerts_body(root), "application/json"
+            if path == "/usage":
+                return _usage_body(root), "application/json"
+            if path == "/tenants":
+                return _tenants_body(root), "text/html; charset=utf-8"
+            if path.startswith("/tenants/"):
+                return (
+                    _tenant_page_body(root, path[len("/tenants/"):]),
+                    "text/html; charset=utf-8",
+                )
             if path.startswith("/jobs/"):
                 return (
                     _job_body(root, path[len("/jobs/"):]),
@@ -196,6 +350,85 @@ def serve_portal(
                     "image/svg+xml",
                 )
             return None, ""
+
+        def do_POST(self) -> None:  # noqa: N802 (http.server contract)
+            try:
+                self._post()
+            except Exception as exc:
+                self.send_error(500, f"{type(exc).__name__}: {exc}")
+
+        def _post(self) -> None:
+            path = self.path.split("?", 1)[0].rstrip("/")
+            if path != "/submit":
+                self.send_error(404)
+                return
+            from ..campaign.ingest import submit_observation
+            from ..campaign.tenants import TenantRegistry
+
+            token = ""
+            auth = self.headers.get("Authorization") or ""
+            if auth.lower().startswith("bearer "):
+                token = auth[len("bearer "):].strip()
+            if not token:
+                token = (self.headers.get("X-Peasoup-Token") or "").strip()
+            tenant = TenantRegistry(root).by_token(token)
+            if tenant is None:
+                self._json(401, {"error": "missing or invalid token"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+            except ValueError:
+                length = 0
+            if length <= 0 or length > 1 << 20:
+                self._json(400, {"error": "bad Content-Length"})
+                return
+            try:
+                doc = json.loads(self.rfile.read(length))
+            except (ValueError, OSError):
+                self._json(400, {"error": "malformed JSON body"})
+                return
+            if not isinstance(doc, dict) or not isinstance(
+                doc.get("input"), str
+            ):
+                self._json(400, {"error": 'body needs a string "input"'})
+                return
+            try:
+                priority = int(doc.get("priority", 0))
+            except (TypeError, ValueError):
+                self._json(400, {"error": "priority must be an integer"})
+                return
+            config = doc.get("config")
+            if config is not None and not isinstance(config, dict):
+                self._json(400, {"error": "config must be an object"})
+                return
+            entry = submit_observation(
+                root,
+                tenant.name,
+                doc["input"],
+                priority=priority,
+                config=config,
+                pipeline=str(doc.get("pipeline") or "spsearch"),
+                via="http",
+            )
+            if entry.get("accepted"):
+                code = 200
+            else:
+                reason = str(entry.get("reason") or "")
+                if reason.startswith("duplicate"):
+                    code = 409
+                elif reason.startswith("max_queued"):
+                    code = 429
+                else:
+                    code = 400
+            self._json(code, entry)
+
+        def _json(self, code: int, doc: dict) -> None:
+            body = (json.dumps(doc) + "\n").encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
 
         def log_message(self, fmt, *args) -> None:
             log.debug("portal http: " + fmt, *args)

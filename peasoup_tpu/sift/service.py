@@ -201,6 +201,7 @@ class SiftRun:
             tel.set_stage("loading")
             self._mark("loading")
             obs_rows = db.observations()
+            watermark_rowid = db.max_observation_rowid()
             periodicity = db.all_candidates("periodicity")
             single_pulse = db.all_candidates("single_pulse")
             self._mark(
@@ -403,6 +404,10 @@ class SiftRun:
             self._mark("ingest")
             config_doc = dataclasses.asdict(cfg)
             config_doc["n_folded"] = n_folded
+            # Incremental-sift watermark: the highest observation rowid
+            # this run saw.  `peasoup-sift run --incremental` no-ops
+            # while the campaign DB is still at or below it.
+            config_doc["watermark_rowid"] = watermark_rowid
             tally = db.ingest_sift_run(
                 run_id, config_doc, catalogue_rows, known_matches,
                 sp_sources,
@@ -414,6 +419,7 @@ class SiftRun:
                 "observations": len(obs_rows),
                 "periodicity": len(periodicity),
                 "single_pulse": len(single_pulse),
+                "watermark_rowid": watermark_rowid,
                 "duration_s": round(time.perf_counter() - t_run, 3),
                 **tally,
             }

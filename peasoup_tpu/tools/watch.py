@@ -282,6 +282,48 @@ def render_data_quality(sec: dict) -> list[str]:
     return lines
 
 
+def render_tenants(
+    sec: dict, usage: dict | None = None, alerts: dict | None = None
+) -> list[str]:
+    """Lines for a campaign rollup's ``tenants`` section: one row per
+    tenant (queued/running/throttled, device-seconds vs budget, firing
+    alerts), throttled tenants loud.  Tolerant of pre-tenant rollup
+    schemas — every field is optional."""
+    if not sec:
+        return []
+    usage = usage or {}
+    firing: dict[str, int] = {}
+    for a in (alerts or {}).get("active") or []:
+        t = (a.get("labels") or {}).get("tenant")
+        if t and a.get("state") == "firing":
+            firing[t] = firing.get(t, 0) + 1
+    lines = [f"  tenants: {len(sec)}"]
+    for name in sorted(sec):
+        rec = sec[name] if isinstance(sec[name], dict) else {}
+        bits = [
+            f"    {name}  q={rec.get('queued', 0)}"
+            f" run={rec.get('running', 0)}"
+            f" thr={rec.get('throttled', 0)}"
+            f" done={rec.get('done', 0)}"
+        ]
+        wdev = rec.get("window_device_s")
+        budget = rec.get("device_s_budget")
+        if wdev is not None:
+            bits.append(
+                f"dev-s {wdev:.1f}/{budget:.0f}"
+                if budget else f"dev-s {wdev:.1f}"
+            )
+        u = usage.get(name) or {}
+        if u.get("jobs_failed"):
+            bits.append(f"failed={u['jobs_failed']}")
+        if firing.get(name):
+            bits.append(f"{firing[name]} alert(s) firing")
+        if rec.get("throttle"):
+            bits.append(f"*** THROTTLED: {rec['throttle']} ***")
+        lines.append("  ".join(bits))
+    return lines
+
+
 def render_campaign_status(st: dict, stale_after: float = 0.0) -> str:
     """One compact text block for a campaign_status.json rollup."""
     q = st.get("queue") or {}
@@ -295,6 +337,8 @@ def render_campaign_status(st: dict, stale_after: float = 0.0) -> str:
         f"+{q.get('backoff', 0)} backing off  "
         f"stale={q.get('stale', 0)}  quarantined={q.get('quarantined', 0)}"
     )
+    if q.get("throttled"):
+        head += f"  throttled={q['throttled']}"
     lines = [head]
     thr = st.get("throughput_jobs_per_s")
     if thr:
@@ -380,6 +424,14 @@ def render_campaign_status(st: dict, stale_after: float = 0.0) -> str:
                 + f" block={plan.get('dedisp_block', '?')} "
                 f"[{plan.get('source', '?')}]"
             )
+    if isinstance(st.get("tenants"), dict) and st["tenants"]:
+        lines.extend(render_tenants(
+            st["tenants"],
+            usage=st.get("usage") if isinstance(st.get("usage"), dict)
+            else None,
+            alerts=st.get("alerts") if isinstance(st.get("alerts"), dict)
+            else None,
+        ))
     if isinstance(st.get("alerts"), dict):
         lines.extend(render_alerts(st["alerts"]))
     if isinstance(st.get("data_quality"), dict):

@@ -410,7 +410,7 @@ class TestCampaignEndToEnd:
         counts = queue.counts()
         assert counts == {
             "total": 4, "pending": 0, "backoff": 0, "running": 0,
-            "stale": 0, "done": 3, "quarantined": 1,
+            "stale": 0, "done": 3, "quarantined": 1, "throttled": 0,
         }
         done = queue.done_records()
         assert sorted(d["job_id"] for d in done) == sorted(
