@@ -130,6 +130,15 @@ def submit_observation(
         entry["priority"] = int(tenant.priority_max)
         entry["priority_capped"] = True
     if tenant.max_queued > 0:
+        # Check-then-act across processes (CLI, watch ingester and
+        # portal each run their own submit_observation): concurrent
+        # submissions for one tenant can land between this count and
+        # add_job below, over-admitting by at most the number of
+        # simultaneous racers. Matching the running_counts contract,
+        # that transient is accepted rather than locked away — the
+        # very next submission counts every admitted job and the
+        # ceiling re-asserts; retracting an already-visible job here
+        # would race the workers' claim path instead.
         queued = queued_counts(root).get(tenant_name, 0)
         if queued >= tenant.max_queued:
             return _reject(

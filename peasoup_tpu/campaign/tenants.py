@@ -73,13 +73,15 @@ def _read_json(path: str) -> dict | None:
 
 def valid_tenant_name(name: str) -> bool:
     """Tenant names become file names and journal suffixes
-    (``queue/alerts.<tenant>.jsonl``), so the charset is the same one
-    the worker registry allows for ids: alnum plus ``-_.``, non-empty,
-    bounded."""
+    (``queue/alerts.<tenant>.jsonl``), so the charset is alnum plus
+    ``-`` and ``_`` only, non-empty, bounded. Dots are deliberately
+    excluded (unlike worker ids): a name must parse back unambiguously
+    out of the dotted journal filename, and can never be a hidden
+    file or a path dodge. The portal's ``/tenants/<name>`` route uses
+    this same predicate — one validator for every door."""
     return (
         0 < len(name) <= 48
         and all(c.isalnum() or c in "-_" for c in name)
-        and not name.startswith(".")
     )
 
 

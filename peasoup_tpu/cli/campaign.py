@@ -32,6 +32,7 @@ import glob
 import json
 import os
 import sys
+import time
 
 from . import add_version_arg
 
@@ -295,6 +296,11 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--max-requests", type=int, default=None,
                     help="serve N requests then exit (for tests/gates; "
                     "default: serve forever)")
+    sv.add_argument("--data-root", action="append", default=[],
+                    dest="data_roots", metavar="DIR",
+                    help="allow POST /submit inputs under DIR "
+                    "(repeatable); a tenant's own watch_dir is always "
+                    "allowed, anything else is rejected 403")
 
     al = sub.add_parser(
         "alerts", help="print the campaign's alerts snapshot "
@@ -735,7 +741,6 @@ def _cmd_profile(args) -> int:
 
 def _cmd_prune(args) -> int:
     import shutil
-    import time
 
     if not args.corrupt and not args.profiles and not args.journals:
         print(
@@ -836,6 +841,7 @@ def _cmd_serve(args) -> int:
             port=args.port,
             host=args.host,
             max_requests=args.max_requests,
+            data_roots=args.data_roots,
         )
     except KeyboardInterrupt:
         pass

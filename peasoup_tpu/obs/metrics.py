@@ -220,7 +220,9 @@ def rotate_journal(
     kept: list[str] = []
     total = 0
     for ln in reversed(lines):
-        total += len(ln)
+        # budgets are bytes on disk, so measure encoded length —
+        # len(ln) undercounts multibyte UTF-8 journal content
+        total += len(ln.encode("utf-8"))
         if total > keep:
             break
         kept.append(ln)

@@ -31,7 +31,12 @@ end. Authenticated by bearer token (``Authorization: Bearer <token>``
 or ``X-Peasoup-Token``) against the tenant registry; the JSON body
 ``{"input": ..., "priority"?, "config"?, "pipeline"?}`` is admitted
 through campaign/ingest.submit_observation (quota-checked, journaled
-append-only to ``queue/submissions.jsonl``).
+append-only to ``queue/submissions.jsonl``). The ``input`` path is
+CONFINED: it must resolve (realpath, so symlinks cannot escape) under
+the tenant's own ``watch_dir`` or an operator-configured ``--data-root``
+— otherwise 403. A token only authenticates a tenant; it must not let
+them enqueue arbitrary server-readable files (another tenant's drops,
+host configuration) for the pipeline to open.
 """
 
 from __future__ import annotations
@@ -115,6 +120,21 @@ def _file_body(path: str) -> bytes | None:
         return None
 
 
+def _input_allowed(input_path: str, roots: list[str]) -> bool:
+    """Realpath-prefix confinement for HTTP-submitted inputs: the
+    fully-resolved path must sit under one of ``roots`` (each itself
+    resolved), so neither ``..`` segments nor symlinks reach outside.
+    Empty ``roots`` allows nothing — the HTTP door is deny-by-default."""
+    rp = os.path.realpath(input_path)
+    for root in roots:
+        if not root:
+            continue
+        rr = os.path.realpath(root)
+        if rp == rr or rp.startswith(rr + os.sep):
+            return True
+    return False
+
+
 def _tenant_sections(root: str) -> tuple[dict, dict]:
     """(tenants, usage) rollup sections — from the workers' snapshot
     when it carries them, rebuilt in-memory otherwise (pre-tenant
@@ -194,7 +214,9 @@ def _tenants_body(root: str) -> bytes:
 
 
 def _tenant_page_body(root: str, name: str) -> bytes | None:
-    if not name or any(c not in _JOB_ID_OK for c in name):
+    from ..campaign.tenants import valid_tenant_name
+
+    if not valid_tenant_name(name):
         return None
     tenants, usage = _tenant_sections(root)
     if name not in tenants and name not in usage:
@@ -288,12 +310,17 @@ def serve_portal(
     port: int = 9100,
     host: str = "127.0.0.1",
     max_requests: int | None = None,
+    data_roots: list[str] | None = None,
 ) -> None:
     """Serve the campaign portal. Blocks; ``max_requests`` bounds it
-    for tests and the check gate."""
+    for tests and the check gate. ``data_roots`` are the operator's
+    shared staging directories HTTP-submitted inputs may come from (a
+    tenant's own ``watch_dir`` is always allowed); with none configured
+    and no watch_dir, POST /submit rejects every path with 403."""
     from http.server import BaseHTTPRequestHandler, HTTPServer
 
     root = os.path.abspath(root)
+    data_roots = [d for d in (data_roots or []) if d]
 
     class _Handler(BaseHTTPRequestHandler):
         def do_GET(self) -> None:  # noqa: N802 (http.server contract)
@@ -400,6 +427,33 @@ def serve_portal(
             config = doc.get("config")
             if config is not None and not isinstance(config, dict):
                 self._json(400, {"error": "config must be an object"})
+                return
+            allowed = list(data_roots)
+            if tenant.watch_dir:
+                allowed.append(tenant.watch_dir)
+            if not _input_allowed(doc["input"], allowed):
+                import time
+
+                from ..campaign.ingest import append_submission
+
+                now_unix = time.time()
+                entry = {
+                    "t_unix": round(now_unix, 3),
+                    "via": "http",
+                    "tenant": tenant.name,
+                    "input": doc["input"],
+                    "pipeline": str(doc.get("pipeline") or "spsearch"),
+                    "priority": priority,
+                    "priority_capped": False,
+                    "accepted": False,
+                    "reason": (
+                        "input outside the tenant watch_dir and the "
+                        "portal --data-root allowlist"
+                    ),
+                    "job_id": None,
+                }
+                append_submission(root, entry)
+                self._json(403, entry)
                 return
             entry = submit_observation(
                 root,

@@ -105,7 +105,8 @@ class TestTenantRegistry:
 
     def test_names_are_filesystem_safe(self, tmp_path):
         assert valid_tenant_name("survey-A_2")
-        for bad in ("", "a/b", "..", ".hidden", "x" * 49, "a b"):
+        for bad in ("", "a/b", "..", ".hidden", "a.b",
+                    "x" * 49, "a b"):
             assert not valid_tenant_name(bad)
         with pytest.raises(ValueError):
             TenantRegistry(str(tmp_path)).create(Tenant(name="a/b"))
@@ -177,6 +178,26 @@ class TestAdmission:
         n = len(read_submissions(root))
         assert ingest_watch_folders(root) == []
         assert len(read_submissions(root)) == n
+
+    def test_cli_ingest_folder_door(self, tmp_path, capsys):
+        # drive the actual CLI entry point (one-shot and bounded-poll
+        # modes), not just the library function behind it
+        from peasoup_tpu.cli.campaign import main
+
+        root = str(tmp_path / "camp")
+        wdir = tmp_path / "drop"
+        wdir.mkdir()
+        TenantRegistry(root).create(
+            Tenant(name="alice", watch_dir=str(wdir))
+        )
+        obs = _obs_file(wdir, "fresh.fil")
+        assert main(["ingest-folder", "-w", root]) == 0
+        assert "accepted" in capsys.readouterr().out
+        assert JobQueue(root).get_job(job_id_for(obs)) is not None
+        assert main([
+            "ingest-folder", "-w", root,
+            "--poll", "0.05", "--max-runtime", "0.15",
+        ]) == 0
 
 
 # --------------------------------------------------------------------------
@@ -489,7 +510,7 @@ class TestJournalRotation:
 # --------------------------------------------------------------------------
 
 class TestSubmissionPortal:
-    N_REQUESTS = 10
+    N_REQUESTS = 12
 
     @pytest.fixture()
     def portal(self, tmp_path):
@@ -501,14 +522,21 @@ class TestSubmissionPortal:
         reg = TenantRegistry(root)
         alice = reg.create(Tenant(name="alice", priority_max=1))
         _done_record(root, "d0", "alice", time.time(), 2.0)
-        obs = _obs_file(tmp_path)
+        # the obs sits inside the portal's --data-root; anything
+        # outside it (tmp_path itself) must bounce off confinement
+        (tmp_path / "stage").mkdir()
+        obs = _obs_file(tmp_path / "stage")
         s = socket.socket()
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
         s.close()
         srv = threading.Thread(
             target=serve_portal, args=(root,),
-            kwargs={"port": port, "max_requests": self.N_REQUESTS},
+            kwargs={
+                "port": port,
+                "max_requests": self.N_REQUESTS,
+                "data_roots": [str(tmp_path / "stage")],
+            },
             daemon=True,
         )
         srv.start()
@@ -544,7 +572,7 @@ class TestSubmissionPortal:
         except urllib.error.HTTPError as exc:
             return exc.code, json.loads(exc.read() or b"{}")
 
-    def test_submit_and_tenant_pages(self, portal):
+    def test_submit_and_tenant_pages(self, portal, tmp_path):
         base, root, alice, obs = portal
         # no/bad token -> 401, nothing journaled
         code, _ = self._post(base, {"input": obs})
@@ -552,6 +580,16 @@ class TestSubmissionPortal:
         code, _ = self._post(base, {"input": obs}, token="wrong")
         assert code == 401
         assert read_submissions(root) == []
+        # a real, readable file OUTSIDE the data-root/watch_dir
+        # allowlist -> 403 (confinement, not existence), journaled as
+        # a rejection so the audit trail shows the attempt
+        outside = _obs_file(tmp_path, "outside.fil", seed=1)
+        code, entry = self._post(
+            base, {"input": outside}, token=alice.token
+        )
+        assert code == 403 and not entry["accepted"]
+        assert "data-root" in entry["reason"]
+        assert JobQueue(root).get_job(job_id_for(outside)) is None
         # authenticated: accepted, journaled via=http, priority capped
         code, entry = self._post(
             base, {"input": obs, "priority": 5}, token=alice.token
@@ -566,7 +604,7 @@ class TestSubmissionPortal:
         assert code == 409 and "duplicate" in entry["reason"]
         code, _ = self._post(base, {"nope": 1}, token=alice.token)
         assert code == 400
-        assert len(read_submissions(root)) == 2
+        assert len(read_submissions(root)) == 3
 
         with urllib.request.urlopen(base + "/tenants", timeout=5) as r:
             body = r.read().decode()
