@@ -54,7 +54,9 @@ DB_FILENAME = "candidates.sqlite"
 #:     ``sift_*`` tables arrive (the peasoup-sift product).
 #: 3 — observations gain the ``tenant`` stamp (multi-tenant usage
 #:     accounting + per-tenant sift slices).
-SCHEMA_VERSION = 3
+#: 4 — sift_candidates gain ``score``/``score_tier``/``model_fp``
+#:     (the peasoup-rank calibrated scorer's output + provenance).
+SCHEMA_VERSION = 4
 
 
 class SchemaVersionError(RuntimeError):
@@ -209,9 +211,31 @@ def _migrate_2_to_3(conn: sqlite3.Connection) -> None:
             )
 
 
+# columns added to sift_candidates in version 4: the rank scorer's
+# calibrated probability, triage tier, and the fingerprint of the
+# model artifact that produced them
+_SIFT_V4_COLUMNS = (
+    ("score", "REAL"),
+    ("score_tier", "INTEGER"),
+    ("model_fp", "TEXT"),
+)
+
+
+def _migrate_3_to_4(conn: sqlite3.Connection) -> None:
+    """v3 -> v4: ranking columns on sift_candidates."""
+    existing = {
+        r[1] for r in conn.execute("PRAGMA table_info(sift_candidates)")
+    }
+    for col, typ in _SIFT_V4_COLUMNS:
+        if col not in existing:
+            conn.execute(
+                f"ALTER TABLE sift_candidates ADD COLUMN {col} {typ}"
+            )
+
+
 #: in-place upgrades, keyed by FROM-version; applied in sequence until
 #: the file reads :data:`SCHEMA_VERSION`
-MIGRATIONS = {1: _migrate_1_to_2, 2: _migrate_2_to_3}
+MIGRATIONS = {1: _migrate_1_to_2, 2: _migrate_2_to_3, 3: _migrate_3_to_4}
 
 
 def _fnum(v, cast=float, default=None):
@@ -284,6 +308,7 @@ class CandidateDB:
                 _exec_script(self._conn, _SCHEMA_V1)
                 _migrate_1_to_2(self._conn)
                 _migrate_2_to_3(self._conn)
+                _migrate_3_to_4(self._conn)
             else:
                 for step in range(v, SCHEMA_VERSION):
                     MIGRATIONS[step](self._conn)
@@ -453,7 +478,7 @@ class CandidateDB:
         q = (
             "SELECT c.*, o.source_name, o.tstart AS obs_tstart, "
             "o.tsamp AS obs_tsamp, o.input AS obs_input, o.beam, "
-            "o.src_raj, o.src_dej, o.nsamps AS obs_nsamps "
+            "o.src_raj, o.src_dej, o.nsamps AS obs_nsamps, o.tenant "
             "FROM candidates c JOIN observations o "
             "ON o.job_id = c.job_id"
         )
@@ -507,7 +532,8 @@ class CandidateDB:
                     "INSERT INTO sift_candidates (run_id, kind, label, "
                     "tier, dm, snr, period, folded_snr, opt_period, "
                     "known_source, harmonic, n_obs, members, job_ids, "
-                    "fold_json) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                    "fold_json, score, score_tier, model_fp) VALUES "
+                    "(?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
                     [
                         (
                             run_id, c["kind"], c["label"], int(c["tier"]),
@@ -519,6 +545,10 @@ class CandidateDB:
                             json.dumps(c.get("job_ids", [])),
                             json.dumps(c["fold"])
                             if c.get("fold") is not None else None,
+                            c.get("score"),
+                            int(c["score_tier"])
+                            if c.get("score_tier") is not None else None,
+                            c.get("model_fp"),
                         )
                         for c in catalogue
                     ],
@@ -582,6 +612,29 @@ class CandidateDB:
             q += " LIMIT ?"
             args.append(int(limit))
         return self._query(q, args)
+
+    def update_sift_scores(self, scored: list[dict]) -> int:
+        """Write a re-scoring pass back onto existing sift rows (the
+        ``peasoup-rank score`` path; the sift service ingests scores
+        inline). Rows need ``id``, ``score``, ``score_tier``,
+        ``model_fp``."""
+
+        def _txn():
+            with self._conn:
+                self._conn.executemany(
+                    "UPDATE sift_candidates SET score = ?, "
+                    "score_tier = ?, model_fp = ? WHERE id = ?",
+                    [
+                        (
+                            s.get("score"), s.get("score_tier"),
+                            s.get("model_fp"), s["id"],
+                        )
+                        for s in scored
+                    ],
+                )
+
+        DB_RETRY.call(_txn, site="db.ingest", context="rank.score")
+        return len(scored)
 
     def sift_known_matches(self) -> list[dict]:
         return self._query(

@@ -72,6 +72,9 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--fold-batch", type=int, default=None,
                      help="candidates per fixed fold batch "
                      "(default 64)")
+    run.add_argument("--tenant", default="",
+                     help="sift only observations stamped with this "
+                     "tenant (the multi-tenant submission stamp)")
     run.add_argument("-v", "--verbose", action="store_true")
     add_observability_args(run)
 
@@ -89,6 +92,10 @@ def build_parser() -> argparse.ArgumentParser:
                      "<workdir>/sift/report.json)")
     rep.add_argument("--limit", type=int, default=50,
                      help="catalogue rows included (default 50)")
+    rep.add_argument("--tenant", default="",
+                     help="report only rows touching this tenant's "
+                     "observations (a filtered view of the sifted "
+                     "product; the bowtie honours it too)")
     rep.add_argument("--print-summary", action="store_true",
                      help="also print the tally to stdout")
     return p
@@ -128,6 +135,8 @@ def _cmd_run(args) -> int:
         overrides["fold"] = False
     if args.fold_batch:
         overrides["fold_batch"] = args.fold_batch
+    if args.tenant:
+        overrides["tenant"] = args.tenant
     cfg = SiftConfig(**overrides)
 
     if args.incremental:
@@ -216,7 +225,10 @@ def _cmd_report(args) -> int:
     html_path = args.html or os.path.join(sift_dir, "report.html")
     json_path = args.json_out or os.path.join(sift_dir, "report.json")
     with CandidateDB(db_path) as db:
-        doc = build_report(db, campaign_status, limit=args.limit)
+        doc = build_report(
+            db, campaign_status, limit=args.limit,
+            tenant=args.tenant or None,
+        )
     # the DM-time bowtie diagnostic rides beside the report and is
     # linked from it (a missing/empty SP table renders an empty plot;
     # a failure only loses the plot, never the report)
@@ -224,7 +236,7 @@ def _cmd_report(args) -> int:
     try:
         from ..tools.plotting import bowtie_from_db
 
-        svg = bowtie_from_db(db_path)
+        svg = bowtie_from_db(db_path, tenant=args.tenant or None)
         os.makedirs(sift_dir, exist_ok=True)
         bowtie_path = os.path.join(sift_dir, "bowtie.svg")
         tmp = bowtie_path + ".tmp"

@@ -22,6 +22,9 @@ Endpoints:
 - ``/tenants`` and ``/tenants/<name>`` — the multi-tenant view: per
   tenant queue tallies, quota vs windowed device-seconds, usage
   ledger, firing alerts, per-tenant sift/bowtie links.
+- ``/candidates`` (and ``/tenants/<name>/candidates``) — the ranked
+  triage table: score-tier tallies + top candidates, read READ-ONLY
+  from the sifted candidates.sqlite.
 - ``/usage`` — the usage ledger JSON (``queue/usage.json`` content,
   rebuilt in-memory when absent).
 - ``/`` — a small HTML index linking the above.
@@ -260,10 +263,137 @@ def _tenant_page_body(root: str, name: str) -> bytes | None:
         f"<h2>usage</h2>{_table(u)}"
         f"<h2>alerts</h2><ul>{alert_lines}</ul>"
         f"<h2>recent submissions</h2><ul>{sub_lines}</ul>"
-        '<p><a href="/report">sift report</a> · '
+        f'<p><a href="/tenants/{safe}/candidates">candidate '
+        "triage</a> · "
+        '<a href="/report">sift report</a> · '
         '<a href="/bowtie.svg">bowtie</a> · '
         '<a href="/tenants">all tenants</a></p>'
         "</body></html>"
+    )
+    return doc.encode()
+
+
+def _candidates_body(
+    root: str, tenant: str | None = None, limit: int = 50
+) -> bytes | None:
+    """The triage page: score-tier tallies + the top-N sifted
+    candidates, read directly (and READ-ONLY — the portal must never
+    migrate or write a database it merely renders) from the campaign's
+    candidates.sqlite. ``tenant`` narrows to rows touching that
+    tenant's observations. Tolerates a pre-ranking (v3) database: the
+    score columns simply read as absent."""
+    import sqlite3
+
+    if tenant is not None:
+        from ..campaign.tenants import valid_tenant_name
+
+        if not valid_tenant_name(tenant):
+            return None
+    db_path = os.path.join(root, "candidates.sqlite")
+    if not os.path.exists(db_path):
+        return None
+    try:
+        conn = sqlite3.connect(f"file:{db_path}?mode=ro", uri=True)
+    except sqlite3.Error:
+        return None
+    try:
+        conn.row_factory = sqlite3.Row
+        cols = {
+            r[1]
+            for r in conn.execute(
+                "PRAGMA table_info(sift_candidates)"
+            )
+        }
+        if not cols:
+            return None  # no sift product in this database yet
+        has_scores = "score" in cols
+        score_sel = (
+            "score, score_tier, model_fp"
+            if has_scores
+            else "NULL AS score, NULL AS score_tier, "
+            "NULL AS model_fp"
+        )
+        rows = [
+            dict(r)
+            for r in conn.execute(
+                f"SELECT label, tier, {score_sel}, dm, snr, period, "
+                "folded_snr, n_obs, job_ids FROM sift_candidates "
+                "ORDER BY (score IS NULL), score DESC, snr DESC"
+            )
+        ]
+        keep_jobs = None
+        if tenant is not None:
+            keep_jobs = {
+                r[0]
+                for r in conn.execute(
+                    "SELECT job_id FROM observations "
+                    "WHERE COALESCE(tenant, '') = ?",
+                    (tenant,),
+                )
+            }
+    except sqlite3.Error:
+        return None
+    finally:
+        conn.close()
+    if keep_jobs is not None:
+        rows = [
+            r for r in rows
+            if any(
+                j in keep_jobs
+                for j in json.loads(r.get("job_ids") or "[]")
+            )
+        ]
+    tier_counts: dict[str, int] = {}
+    model_fp = None
+    for r in rows:
+        st = r.get("score_tier")
+        key = str(st) if st is not None else "unscored"
+        tier_counts[key] = tier_counts.get(key, 0) + 1
+        model_fp = model_fp or r.get("model_fp")
+    tally = ", ".join(
+        f"{tier_counts.get(k, 0)} {lbl}"
+        for k, lbl in (
+            ("1", "tier-1"), ("2", "tier-2"), ("3", "tier-3"),
+            ("unscored", "unscored"),
+        )
+    )
+    def _num(v, nd: int) -> str:
+        return f"{v:.{nd}f}" if v is not None else "-"
+
+    body_rows = []
+    for r in rows[:limit]:
+        st = r.get("score_tier")
+        body_rows.append(
+            "<tr>"
+            f"<td>{_num(r.get('score'), 3)}</td>"
+            f"<td>{st if st is not None else '-'}</td>"
+            f"<td>{html.escape(str(r.get('label') or ''))}</td>"
+            f"<td>{r.get('tier')}</td>"
+            f"<td>{_num(r.get('period'), 6)}</td>"
+            f"<td>{_num(r.get('dm'), 2)}</td>"
+            f"<td>{_num(r.get('snr'), 1)}</td>"
+            f"<td>{_num(r.get('folded_snr'), 1)}</td>"
+            f"<td>{r.get('n_obs')}</td>"
+            "</tr>"
+        )
+    title = "candidate triage" + (
+        f" — tenant {html.escape(tenant)}" if tenant else ""
+    )
+    fp_line = (
+        f"<p>ranked by model <code>{html.escape(str(model_fp))}"
+        "</code></p>"
+        if model_fp else "<p>no ranking scores recorded yet</p>"
+    )
+    doc = (
+        f"<!DOCTYPE html><html><head><title>{title}</title></head>"
+        f"<body><h1>{title}</h1>"
+        f"<p>score tiers: {tally}</p>{fp_line}"
+        "<table border=1><tr><th>score</th><th>s-tier</th>"
+        "<th>label</th><th>tier</th><th>P (s)</th><th>DM</th>"
+        "<th>S/N</th><th>folded S/N</th><th>obs</th></tr>"
+        + "".join(body_rows)
+        + '</table><p><a href="/report">sift report</a> · '
+        '<a href="/">index</a></p></body></html>'
     )
     return doc.encode()
 
@@ -298,6 +428,7 @@ def _index_body(root: str) -> bytes:
         '<li><a href="/alerts">/alerts</a></li>'
         '<li><a href="/tenants">/tenants</a></li>'
         '<li><a href="/usage">/usage</a></li>'
+        '<li><a href="/candidates">candidate triage</a></li>'
         '<li><a href="/report">sift report</a></li>'
         '<li><a href="/bowtie.svg">bowtie</a></li></ul>'
         "</body></html>"
@@ -350,8 +481,21 @@ def serve_portal(
                 return _alerts_body(root), "application/json"
             if path == "/usage":
                 return _usage_body(root), "application/json"
+            if path == "/candidates":
+                return (
+                    _candidates_body(root),
+                    "text/html; charset=utf-8",
+                )
             if path == "/tenants":
                 return _tenants_body(root), "text/html; charset=utf-8"
+            if path.startswith("/tenants/") and path.endswith(
+                "/candidates"
+            ):
+                name = path[len("/tenants/"):-len("/candidates")]
+                return (
+                    _candidates_body(root, tenant=name),
+                    "text/html; charset=utf-8",
+                )
             if path.startswith("/tenants/"):
                 return (
                     _tenant_page_body(root, path[len("/tenants/"):]),

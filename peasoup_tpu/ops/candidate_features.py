@@ -1,0 +1,230 @@
+"""Batched candidate feature extraction + scorer forward pass.
+
+The ranking stage's device programs (the PICS/PulsarX direction,
+arXiv:2309.02544): one jitted program turns a fixed-width batch of
+fold products — folded profile, subintegration stamp, DM curve — into
+a feature matrix, and a second (a builder, so weight geometry stays an
+argument) runs the small MLP scorer forward pass over it. Both are
+registered so the audit's contract engine, AOT warmup (at
+campaign-bucket shapes via the ``fold_batch``/``fold_nbins``/
+``fold_nints`` ShapeCtx fields), the microbench and the perf ratchet
+cover them like every other program.
+
+Feature rows are **independent** — no cross-row reduction anywhere —
+so the scoring driver (:mod:`peasoup_tpu.rank.score`) can halve the
+batch under ``device.oom`` and get bitwise-identical features, the
+same contract the survey folder honours.
+
+The DM curve is the fold significance at :data:`DM_CURVE_FRACTIONS`
+of the candidate DM (index 0 = the zero-DM hypothesis, last = the
+candidate DM). Broadband terrestrial RFI peaks at zero DM; a real
+dispersed pulsar peaks at its own DM — the contrast features carry
+exactly that discriminant.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+#: DM-curve sample points, as fractions of the candidate DM. Fixed at
+#: module level so every jit shape derives from (batch, nbins, nints)
+#: alone and same-bucket scoring batches never recompile.
+DM_CURVE_FRACTIONS = (0.0, 0.25, 0.5, 0.75, 1.0)
+DM_CURVE_POINTS = len(DM_CURVE_FRACTIONS)
+
+#: Feature-matrix columns, in order. FEATURE_NAMES[j] documents
+#: features[:, j]; the model artifact pins this list so a stale model
+#: can never silently read a reordered matrix.
+FEATURE_NAMES = (
+    "prof_snr",            # (peak - off-pulse mean) / off-pulse std
+    "prof_sharpness",      # fraction of bins above half the peak
+    "offpulse_cv",         # off-pulse std / profile dynamic range
+    "offpulse_mad_ratio",  # off-pulse MAD/std (baseline gaussianity)
+    "subint_chi2",         # mean sq. dev of normalised subints vs prof
+    "subint_corr_mean",    # mean subint-profile correlation
+    "subint_persistence",  # fraction of subints correlated with prof
+    "subint_intermittency",  # std/mean of per-subint peak amplitude
+    "dm_contrast",         # (S(dm_c) - S(0)) / (|S(0)| + |S(dm_c)|)
+    "dm_peakedness",       # (max - mean) / std over the DM curve
+    "dm_argmax_frac",      # argmax position on the curve (1 = cand DM)
+)
+NFEATURES = len(FEATURE_NAMES)
+
+_EPS = 1e-6
+
+
+def _median_last(x: jnp.ndarray) -> jnp.ndarray:
+    """Median over the last axis via an explicit f32 sort.
+    ``jnp.median``'s quantile path does its index arithmetic (floor/
+    ceil/clamp on the scaled q) in float64, which trips the audit's
+    PSC101 f64-drift contract; the sizes here are static, so the
+    middle elements are compile-time indices."""
+    n = x.shape[-1]
+    s = jnp.sort(x, axis=-1)
+    mid = n // 2
+    if n % 2:
+        return s[..., mid]
+    return 0.5 * (s[..., mid - 1] + s[..., mid])
+
+
+def _row_features(
+    prof: jnp.ndarray, subints: jnp.ndarray, dm_curve: jnp.ndarray
+) -> jnp.ndarray:
+    """Features of ONE candidate: (nbins,), (nints, nbins), (D,)."""
+    # --- profile shape ------------------------------------------------
+    med = _median_last(prof)
+    centred = prof - med
+    peak = jnp.max(centred)
+    on = centred > 0.5 * peak  # the half-max pulse window
+    off = ~on
+    n_off = jnp.maximum(jnp.sum(off), 1)
+    off_mean = jnp.sum(jnp.where(off, prof, 0.0)) / n_off
+    off_var = (
+        jnp.sum(jnp.where(off, (prof - off_mean) ** 2, 0.0)) / n_off
+    )
+    off_std = jnp.sqrt(jnp.maximum(off_var, 0.0))
+    off_mad = (
+        jnp.sum(jnp.where(off, jnp.abs(prof - off_mean), 0.0)) / n_off
+    )
+    dyn = jnp.max(prof) - jnp.min(prof)
+    prof_snr = (jnp.max(prof) - off_mean) / (off_std + _EPS)
+    prof_sharpness = jnp.mean(on.astype(jnp.float32))
+    offpulse_cv = off_std / (dyn + _EPS)
+    offpulse_mad_ratio = off_mad / (off_std + _EPS)
+
+    # --- subintegration persistence ----------------------------------
+    nprof = (prof - jnp.mean(prof)) / (jnp.std(prof) + _EPS)
+    smean = jnp.mean(subints, axis=1, keepdims=True)
+    sstd = jnp.std(subints, axis=1, keepdims=True)
+    nsub = (subints - smean) / (sstd + _EPS)
+    corr = jnp.mean(nsub * nprof[None, :], axis=1)  # (nints,)
+    subint_chi2 = jnp.mean((nsub - nprof[None, :]) ** 2)
+    subint_corr_mean = jnp.mean(corr)
+    subint_persistence = jnp.mean((corr > 0.15).astype(jnp.float32))
+    peaks = jnp.max(subints, axis=1) - _median_last(subints)
+    subint_intermittency = jnp.std(peaks) / (
+        jnp.abs(jnp.mean(peaks)) + _EPS
+    )
+
+    # --- DM curve vs the zero-DM hypothesis --------------------------
+    s0, sc = dm_curve[0], dm_curve[-1]
+    dm_contrast = (sc - s0) / (jnp.abs(s0) + jnp.abs(sc) + _EPS)
+    dm_peakedness = (jnp.max(dm_curve) - jnp.mean(dm_curve)) / (
+        jnp.std(dm_curve) + _EPS
+    )
+    dm_argmax_frac = jnp.argmax(dm_curve).astype(jnp.float32) / float(
+        max(dm_curve.shape[0] - 1, 1)
+    )
+
+    return jnp.stack(
+        [
+            prof_snr,
+            prof_sharpness,
+            offpulse_cv,
+            offpulse_mad_ratio,
+            subint_chi2,
+            subint_corr_mean,
+            subint_persistence,
+            subint_intermittency,
+            dm_contrast,
+            dm_peakedness,
+            dm_argmax_frac,
+        ]
+    ).astype(jnp.float32)
+
+
+@partial(jax.jit, static_argnames=("nbins", "nints"))
+def candidate_features_batch(
+    prof: jnp.ndarray,  # (B, nbins) f32 folded profiles
+    subints: jnp.ndarray,  # (B, nints, nbins) f32 subint stamps
+    dm_curve: jnp.ndarray,  # (B, DM_CURVE_POINTS) f32 significances
+    *,
+    nbins: int,
+    nints: int,
+) -> jnp.ndarray:
+    """Feature matrix of a fixed batch of fold products ->
+    (B, NFEATURES) f32. ``nbins``/``nints`` are static for the same
+    reason they are on ``survey_fold_batch``: they name the compiled
+    geometry, which the scoring driver pins per campaign bucket."""
+    del nbins, nints  # carried in the array shapes
+    return jax.vmap(_row_features)(prof, subints, dm_curve)
+
+
+def make_score_apply_fn():
+    """The scorer forward pass: standardise features, one tanh hidden
+    layer, logistic output. Weights are *arguments* (not baked-in
+    constants), so one compiled program serves every model artifact of
+    a given geometry — swapping models never recompiles."""
+
+    def _apply(feats, mean, scale, w1, b1, w2, b2):
+        z = (feats - mean[None, :]) / scale[None, :]
+        h = jnp.tanh(z @ w1 + b1[None, :])
+        logit = h @ w2 + b2
+        return jax.nn.sigmoid(logit)
+
+    return jax.jit(_apply)
+
+
+# --- audit registry: tiny representative shapes; the ShapeCtx hooks
+# rebuild at the sift service's production fold bucket so campaign
+# warmup + the >=2-rung ladder contract trace cover both programs ---
+from .registry import register_program, sds  # noqa: E402
+
+_HIDDEN = 16  # the shipped artifact's hidden width
+
+
+def _score_apply_args(batch: int):
+    return (
+        sds((batch, NFEATURES), "float32"),
+        sds((NFEATURES,), "float32"),
+        sds((NFEATURES,), "float32"),
+        sds((NFEATURES, _HIDDEN), "float32"),
+        sds((_HIDDEN,), "float32"),
+        sds((_HIDDEN,), "float32"),
+        sds((), "float32"),
+    )
+
+
+def _param_candidate_features(ctx):
+    if ctx.fold_batch <= 0 or ctx.fold_nsamps <= 0:
+        return None
+    b, nbins, nints = ctx.fold_batch, ctx.fold_nbins, ctx.fold_nints
+    return (
+        candidate_features_batch,
+        (
+            sds((b, nbins), "float32"),
+            sds((b, nints, nbins), "float32"),
+            sds((b, DM_CURVE_POINTS), "float32"),
+        ),
+        {"nbins": nbins, "nints": nints},
+    )
+
+
+def _param_score_apply(ctx):
+    if ctx.fold_batch <= 0 or ctx.fold_nsamps <= 0:
+        return None
+    return (make_score_apply_fn(), _score_apply_args(ctx.fold_batch), {})
+
+
+register_program(
+    "ops.candidate_features.candidate_features_batch",
+    lambda: (
+        candidate_features_batch,
+        (
+            sds((3, 16), "float32"),
+            sds((3, 4, 16), "float32"),
+            sds((3, DM_CURVE_POINTS), "float32"),
+        ),
+        {"nbins": 16, "nints": 4},
+    ),
+    param=_param_candidate_features,
+)
+
+register_program(
+    "ops.candidate_features.score_apply",
+    lambda: (make_score_apply_fn(), _score_apply_args(3), {}),
+    param=_param_score_apply,
+)

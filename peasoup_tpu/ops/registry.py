@@ -50,6 +50,7 @@ _PROGRAM_MODULES = (
     "peasoup_tpu.ops.ffa",
     "peasoup_tpu.ops.coincidence",
     "peasoup_tpu.ops.correlate",
+    "peasoup_tpu.ops.candidate_features",
 )
 
 
@@ -190,6 +191,9 @@ REGISTRY_ALIASES = {
     ),
     "ops.dedisperse._stage2_matmul_batched": (
         "ops.dedisperse.subband_stage2_matmul"
+    ),
+    "ops.candidate_features.make_score_apply_fn": (
+        "ops.candidate_features.score_apply"
     ),
 }
 

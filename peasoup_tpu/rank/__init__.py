@@ -1,0 +1,26 @@
+"""Candidate ranking: calibrated scores over sift fold products.
+
+The triage layer (the PICS/PulsarX direction, arXiv:2309.02544): a
+batched, jitted feature extractor (ops/candidate_features.py) feeds a
+small pure-JAX MLP scorer whose weights ship as a schema-validated
+JSON artifact, trained and calibrated on the injection machinery the
+repo already owns (synthetic pulsars + RFI foils). The sift service
+scores every catalogue row through the same fixed-batch/OOM-ladder
+dispatch as the survey folder; scores, score tiers and the model
+fingerprint land in the sift DB (schema v4), the report and the
+portal's ``/candidates`` triage page.
+
+- :mod:`peasoup_tpu.rank.model` — the artifact (load/save/validate,
+  fingerprint, calibrated prediction, score-tier mapping);
+- :mod:`peasoup_tpu.rank.score` — the batched scoring driver
+  (pad-recycled fixed batches, ``device.oom`` degradation ladder);
+- :mod:`peasoup_tpu.rank.train` — deterministic seeded training +
+  isotonic-style calibration + the injected-ground-truth ROC/AUC
+  evaluation the CI gate runs (``peasoup-rank eval``).
+"""
+
+from .model import (  # noqa: F401
+    DEFAULT_MODEL_PATH,
+    RankModel,
+    score_tier,
+)

@@ -1,0 +1,126 @@
+"""Batched scoring driver: fold products -> features -> scores.
+
+The dispatch mirrors the survey folder exactly: fixed-width batches
+padded by recycling rows (so every dispatch of one geometry reuses ONE
+compiled program — zero steady-state recompiles), a ``device.oom``
+fault seam, and a ``rank.features`` :class:`DegradationLadder` that
+halves the batch and retries. Feature rows are independent
+(ops/candidate_features.py), so shrinking the batch is bitwise-neutral
+— pinned by tests/test_rank.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..obs import get_logger
+from ..resilience import DegradationLadder, faults, is_resource_exhausted
+from .model import RankModel
+
+log = get_logger("rank.score")
+
+
+def neutral_dm_curve(n: int) -> np.ndarray:
+    """A flat DM curve for candidates scored without one (no raw data
+    left to refold): zero contrast, zero peakedness — the DM features
+    go silent instead of inventing a verdict."""
+    from ..ops.candidate_features import DM_CURVE_POINTS
+
+    return np.zeros((n, DM_CURVE_POINTS), dtype=np.float32)
+
+
+def extract_features(
+    prof: np.ndarray,  # (N, nbins) f32
+    subints: np.ndarray,  # (N, nints, nbins) f32
+    dm_curve: np.ndarray,  # (N, DM_CURVE_POINTS) f32
+    *,
+    batch: int = 64,
+) -> np.ndarray:
+    """Feature matrix (N, NFEATURES) via fixed pad-recycled batches of
+    ``candidate_features_batch``, shrinking under ``device.oom``."""
+    from ..ops.candidate_features import candidate_features_batch
+
+    import jax.numpy as jnp
+
+    n_total = len(prof)
+    if n_total == 0:
+        from ..ops.candidate_features import NFEATURES
+
+        return np.empty((0, NFEATURES), dtype=np.float32)
+    nbins = int(prof.shape[-1])
+    nints = int(subints.shape[-2])
+    batch = max(1, int(batch))
+    ladder = DegradationLadder("rank.features", ("batch_shrink",))
+    out: list[np.ndarray] = []
+    lo = 0
+    while lo < n_total:
+        hi = min(lo + batch, n_total)
+        n = hi - lo
+        pad_idx = np.arange(batch) % n + lo
+        try:
+            faults.fire("device.oom", context=f"rank.features:{lo}")
+            feats = np.asarray(
+                candidate_features_batch(
+                    jnp.asarray(prof[pad_idx]),
+                    jnp.asarray(subints[pad_idx]),
+                    jnp.asarray(dm_curve[pad_idx]),
+                    nbins=nbins,
+                    nints=nints,
+                )
+            )[:n]
+        except Exception as exc:
+            if not is_resource_exhausted(exc):
+                raise
+            if batch <= 1:
+                ladder.exhausted(batch=batch, error=f"{exc!s:.200}")
+                raise
+            ladder.step(
+                "batch_shrink", batch_old=batch,
+                batch_new=batch // 2, error=f"{exc!s:.200}",
+            )
+            batch //= 2
+            continue  # retry the same rows at the smaller batch
+        out.append(feats)
+        lo = hi
+    return np.concatenate(out, axis=0)
+
+
+def score_feature_matrix(
+    model: RankModel, feats: np.ndarray, *, batch: int = 64
+) -> np.ndarray:
+    """Calibrated probabilities over a feature matrix, dispatched in
+    the same fixed pad-recycled batch width so the ``score_apply``
+    program compiles once per geometry."""
+    n_total = len(feats)
+    if n_total == 0:
+        return np.empty((0,), dtype=np.float64)
+    batch = max(1, int(batch))
+    raw = np.empty(n_total, dtype=np.float64)
+    lo = 0
+    while lo < n_total:
+        hi = min(lo + batch, n_total)
+        n = hi - lo
+        pad_idx = np.arange(batch) % n + lo
+        raw[lo:hi] = model.predict_raw(feats[pad_idx])[:n]
+        lo = hi
+    return model.calibrate(raw)
+
+
+def score_fold_products(
+    model: RankModel,
+    prof: np.ndarray,
+    subints: np.ndarray,
+    dm_curve: np.ndarray | None = None,
+    *,
+    batch: int = 64,
+) -> tuple[np.ndarray, np.ndarray]:
+    """The full pass: ``(features, calibrated_scores)``."""
+    if dm_curve is None:
+        dm_curve = neutral_dm_curve(len(prof))
+    feats = extract_features(
+        np.asarray(prof, dtype=np.float32),
+        np.asarray(subints, dtype=np.float32),
+        np.asarray(dm_curve, dtype=np.float32),
+        batch=batch,
+    )
+    return feats, score_feature_matrix(model, feats, batch=batch)

@@ -33,18 +33,81 @@ from .crossmatch import harmonic_identify
 log = get_logger("sift.dedup")
 
 
+def packed_position_deg(
+    raj: float, dej: float
+) -> tuple[float, float]:
+    """Sigproc packed ``HHMMSS.s`` / ``DDMMSS.s`` header position ->
+    ``(ra_deg, dec_deg)``."""
+    sign = -1.0 if dej < 0 else 1.0
+    a = abs(float(raj))
+    hh = int(a // 10000)
+    mm = int((a - hh * 10000) // 100)
+    ss = a - hh * 10000 - mm * 100
+    d = abs(float(dej))
+    dd = int(d // 10000)
+    dmm = int((d - dd * 10000) // 100)
+    dss = d - dd * 10000 - dmm * 100
+    return (
+        (hh + mm / 60.0 + ss / 3600.0) * 15.0,
+        sign * (dd + dmm / 60.0 + dss / 3600.0),
+    )
+
+
+def sky_separation_deg(
+    ra1: float, dec1: float, ra2: float, dec2: float
+) -> float:
+    """Great-circle angular separation (haversine) in degrees."""
+    r1, d1, r2, d2 = (
+        math.radians(v) for v in (ra1, dec1, ra2, dec2)
+    )
+    s = (
+        math.sin((d2 - d1) / 2.0) ** 2
+        + math.cos(d1) * math.cos(d2)
+        * math.sin((r2 - r1) / 2.0) ** 2
+    )
+    return math.degrees(2.0 * math.asin(min(1.0, math.sqrt(s))))
+
+
+def _row_position_deg(c: dict) -> tuple[float, float] | None:
+    """A row's sky position in degrees, or None when the observation
+    recorded none (rows without positions are never position-gated)."""
+    raj, dej = c.get("src_raj"), c.get("src_dej")
+    if raj is None or dej is None:
+        return None
+    return packed_position_deg(float(raj), float(dej))
+
+
+def position_gate_ok(a: dict, b: dict, pos_tol_deg: float) -> bool:
+    """Whether two rows may associate under the sky-position gate: a
+    disabled gate (``pos_tol_deg <= 0``) or a missing position on
+    either side always passes; otherwise the great-circle separation
+    must stay within tolerance — a harmonic coincidence between
+    antipodal pointings is not one pulsar."""
+    if pos_tol_deg <= 0:
+        return True
+    pa, pb = _row_position_deg(a), _row_position_deg(b)
+    if pa is None or pb is None:
+        return True
+    return sky_separation_deg(*pa, *pb) <= pos_tol_deg
+
+
 def dedup_candidates(
     cands: list[dict],
     *,
     max_harm: int = 8,
     period_tol: float = 2e-3,
     dm_tol: float = 2.0,
+    pos_tol_deg: float = 0.0,
 ) -> list[dict]:
     """Associate harmonically-related candidates across observations.
 
     ``cands`` rows need ``id``, ``job_id``, ``period`` (the effective
-    one — opt_period when folded), ``dm``, ``snr``. Returns one group
-    dict per distinct source: ``leader`` (the highest-S/N member),
+    one — opt_period when folded), ``dm``, ``snr``, and optionally
+    ``src_raj``/``src_dej`` (sigproc packed) for the sky-position gate
+    (``pos_tol_deg > 0``: members beyond that separation from the
+    leader never merge; rows without positions always pass). Returns
+    one group dict per distinct source: ``leader`` (the highest-S/N
+    member),
     ``members`` (every absorbed row, leader included), ``n_obs``
     (distinct observations), ``job_ids`` and, when the leader absorbed
     a non-fundamental detection, the member's ladder identity.
@@ -63,6 +126,8 @@ def dedup_candidates(
             if other["id"] in claimed:
                 continue
             if abs(float(other["dm"]) - float(lead["dm"])) > dm_tol:
+                continue
+            if not position_gate_ok(lead, other, pos_tol_deg):
                 continue
             rung = harmonic_identify(
                 float(other["period"]), float(lead["period"]),

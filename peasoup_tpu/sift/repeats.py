@@ -23,10 +23,33 @@ from __future__ import annotations
 import numpy as np
 
 from ..obs import get_logger
+from .dedup import position_gate_ok
 
 log = get_logger("sift.repeats")
 
 SECONDS_PER_DAY = 86400.0
+
+
+def _split_by_position(
+    group: list[dict], pos_tol_deg: float
+) -> list[list[dict]]:
+    """Partition one DM cluster by sky position: greedy anchoring —
+    the first unassigned row seeds a source, every row passing the
+    position gate against that anchor joins it (rows without recorded
+    positions always pass). One DM coincidence across opposite sky
+    poles is not one repeating source."""
+    out: list[list[dict]] = []
+    remaining = list(group)
+    while remaining:
+        anchor = remaining[0]
+        sub = [
+            r for r in remaining
+            if position_gate_ok(anchor, r, pos_tol_deg)
+        ]
+        sub_ids = {id(r) for r in sub}
+        remaining = [r for r in remaining if id(r) not in sub_ids]
+        out.append(sub)
+    return out
 
 
 def associate_repeats(
@@ -35,11 +58,15 @@ def associate_repeats(
     dm_tol: float = 1.0,
     min_pulses: int = 3,
     min_obs: int = 2,
+    pos_tol_deg: float = 0.0,
 ) -> list[list[dict]]:
     """Cluster single-pulse rows (needing ``dm``, ``job_id``) into
     repeat-source groups: DM chain clustering (adjacent-in-DM rows
-    within ``dm_tol`` join one cluster), kept when the cluster spans
-    at least ``min_obs`` observations and ``min_pulses`` pulses."""
+    within ``dm_tol`` join one cluster), each cluster then split by
+    sky position when ``pos_tol_deg > 0`` (rows need
+    ``src_raj``/``src_dej``; missing positions never gate), kept when
+    the cluster spans at least ``min_obs`` observations and
+    ``min_pulses`` pulses."""
     rows = sorted(sp_cands, key=lambda c: float(c["dm"]))
     groups: list[list[dict]] = []
     cur: list[dict] = []
@@ -50,6 +77,12 @@ def associate_repeats(
         cur.append(r)
     if cur:
         groups.append(cur)
+    if pos_tol_deg > 0:
+        groups = [
+            sub
+            for g in groups
+            for sub in _split_by_position(g, pos_tol_deg)
+        ]
     return [
         g
         for g in groups
@@ -126,13 +159,15 @@ def repeat_sources(
     min_period: float = 0.05,
     max_harm: int = 1000,
     phase_tol: float = 0.02,
+    pos_tol_deg: float = 0.0,
 ) -> list[dict]:
     """The full pass: associate + infer. Returns one source dict per
     repeat group (period fields None when the GCD fit found nothing —
     a sporadic repeater is still worth a catalogue row)."""
     sources = []
     for group in associate_repeats(
-        sp_cands, dm_tol=dm_tol, min_pulses=min_pulses, min_obs=min_obs
+        sp_cands, dm_tol=dm_tol, min_pulses=min_pulses,
+        min_obs=min_obs, pos_tol_deg=pos_tol_deg,
     ):
         toas = toas_seconds(group)
         fit = infer_period(

@@ -39,24 +39,46 @@ def validate_report(doc: dict) -> None:
     validate(doc, schema)
 
 
+def _tenant_jobs(db: CandidateDB, tenant: str) -> set:
+    """Job ids of observations stamped with this tenant."""
+    return {
+        o["job_id"]
+        for o in db.observations()
+        if (o.get("tenant") or "") == tenant
+    }
+
+
 def build_report(
     db: CandidateDB,
     campaign_status: dict | None = None,
     *,
     limit: int = 50,
+    tenant: str | None = None,
 ) -> dict:
-    """Aggregate DB + rollup into the report document."""
+    """Aggregate DB + rollup into the report document. With ``tenant``
+    the catalogue/known/SP sections keep only rows touching that
+    tenant's observations (the sifted product itself is campaign-wide;
+    this is a view)."""
     run = db.latest_sift_run()
     if run is None:
         raise RuntimeError(
             "no sift run in the database — run `peasoup-sift run` first"
         )
-    catalogue = db.sift_catalogue(limit=limit)
-    for row in catalogue:
+    keep_jobs = _tenant_jobs(db, tenant) if tenant else None
+    full = db.sift_catalogue()
+    for row in full:
         row["job_ids"] = json.loads(row.get("job_ids") or "[]")
         fold = row.pop("fold_json", None)
         row["fold"] = json.loads(fold) if fold else None
+    if keep_jobs is not None:
+        full = [
+            row for row in full
+            if any(j in keep_jobs for j in row["job_ids"])
+        ]
+    catalogue = full[:limit] if limit else full
     known = db.sift_known_matches()
+    if keep_jobs is not None:
+        known = [m for m in known if m.get("job_id") in keep_jobs]
     by_psr: dict[str, dict] = {}
     for m in known:
         rec = by_psr.setdefault(
@@ -76,12 +98,27 @@ def build_report(
     for s in sp_sources:
         s["job_ids"] = json.loads(s.get("job_ids") or "[]")
         s["toas_s"] = json.loads(s.get("toas_s") or "[]")
+    if keep_jobs is not None:
+        sp_sources = [
+            s for s in sp_sources
+            if any(j in keep_jobs for j in s["job_ids"])
+        ]
     tiers: dict[str, int] = {}
     labels: dict[str, int] = {}
-    for row in db.sift_catalogue():
+    score_tiers: dict[str, int] = {}
+    model_fp = None
+    for row in full:
         tiers[str(row["tier"])] = tiers.get(str(row["tier"]), 0) + 1
         labels[row["label"]] = labels.get(row["label"], 0) + 1
+        st = row.get("score_tier")
+        if st is not None:
+            score_tiers[str(st)] = score_tiers.get(str(st), 0) + 1
+            model_fp = model_fp or row.get("model_fp")
     counts = db.counts()
+    n_observations = (
+        len(keep_jobs)
+        if keep_jobs is not None else counts["observations"]
+    )
     return {
         "schema": REPORT_SCHEMA,
         "version": REPORT_VERSION,
@@ -96,10 +133,13 @@ def build_report(
             "n_rfi": run["n_rfi"],
             "n_sp_sources": run["n_sp_sources"],
         },
-        "observations": counts["observations"],
+        "observations": n_observations,
         "candidates": counts["candidates"],
         "tiers": tiers,
         "labels": labels,
+        "score_tiers": score_tiers,
+        "model_fp": model_fp,
+        "tenant": tenant or None,
         "known_sources": sorted(
             by_psr.values(), key=lambda r: -r["n_matches"]
         ),
@@ -170,22 +210,38 @@ def render_html(doc: dict, bowtie_href: str | None = None) -> str:
         f"<h1>Survey sifting report <code>{run['run_id']}</code></h1>",
         "<p>",
         f"generated {time.strftime('%Y-%m-%d %H:%M:%S UTC', time.gmtime(doc['generated_unix']))}"
-        f" · {doc['observations']} observations",
+        f" · {doc['observations']} observations"
+        + (
+            f" · tenant <code>{html.escape(doc['tenant'])}</code>"
+            if doc.get("tenant") else ""
+        ),
         "</p><div>",
     ]
-    for label, n in (
+    score_tiers = doc.get("score_tiers") or {}
+    tallies = [
         ("catalogue rows", run["n_catalogue"]),
         ("known sources", run["n_known"]),
         ("RFI vetoed", run["n_rfi"]),
         ("repeat SP sources", run["n_sp_sources"]),
         ("candidates folded", run["n_folded"]),
-    ):
+    ]
+    if score_tiers:
+        tallies.append(("score tier 1", score_tiers.get("1", 0)))
+    for label, n in tallies:
         parts.append(
             f"<span class='tally'><b>{n}</b>{label}</span>"
         )
-    parts.append("</div><h2>Candidate catalogue</h2><table>")
+    parts.append("</div><h2>Candidate catalogue</h2>")
+    if doc.get("model_fp"):
+        parts.append(
+            f"<p>ranked by model <code>"
+            f"{html.escape(doc['model_fp'])}</code> (score is the "
+            "calibrated P(pulsar); s-tier 1 = review first)</p>"
+        )
+    parts.append("<table>")
     parts.append(
-        "<tr><th>tier</th><th>label</th><th>P (s)</th><th>DM</th>"
+        "<tr><th>tier</th><th>label</th><th>score</th>"
+        "<th>s-tier</th><th>P (s)</th><th>DM</th>"
         "<th>S/N</th><th>folded S/N</th><th>obs</th><th>members</th>"
         "<th>source</th><th>harm</th><th>profile</th></tr>"
     )
@@ -199,9 +255,12 @@ def render_html(doc: dict, bowtie_href: str | None = None) -> str:
             cls.append("rfi")
         prof = (row.get("fold") or {}).get("prof") or []
         src = row.get("known_source")
+        stier = row.get("score_tier")
         parts.append(
             f"<tr class='{' '.join(cls)}'>"
             f"<td>{row['tier']}</td><td>{row['label']}</td>"
+            f"<td>{_fmt(row.get('score'), 3)}</td>"
+            f"<td>{stier if stier is not None else '–'}</td>"
             f"<td>{_fmt(row['period'], 6)}</td>"
             f"<td>{_fmt(row['dm'], 2)}</td>"
             f"<td>{_fmt(row['snr'], 1)}</td>"

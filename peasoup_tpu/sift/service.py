@@ -4,8 +4,10 @@ One run consumes the campaign candidate database end to end:
 
   load -> batch-fold (ops/survey_fold via sift/fold) -> known-pulsar
   cross-match -> multi-beam coincidence veto -> campaign-level
-  harmonic/DM dedup -> repeat single-pulse association -> one
-  transaction writing the ``sift_*`` tables.
+  harmonic/DM dedup (sky-position gated) -> calibrated candidate
+  scoring (peasoup_tpu/rank, DM-curve refold + batched feature
+  extraction) -> repeat single-pulse association -> one transaction
+  writing the ``sift_*`` tables.
 
 The run is wired into the full observability + resilience stacks: a
 ``sift`` status section (heartbeat/status.json + telemetry manifest),
@@ -74,6 +76,20 @@ class SiftConfig:
     sp_min_period: float = 0.05
     sp_max_harm: int = 1000
     sp_phase_tol: float = 0.02
+    # sky-position association gates (degrees; <= 0 disables): members
+    # must lie within this angular separation to merge into one source
+    # — a harmonic coincidence between opposite sky poles is not one
+    # pulsar. Generous default: adjacent beams of one pointing pass,
+    # antipodal detections never do.
+    dedup_pos_tol_deg: float = 3.0
+    sp_pos_tol_deg: float = 3.0
+    # candidate ranking (peasoup-rank): score every catalogue row with
+    # fold products through the calibrated model artifact
+    score: bool = True
+    score_model: str = ""  # "" = the checked-in default artifact
+    score_batch: int = 64
+    # per-tenant slice: sift only observations stamped with this tenant
+    tenant: str = ""
 
     def resolved_db(self) -> str:
         return self.db_path or os.path.join(self.workdir, DB_FILENAME)
@@ -183,6 +199,122 @@ class SiftRun:
             )
         return out
 
+    # --- candidate ranking --------------------------------------------
+    def _dm_curve_refold(
+        self, scorable: list[tuple[int, dict]], obs_rows: list[dict]
+    ) -> dict[int, np.ndarray]:
+        """Refold each scored lead at fractions of its own DM (same
+        batched survey-fold path, synthetic candidate keys): the curve
+        of optimised S/N over trial DM peaks at the candidate DM for a
+        celestial signal and at zero for terrestrial interference — the
+        scorer's strongest discriminant. Returns row-index -> curve."""
+        from ..ops.candidate_features import DM_CURVE_FRACTIONS
+        from ..parallel.multihost import run_survey_fold
+
+        cfg = self.cfg
+        ndm = len(DM_CURVE_FRACTIONS)
+        per_obs_cap = max(1, cfg.max_fold_per_obs // ndm)
+        synth: list[dict] = []
+        per_job: dict[str, int] = {}
+        for ridx, lead in scorable:
+            jid = lead["job_id"]
+            if per_job.get(jid, 0) >= per_obs_cap:
+                continue
+            per_job[jid] = per_job.get(jid, 0) + 1
+            for fi, frac in enumerate(DM_CURVE_FRACTIONS):
+                synth.append(
+                    {
+                        "id": ridx * ndm + fi,
+                        "job_id": jid,
+                        "dm": float(frac) * float(lead["dm"]),
+                        "period": float(lead["eff_period"]),
+                        "acc": float(lead.get("acc") or 0.0),
+                        "snr": float(lead.get("snr") or 0.0),
+                    }
+                )
+        if not synth:
+            return {}
+        fold_inputs = self.build_fold_inputs(obs_rows, synth)
+        folder = SurveyFolder(
+            nbins=cfg.fold_nbins, nints=cfg.fold_nints,
+            batch=cfg.fold_batch,
+        )
+        curves: dict[int, np.ndarray] = {}
+        for o in run_survey_fold(fold_inputs, folder):
+            ridx, fi = divmod(int(o["key"]), ndm)
+            curves.setdefault(
+                ridx, np.zeros(ndm, dtype=np.float32)
+            )[fi] = float(o["opt_sn"])
+        return curves
+
+    def _score_catalogue(
+        self,
+        catalogue_rows: list[dict],
+        row_leads: list[tuple[int, dict]],
+        outcomes_by_key: dict,
+        obs_rows: list[dict],
+    ) -> int:
+        """Attach calibrated scores, triage tiers, and the model
+        fingerprint to every catalogue row with fold products. The DM
+        curve lands in the row's fold stamp so ``peasoup-rank score``
+        can re-score the database later without raw data."""
+        from ..ops.candidate_features import DM_CURVE_FRACTIONS
+        from ..rank.model import RankModel, score_tier
+        from ..rank.score import score_fold_products
+
+        cfg = self.cfg
+        scorable = [
+            (ridx, lead)
+            for ridx, lead in row_leads
+            if outcomes_by_key.get(lead["id"]) is not None
+        ]
+        if not scorable:
+            return 0
+        model = RankModel.from_file(cfg.score_model or None)
+        curves = self._dm_curve_refold(scorable, obs_rows)
+        ndm = len(DM_CURVE_FRACTIONS)
+        prof = np.stack(
+            [
+                np.asarray(
+                    outcomes_by_key[lead["id"]]["opt_prof"],
+                    dtype=np.float32,
+                )
+                for _, lead in scorable
+            ]
+        )
+        subints = np.stack(
+            [
+                np.asarray(
+                    outcomes_by_key[lead["id"]]["opt_fold"],
+                    dtype=np.float32,
+                )
+                for _, lead in scorable
+            ]
+        )
+        dm_curve = np.stack(
+            [
+                curves.get(ridx, np.zeros(ndm, dtype=np.float32))
+                for ridx, _ in scorable
+            ]
+        )
+        _feats, scores = score_fold_products(
+            model, prof, subints, dm_curve, batch=cfg.score_batch
+        )
+        for (ridx, _), p, curve in zip(scorable, scores, dm_curve):
+            row = catalogue_rows[ridx]
+            row["score"] = round(float(p), 6)
+            row["score_tier"] = score_tier(float(p))
+            row["model_fp"] = model.fingerprint
+            if row.get("fold") is not None:
+                row["fold"]["dm_curve"] = [
+                    round(float(v), 3) for v in curve
+                ]
+        log.info(
+            "scored %d/%d catalogue rows (model %s)",
+            len(scorable), len(catalogue_rows), model.fingerprint,
+        )
+        return len(scorable)
+
     # --- the run -------------------------------------------------------
     def run(self) -> dict:
         cfg = self.cfg
@@ -204,6 +336,27 @@ class SiftRun:
             watermark_rowid = db.max_observation_rowid()
             periodicity = db.all_candidates("periodicity")
             single_pulse = db.all_candidates("single_pulse")
+            if cfg.tenant:
+                # per-tenant slice: only observations stamped with this
+                # tenant (and their candidates) enter the sift
+                keep = {
+                    o["job_id"]
+                    for o in obs_rows
+                    if (o.get("tenant") or "") == cfg.tenant
+                }
+                obs_rows = [o for o in obs_rows if o["job_id"] in keep]
+                periodicity = [
+                    c for c in periodicity if c["job_id"] in keep
+                ]
+                single_pulse = [
+                    c for c in single_pulse if c["job_id"] in keep
+                ]
+                tel.event(
+                    "sift_tenant_filter", tenant=cfg.tenant,
+                    observations=len(obs_rows),
+                    periodicity=len(periodicity),
+                    single_pulse=len(single_pulse),
+                )
             self._mark(
                 "loaded", observations=len(obs_rows),
                 periodicity=len(periodicity),
@@ -305,15 +458,19 @@ class SiftRun:
                         "id": c["id"], "job_id": c["job_id"],
                         "period": c["eff_period"], "dm": c["dm"],
                         "snr": c["snr"],
+                        "src_raj": c.get("src_raj"),
+                        "src_dej": c.get("src_dej"),
                     }
                     for c in periodicity
                 ],
                 max_harm=cfg.dedup_max_harm,
                 period_tol=cfg.dedup_period_tol,
                 dm_tol=cfg.dedup_dm_tol,
+                pos_tol_deg=cfg.dedup_pos_tol_deg,
             )
             by_id = {c["id"]: c for c in periodicity}
             catalogue_rows: list[dict] = []
+            row_leads: list[tuple[int, dict]] = []
             for g in groups:
                 lead = by_id[g["leader"]["id"]]
                 member_matches = [
@@ -375,12 +532,30 @@ class SiftRun:
                         ),
                     }
                 )
+                row_leads.append((len(catalogue_rows) - 1, lead))
             tel.add_timer("sift_dedup", time.perf_counter() - t0)
             tel.event(
                 "sift_dedup", groups=len(groups),
                 candidates=len(periodicity),
             )
             self._mark("deduped", catalogue=len(catalogue_rows))
+
+            # --- candidate ranking -------------------------------------
+            if cfg.score and catalogue_rows:
+                tel.set_stage("scoring")
+                self._mark("scoring")
+                t0 = time.perf_counter()
+                n_scored = self._score_catalogue(
+                    catalogue_rows, row_leads, outcomes_by_key, obs_rows
+                )
+                tel.add_timer(
+                    "sift_scoring", time.perf_counter() - t0
+                )
+                tel.event(
+                    "sift_scored", scored=n_scored,
+                    catalogue=len(catalogue_rows),
+                )
+                self._mark("scored", scored=n_scored)
 
             # --- repeat single-pulse association -----------------------
             tel.set_stage("repeats")
@@ -393,6 +568,7 @@ class SiftRun:
                 min_period=cfg.sp_min_period,
                 max_harm=cfg.sp_max_harm,
                 phase_tol=cfg.sp_phase_tol,
+                pos_tol_deg=cfg.sp_pos_tol_deg,
             )
             for s in sp_sources:
                 s.pop("member_ids", None)

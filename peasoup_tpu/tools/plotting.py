@@ -339,16 +339,24 @@ def bowtie_from_singlepulse(path: str, **kw) -> str:
     )
 
 
-def bowtie_from_db(db_path: str, job_id: str | None = None, **kw) -> str:
+def bowtie_from_db(
+    db_path: str,
+    job_id: str | None = None,
+    tenant: str | None = None,
+    **kw,
+) -> str:
     """Bowtie SVG over a campaign database's single-pulse candidates
-    (optionally one job's), with per-observation time offsets from
-    tstart so a multi-observation campaign lays out on one axis."""
+    (optionally one job's, or one tenant's observations), with
+    per-observation time offsets from tstart so a multi-observation
+    campaign lays out on one axis."""
     from ..campaign.db import CandidateDB
 
     with CandidateDB(db_path) as db:
         rows = db.all_candidates(kind="single_pulse")
     if job_id is not None:
         rows = [r for r in rows if r.get("job_id") == job_id]
+    if tenant is not None:
+        rows = [r for r in rows if (r.get("tenant") or "") == tenant]
     if rows:
         t0_mjd = min(float(r.get("obs_tstart") or 0.0) for r in rows)
     times, dms, snrs, widths = [], [], [], []
@@ -358,8 +366,10 @@ def bowtie_from_db(db_path: str, job_id: str | None = None, **kw) -> str:
         dms.append(float(r.get("dm") or 0.0))
         snrs.append(float(r.get("snr") or 0.0))
         widths.append(int(r.get("width") or 0))
-    title = "DM-time bowtie — campaign DB" + (
-        f" [{job_id}]" if job_id else ""
+    title = (
+        "DM-time bowtie — campaign DB"
+        + (f" [{job_id}]" if job_id else "")
+        + (f" [tenant {tenant}]" if tenant else "")
     )
     return render_bowtie_svg(
         times, dms, snrs, widths=widths, title=title, **kw

@@ -1,0 +1,591 @@
+"""Candidate-ranking tests: batched feature extraction (bitwise
+determinism, batch-size invariance, OOM-halving invariance, zero
+steady-state recompiles), deterministic training + isotonic
+calibration, model-artifact validation and fingerprinting, the
+v3->v4 schema migration, the sky-position association gate, the
+held-out ROC gate, and the end-to-end scored sift (DB columns,
+report tiers, portal triage page, `peasoup-rank` CLI).
+"""
+
+import json
+import os
+import sqlite3
+
+import numpy as np
+import pytest
+
+from peasoup_tpu.campaign.db import (
+    _SCHEMA_V1,
+    SCHEMA_VERSION,
+    CandidateDB,
+    SchemaVersionError,
+)
+from peasoup_tpu.io.sigproc import (
+    Filterbank,
+    SigprocHeader,
+    write_filterbank,
+)
+from peasoup_tpu.obs.telemetry import RunTelemetry
+from peasoup_tpu.ops.candidate_features import (
+    DM_CURVE_POINTS,
+    FEATURE_NAMES,
+    NFEATURES,
+)
+from peasoup_tpu.rank.model import (
+    DEFAULT_MODEL_PATH,
+    SCORE_TIER1,
+    SCORE_TIER2,
+    RankModel,
+    model_fingerprint,
+    score_tier,
+)
+from peasoup_tpu.rank.score import (
+    extract_features,
+    neutral_dm_curve,
+    score_fold_products,
+)
+from peasoup_tpu.rank.train import (
+    evaluate_model,
+    isotonic_calibration,
+    roc_auc,
+    synth_fold_products,
+    train_model,
+)
+from peasoup_tpu.resilience import faults
+from peasoup_tpu.resilience.stats import STATS
+from peasoup_tpu.sift.dedup import (
+    dedup_candidates,
+    packed_position_deg,
+    position_gate_ok,
+    sky_separation_deg,
+)
+from peasoup_tpu.sift.repeats import repeat_sources
+from peasoup_tpu.sift.service import SiftConfig, SiftRun
+
+P0 = 0.714519699726  # J0332+5434 (B0329+54)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.configure(None)
+    STATS.reset()
+    yield
+    faults.configure(None)
+    STATS.reset()
+
+
+def _products(n=13, seed=3):
+    prof, subints, dm_curve, labels, kinds = synth_fold_products(n, seed)
+    return prof, subints, dm_curve
+
+
+# --------------------------------------------------------------------------
+# batched feature extraction
+# --------------------------------------------------------------------------
+
+class TestFeatureExtraction:
+    def test_shapes_finite_and_bitwise_deterministic(self):
+        prof, subints, dmc = _products()
+        a = extract_features(prof, subints, dmc, batch=8)
+        b = extract_features(prof, subints, dmc, batch=8)
+        assert a.shape == (13, NFEATURES)
+        assert a.dtype == np.float32
+        assert np.all(np.isfinite(a))
+        assert np.array_equal(a, b)
+
+    def test_batch_size_invariance(self):
+        """ISSUE satellite: feature rows are independent, so any batch
+        width (padded by recycling rows) is bitwise-identical."""
+        prof, subints, dmc = _products()
+        want = extract_features(prof, subints, dmc, batch=64)
+        for batch in (1, 5, 13):
+            got = extract_features(prof, subints, dmc, batch=batch)
+            assert np.array_equal(got, want), f"batch={batch}"
+
+    def test_bitwise_equal_under_device_oom(self):
+        """ISSUE satellite: an injected device.oom halves the batch
+        (rank.features DegradationLadder rung) and the feature matrix
+        stays bitwise-equal to the fault-free run."""
+        prof, subints, dmc = _products()
+        want = extract_features(prof, subints, dmc, batch=8)
+        faults.configure("device.oom:at=1")
+        tel = RunTelemetry()
+        with tel.activate():
+            got = extract_features(prof, subints, dmc, batch=8)
+        degs = [e for e in tel.events if e["kind"] == "degradation"]
+        assert degs and degs[0]["ladder"] == "rank.features"
+        assert degs[0]["rung"] == "batch_shrink"
+        assert np.array_equal(got, want)
+
+    def test_oom_exhaustion_raises_at_batch_one(self):
+        prof, subints, dmc = _products(n=3)
+        faults.configure("device.oom:n=99")
+        with pytest.raises(Exception, match="RESOURCE_EXHAUSTED"):
+            extract_features(prof, subints, dmc, batch=2)
+
+    def test_empty_input(self):
+        feats = extract_features(
+            np.empty((0, 64), np.float32),
+            np.empty((0, 16, 64), np.float32),
+            neutral_dm_curve(0),
+        )
+        assert feats.shape == (0, NFEATURES)
+
+    def test_zero_steady_state_recompiles(self):
+        """ISSUE satellite: warm same-width batches reuse ONE compiled
+        feature program and ONE compiled scorer apply — the compile
+        counter stays at zero after the first batch."""
+        from peasoup_tpu.campaign.runner import jit_programs_compiled
+
+        model = RankModel.from_file()
+        prof, subints, dmc = _products(n=9, seed=5)
+        score_fold_products(model, prof, subints, dmc, batch=8)  # warm
+        tel = RunTelemetry()
+        with tel.activate():
+            for seed in (6, 7):
+                p, s, d = _products(n=9, seed=seed)
+                feats, scores = score_fold_products(
+                    model, p, s, d, batch=8
+                )
+                assert feats.shape == (9, NFEATURES)
+                assert len(scores) == 9
+        assert jit_programs_compiled(tel) == 0
+
+
+# --------------------------------------------------------------------------
+# training, calibration, the ROC gate
+# --------------------------------------------------------------------------
+
+class TestTraining:
+    def test_train_deterministic_from_seed(self):
+        """ISSUE satellite: same seed -> identical artifact document,
+        identical fingerprint."""
+        kw = dict(seed=7, n_examples=120, steps=30, hidden=8)
+        a = train_model(**kw)
+        b = train_model(**kw)
+        assert a == b
+        assert a["fingerprint"] == b["fingerprint"]
+
+    def test_roc_auc_reference_points(self):
+        labels = np.array([0.0, 0.0, 1.0, 1.0])
+        assert roc_auc(labels, np.array([0.1, 0.2, 0.8, 0.9])) == 1.0
+        assert roc_auc(labels, np.array([0.9, 0.8, 0.2, 0.1])) == 0.0
+        assert roc_auc(labels, np.array([0.5, 0.5, 0.5, 0.5])) == 0.5
+
+    def test_isotonic_calibration_monotone(self):
+        """ISSUE satellite: the PAV fit is a valid calibration map —
+        strictly increasing x, non-decreasing y, [0, 1] endpoints."""
+        rng = np.random.default_rng(0)
+        raw = rng.uniform(0.0, 1.0, 200)
+        labels = (rng.uniform(0.0, 1.0, 200) < raw).astype(np.float64)
+        xs, ys = isotonic_calibration(raw, labels)
+        assert xs[0] == 0.0 and xs[-1] == 1.0
+        assert all(b > a for a, b in zip(xs, xs[1:]))
+        assert all(b >= a for a, b in zip(ys, ys[1:]))
+        assert ys[0] >= 0.0 and ys[-1] <= 1.0
+
+    def test_shipped_calibration_monotone(self):
+        model = RankModel.from_file()
+        grid = np.linspace(0.0, 1.0, 101)
+        cal = model.calibrate(grid)
+        assert np.all(np.diff(cal) >= 0.0)
+        assert np.all((cal >= 0.0) & (cal <= 1.0))
+
+    def test_shipped_model_passes_roc_gate(self):
+        """ISSUE acceptance: held-out injected ROC AUC >= 0.95 for the
+        checked-in artifact (the CI gate `peasoup-rank eval` holds)."""
+        model = RankModel.from_file()
+        ev = evaluate_model(model, n_examples=240)
+        assert ev["auc"] >= 0.95
+        assert ev["fingerprint"] == model.fingerprint
+        assert ev["pulsar_tier1_frac"] > ev["foil_tier1_frac"]
+        assert ev["median_pulsar_score"] > ev["median_foil_score"]
+
+
+# --------------------------------------------------------------------------
+# model artifact validation
+# --------------------------------------------------------------------------
+
+class TestModelArtifact:
+    def _doc(self):
+        with open(DEFAULT_MODEL_PATH) as f:
+            return json.load(f)
+
+    def test_shipped_artifact_loads_and_fingerprints(self):
+        model = RankModel.from_file()
+        assert model.fingerprint.startswith("sha256:")
+        assert model.fingerprint == model_fingerprint(model.doc)
+        assert model.doc["feature_names"] == list(FEATURE_NAMES)
+
+    def test_tampered_weights_rejected(self):
+        doc = self._doc()
+        doc["w2"][0] = float(doc["w2"][0]) + 0.5
+        with pytest.raises(ValueError, match="fingerprint mismatch"):
+            RankModel(doc)
+
+    def test_non_monotone_calibration_rejected(self):
+        doc = self._doc()
+        doc["calibration"] = {"x": [0.0, 0.5, 1.0], "y": [0.0, 0.8, 0.4]}
+        doc["fingerprint"] = model_fingerprint(doc)
+        with pytest.raises(ValueError, match="not monotone"):
+            RankModel(doc)
+
+    def test_wrong_feature_set_rejected(self):
+        doc = self._doc()
+        doc["feature_names"][0] = "bogus_feature"
+        doc["fingerprint"] = model_fingerprint(doc)
+        with pytest.raises(ValueError, match="different"):
+            RankModel(doc)
+
+    def test_score_tier_mapping(self):
+        assert score_tier(0.99) == 1
+        assert score_tier(SCORE_TIER1) == 1
+        assert score_tier(0.6) == 2
+        assert score_tier(SCORE_TIER2) == 2
+        assert score_tier(0.1) == 3
+
+
+# --------------------------------------------------------------------------
+# schema v4 migration
+# --------------------------------------------------------------------------
+
+class TestDBSchemaV4:
+    def _legacy_v1(self, path: str) -> None:
+        conn = sqlite3.connect(path)
+        conn.executescript(_SCHEMA_V1)
+        conn.execute(
+            "INSERT INTO observations (job_id, input, source_name, "
+            "tstart, tsamp, nchans, nsamps, ingested_unix) VALUES "
+            "('j1', 'a.fil', 'SRC', 55000.0, 2.56e-4, 8, 4096, 0)"
+        )
+        conn.execute(
+            "INSERT INTO candidates (job_id, kind, dm, snr, period) "
+            "VALUES ('j1', 'periodicity', 26.7, 9.0, 0.714)"
+        )
+        conn.commit()
+        conn.close()
+
+    def _sift_columns(self, db):
+        return {
+            r[1]
+            for r in db._conn.execute(
+                "PRAGMA table_info(sift_candidates)"
+            )
+        }
+
+    def test_fresh_db_has_score_columns(self, tmp_path):
+        with CandidateDB(str(tmp_path / "c.sqlite")) as db:
+            assert db.schema_version() == SCHEMA_VERSION
+            assert {"score", "score_tier", "model_fp"} <= (
+                self._sift_columns(db)
+            )
+
+    def test_legacy_migrates_to_v4_idempotent(self, tmp_path):
+        """ISSUE satellite: a pre-ranking DB gains the score columns
+        in place (rows preserved); a second open finds nothing to do."""
+        path = str(tmp_path / "c.sqlite")
+        self._legacy_v1(path)
+        for _ in range(2):
+            with CandidateDB(path) as db:
+                assert db.schema_version() == SCHEMA_VERSION
+                assert {"score", "score_tier", "model_fp"} <= (
+                    self._sift_columns(db)
+                )
+                cands = db.all_candidates("periodicity")
+                assert len(cands) == 1 and cands[0]["dm"] == 26.7
+
+    def test_future_version_refused_loudly(self, tmp_path):
+        path = str(tmp_path / "c.sqlite")
+        self._legacy_v1(path)
+        conn = sqlite3.connect(path)
+        conn.execute(f"PRAGMA user_version = {SCHEMA_VERSION + 7}")
+        conn.commit()
+        conn.close()
+        with pytest.raises(SchemaVersionError, match="newer"):
+            CandidateDB(path)
+
+    def test_update_sift_scores(self, tmp_path):
+        row = {
+            "kind": "periodicity", "label": "candidate", "tier": 2,
+            "dm": 10.0, "snr": 9.0, "period": 0.5, "job_ids": ["j1"],
+        }
+        with CandidateDB(str(tmp_path / "c.sqlite")) as db:
+            db.ingest_sift_run("run1", {}, [row], [], [])
+            [cat] = db.sift_catalogue()
+            assert cat["score"] is None
+            db.update_sift_scores([
+                {"id": cat["id"], "score": 0.91, "score_tier": 1,
+                 "model_fp": "sha256:feedc0de00000000"},
+            ])
+            [cat] = db.sift_catalogue()
+            assert cat["score"] == 0.91
+            assert cat["score_tier"] == 1
+            assert cat["model_fp"] == "sha256:feedc0de00000000"
+
+
+# --------------------------------------------------------------------------
+# sky-position association gate
+# --------------------------------------------------------------------------
+
+class TestSkyPositionGate:
+    def test_packed_position_decodes(self):
+        ra, dec = packed_position_deg(123000.0, -453000.0)
+        assert abs(ra - 187.5) < 1e-9  # 12h30m -> 187.5 deg
+        assert abs(dec - (-45.5)) < 1e-9
+
+    def test_separation_reference_points(self):
+        assert sky_separation_deg(5.0, 5.0, 5.0, 5.0) == 0.0
+        assert abs(sky_separation_deg(0, 0, 180, 0) - 180.0) < 1e-9
+        assert abs(sky_separation_deg(10, 20, 10, 21) - 1.0) < 1e-9
+
+    def test_gate_disabled_or_missing_position_passes(self):
+        a = {"src_raj": 0.0, "src_dej": 0.0}
+        b = {"src_raj": 120000.0, "src_dej": 0.0}  # 180 deg away
+        assert position_gate_ok(a, b, 0.0)  # disabled
+        assert position_gate_ok(a, {"src_raj": None, "src_dej": None}, 1.0)
+        assert position_gate_ok(a, {}, 1.0)
+        assert not position_gate_ok(a, b, 1.0)
+
+    def _row(self, rid, job, period, raj, dej, snr=9.0):
+        return {
+            "id": rid, "job_id": job, "period": period, "dm": 30.0,
+            "snr": snr, "src_raj": raj, "src_dej": dej,
+        }
+
+    def test_dedup_antipodal_harmonic_not_merged(self):
+        """ISSUE satellite: a harmonic coincidence between antipodal
+        pointings stays two catalogue rows under the gate (and still
+        merges with the gate off)."""
+        lead = self._row(1, "j0", P0, 0.0, 0.0, snr=12.0)
+        harm = self._row(2, "j1", P0 / 2, 120000.0, 0.0)
+        gated = dedup_candidates([lead, harm], pos_tol_deg=3.0)
+        assert len(gated) == 2
+        merged = dedup_candidates([lead, harm], pos_tol_deg=0.0)
+        assert len(merged) == 1 and len(merged[0]["members"]) == 2
+
+    def test_dedup_adjacent_beams_still_merge(self):
+        # 0h04m (1 deg RA) and 0d30m (0.5 deg dec) away: ~1.1 deg
+        lead = self._row(1, "j0", P0, 0.0, 0.0, snr=12.0)
+        harm = self._row(2, "j1", P0 / 2, 400.0, 3000.0)
+        [group] = dedup_candidates([lead, harm], pos_tol_deg=3.0)
+        assert len(group["members"]) == 2
+        assert group["n_obs"] == 2
+
+    def test_repeat_sources_position_split(self):
+        """A DM-coincident single-pulse chain from antipodal pointings
+        is not one RRAT: the position split leaves each half below
+        min_obs and the 'source' disappears."""
+        rows = []
+        rid = 0
+        for job, raj, tstart in (
+            ("j0", 0.0, 55000.0), ("j1", 120000.0, 55000.01),
+        ):
+            for k in (1, 3, 7):
+                rows.append({
+                    "id": rid, "job_id": job, "dm": 40.0, "snr": 8.0,
+                    "time_s": 0.05 + k * 0.5, "obs_tstart": tstart,
+                    "src_raj": raj, "src_dej": 0.0,
+                })
+                rid += 1
+        merged = repeat_sources(rows, min_pulses=4, pos_tol_deg=0.0)
+        assert len(merged) == 1 and merged[0]["n_obs"] == 2
+        assert repeat_sources(rows, min_pulses=4, pos_tol_deg=3.0) == []
+
+
+# --------------------------------------------------------------------------
+# end-to-end: the scored sift, report tiers, portal triage, the CLI
+# --------------------------------------------------------------------------
+
+def _seed_campaign(camp):
+    """A 2-observation campaign: the injected pulsar fundamental in
+    obs0, its 1/2 harmonic in obs1, plus one unrelated candidate —
+    both observations stamped tenant 'alice'."""
+    camp.mkdir(exist_ok=True)
+    nsamps, nchans, tsamp = 4096, 8, 0.000256
+    rng = np.random.default_rng(0)
+    with CandidateDB(str(camp / "candidates.sqlite")) as db:
+        conn = db._conn
+        for i in range(2):
+            data = np.clip(
+                np.rint(rng.normal(32.0, 4.0, size=(nsamps, nchans))),
+                0, 255,
+            ).astype(np.uint8)
+            hdr = SigprocHeader(
+                source_name=f"OBS{i}", tsamp=tsamp,
+                tstart=55000.0 + i * 0.01, fch1=1400.0, foff=-16.0,
+                nchans=nchans, nbits=8, nifs=1, data_type=1,
+                ibeam=i + 1,
+            )
+            write_filterbank(
+                str(camp / f"obs{i}.fil"),
+                Filterbank(header=hdr, data=data),
+            )
+            conn.execute(
+                "INSERT INTO observations (job_id, input, source_name,"
+                " tstart, tsamp, nchans, nsamps, ingested_unix, beam,"
+                " src_raj, src_dej, tenant) VALUES "
+                "(?,?,?,?,?,?,?,?,?,?,?,?)",
+                (f"job{i}", str(camp / f"obs{i}.fil"), f"OBS{i}",
+                 55000.0 + i * 0.01, tsamp, nchans, nsamps, 0.0,
+                 i + 1, 0.0, 0.0, "alice"),
+            )
+        conn.execute(
+            "INSERT INTO candidates (job_id, kind, dm, snr, period, "
+            "acc, nh) VALUES ('job0', 'periodicity', 26.76, 12.0, ?, "
+            "0.0, 2)", (P0,),
+        )
+        conn.execute(
+            "INSERT INTO candidates (job_id, kind, dm, snr, period, "
+            "acc, nh) VALUES ('job1', 'periodicity', 26.80, 9.0, ?, "
+            "0.0, 1)", (P0 / 2,),
+        )
+        conn.execute(
+            "INSERT INTO candidates (job_id, kind, dm, snr, period, "
+            "acc, nh) VALUES ('job1', 'periodicity', 80.0, 8.0, "
+            "0.1234, 0.0, 1)"
+        )
+        conn.commit()
+    return camp
+
+
+@pytest.fixture(scope="module")
+def scored_camp(tmp_path_factory):
+    camp = _seed_campaign(tmp_path_factory.mktemp("rankcamp") / "camp")
+    tel = RunTelemetry()
+    with tel.activate():
+        summary = SiftRun(
+            SiftConfig(workdir=str(camp), fold_batch=8)
+        ).run()
+    return camp, summary, list(tel.events)
+
+
+class TestScoredSiftEndToEnd:
+    def test_catalogue_rows_scored(self, scored_camp):
+        """ISSUE acceptance: the sift run scores every folded
+        catalogue row — calibrated probability, tier, and the model
+        fingerprint land in the v4 columns, the DM curve in the fold
+        stamp."""
+        camp, summary, events = scored_camp
+        assert "sift_scored" in [e["kind"] for e in events]
+        with CandidateDB(str(camp / "candidates.sqlite")) as db:
+            cat = db.sift_catalogue()
+            scored = [c for c in cat if c["score"] is not None]
+            assert scored
+            for c in scored:
+                assert 0.0 <= c["score"] <= 1.0
+                assert c["score_tier"] in (1, 2, 3)
+                assert c["model_fp"].startswith("sha256:")
+                fold = json.loads(c["fold_json"])
+                assert len(fold["dm_curve"]) == DM_CURVE_POINTS
+            # one model scored the whole catalogue
+            assert len({c["model_fp"] for c in scored}) == 1
+
+    def test_report_carries_score_tiers(self, scored_camp, tmp_path):
+        from peasoup_tpu.sift.report import (
+            build_report,
+            render_html,
+            write_report,
+        )
+
+        camp, _, _ = scored_camp
+        with CandidateDB(str(camp / "candidates.sqlite")) as db:
+            doc = build_report(db)
+        assert doc["model_fp"] and doc["model_fp"].startswith("sha256:")
+        assert sum(doc["score_tiers"].values()) >= 1
+        html = render_html(doc)
+        assert "s-tier" in html and doc["model_fp"] in html
+        # the document stays schema-valid with the new fields
+        write_report(
+            doc, str(tmp_path / "r.json"), str(tmp_path / "r.html")
+        )
+
+    def test_report_tenant_view(self, scored_camp):
+        from peasoup_tpu.sift.report import build_report
+
+        camp, _, _ = scored_camp
+        with CandidateDB(str(camp / "candidates.sqlite")) as db:
+            alice = build_report(db, tenant="alice")
+            ghost = build_report(db, tenant="nosuch")
+        assert alice["tenant"] == "alice"
+        assert alice["observations"] == 2
+        assert len(alice["catalogue"]) >= 1
+        assert ghost["observations"] == 0
+        assert ghost["catalogue"] == []
+
+    def test_portal_candidate_triage_page(self, scored_camp, tmp_path):
+        from peasoup_tpu.obs.portal import _candidates_body
+
+        camp, _, _ = scored_camp
+        body = _candidates_body(str(camp))
+        assert body is not None
+        text = body.decode()
+        assert "sha256:" in text and "tier" in text
+        # the tenant-scoped view renders the same rows for the
+        # stamping tenant; a bad tenant name or missing DB 404s (None)
+        assert _candidates_body(str(camp), tenant="alice") is not None
+        assert _candidates_body(str(camp), tenant="../evil") is None
+        assert _candidates_body(str(tmp_path)) is None
+
+    def test_rank_score_cli_rescored_in_place(self, scored_camp):
+        """`peasoup-rank score` re-scores the sifted DB from stored
+        fold products alone (no raw data touched)."""
+        from peasoup_tpu.cli.rank import main
+
+        camp, _, _ = scored_camp
+        assert main(["score", "-w", str(camp)]) == 0
+        with CandidateDB(str(camp / "candidates.sqlite")) as db:
+            cat = db.sift_catalogue()
+            rescored = [c for c in cat if c.get("fold_json")]
+            assert rescored
+            assert all(c["score"] is not None for c in rescored)
+
+    def test_tenant_scoped_sift_run(self, tmp_path):
+        camp = _seed_campaign(tmp_path / "camp")
+        conn = sqlite3.connect(str(camp / "candidates.sqlite"))
+        conn.execute(
+            "UPDATE observations SET tenant = 'bob' "
+            "WHERE job_id = 'job1'"
+        )
+        conn.commit()
+        conn.close()
+        summary = SiftRun(
+            SiftConfig(workdir=str(camp), fold=False, tenant="alice")
+        ).run()
+        assert summary["observations"] == 1
+        with CandidateDB(str(camp / "candidates.sqlite")) as db:
+            cat = db.sift_catalogue()
+            assert cat
+            for c in cat:
+                assert json.loads(c["job_ids"]) == ["job0"]
+
+
+class TestRankCLI:
+    def test_train_writes_loadable_artifact(self, tmp_path):
+        from peasoup_tpu.cli.rank import main
+
+        out = str(tmp_path / "m.json")
+        rc = main([
+            "train", "-o", out, "--seed", "3", "--examples", "120",
+            "--steps", "30", "--hidden", "8",
+        ])
+        assert rc == 0
+        model = RankModel.from_file(out)
+        assert model.doc["seed"] == 3
+        assert model.fingerprint == model_fingerprint(model.doc)
+
+    def test_eval_gate_exit_codes(self, tmp_path):
+        """ISSUE acceptance: `peasoup-rank eval` exits 0 at the CI
+        threshold and 2 below an unreachable one."""
+        from peasoup_tpu.cli.rank import main
+
+        out = str(tmp_path / "eval.json")
+        assert main([
+            "eval", "--examples", "160", "--min-auc", "0.95",
+            "--json", out,
+        ]) == 0
+        with open(out) as f:
+            ev = json.load(f)
+        assert ev["auc"] >= 0.95
+        assert main([
+            "eval", "--examples", "160", "--min-auc", "1.01",
+        ]) == 2
