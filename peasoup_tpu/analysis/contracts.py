@@ -352,9 +352,13 @@ def ladder_shape_ctxs(rung: int, overrides: dict | None = None) -> list:
     ov = dict(LADDER_OVERRIDES if overrides is None else overrides)
     ctx_sp = shape_ctx_for_bucket(bucket, "spsearch", ov)
     ctx_search = shape_ctx_for_bucket(bucket, "search", ov)
+    ctx_fdas = shape_ctx_for_bucket(bucket, "fdas", ov)
     return [
         ctx_sp,
         ctx_search,
+        # FDAS correlation geometry: the fdas hooks decline every ctx
+        # without a template batch, so they cover via this variant
+        ctx_fdas,
         # streaming geometry: the chunk program's hook declines batch
         # ctxs, so give it the CLI-default chunk at this rung's plan
         replace(ctx_sp, stream_chunk=1024),

@@ -57,7 +57,7 @@ log = get_logger("campaign.runner")
 CAMPAIGN_CONFIG = "campaign.json"
 CAMPAIGN_CONFIG_SCHEMA = "peasoup_tpu.campaign"
 
-PIPELINES = ("search", "spsearch", "ffa")
+PIPELINES = ("search", "spsearch", "ffa", "fdas")
 
 
 def _safe_name(s: str) -> str:
@@ -314,10 +314,12 @@ def enqueue_entries(
                 f"unknown pipeline {job.pipeline!r} for {inp} "
                 f"(expected one of {PIPELINES})"
             )
-        if job.nprocs > 1 and job.pipeline not in ("search", "spsearch"):
+        if job.nprocs > 1 and job.pipeline not in (
+            "search", "spsearch", "fdas"
+        ):
             raise ValueError(
                 f"gang scheduling (nprocs={job.nprocs}) is supported "
-                f"for the search/spsearch pipelines only, not "
+                f"for the search/spsearch/fdas pipelines only, not "
                 f"{job.pipeline!r} ({inp})"
             )
         added += bool(queue.add_job(job))
@@ -448,8 +450,8 @@ def run_observation(
 
     plan_doc = None
     # the dedispersion planner knows the search/spsearch drivers only;
-    # FFA jobs keep their manual knobs
-    if tuning_cache and job.bucket and job.pipeline != "ffa":
+    # FFA/FDAS jobs keep their manual knobs
+    if tuning_cache and job.bucket and job.pipeline not in ("ffa", "fdas"):
         # resolve AFTER the warmer join: the warmer tuned a cold bucket
         # on its thread and persisted the plan, so this is a pure cache
         # hit (zero measurements) for it and for every later job
@@ -525,6 +527,41 @@ def run_observation(
             stats.add_dm_list(result.dm_list)
             stats.add_device_info()
             stats.add_ffa_section(cfg, job.input, result.candidates)
+            stats.add_timing_info(result.timers)
+            stats.to_file(os.path.join(outdir, "overview.xml"))
+        n_cands = len(result.candidates)
+    elif job.pipeline == "fdas":
+        from ..io.output import write_fdas_candidates
+        from ..pipeline.fdas import FdasConfig, FdasSearch
+
+        cfg = _build_config(
+            FdasConfig, overrides, outdir=outdir,
+            checkpoint_file=os.path.join(outdir, "search.ckpt.npz"),
+        )
+        if comm is not None:
+            from ..parallel.multihost import run_fdas_search
+
+            result = run_fdas_search(fil, cfg, comm=comm)
+        else:
+            result = FdasSearch(cfg).run(fil)
+        result.timers["reading"] = reading
+        tel.merge_timers(result.timers)
+        if write_outputs:
+            tel.set_stage("writing")
+            writer = CandidateFileWriter(outdir)
+            writer.write_binary(result.candidates, "candidates.peasoup")
+            write_fdas_candidates(
+                os.path.join(outdir, "candidates.fdas"), result.candidates
+            )
+            stats = OutputFileWriter()
+            stats.add_misc_info()
+            stats.add_header(fil.header)
+            stats.add_fdas_section(cfg, result.zs, result.ws)
+            stats.add_dm_list(result.dm_list)
+            stats.add_device_info()
+            stats.add_candidates_fdas(
+                result.candidates, writer.byte_mapping
+            )
             stats.add_timing_info(result.timers)
             stats.to_file(os.path.join(outdir, "overview.xml"))
         n_cands = len(result.candidates)
@@ -657,7 +694,7 @@ class _BucketWarmer(threading.Thread):
 
         bucket, pipeline, overrides, scratch_dir, mode = self._args
         tuning = None
-        if self._tuning_cache and pipeline != "ffa":
+        if self._tuning_cache and pipeline not in ("ffa", "fdas"):
             try:
                 from ..perf.tuning import resolve_plan_for_bucket
 

@@ -340,27 +340,32 @@ def build_parser() -> argparse.ArgumentParser:
     te = sub.add_parser(
         "tenant", help="manage the multi-tenant registry "
         "(queue/tenants/<name>.json): add mints a bearer token, list "
-        "shows quotas and live throttle state",
+        "shows quotas and live throttle state, rotate-token mints a "
+        "replacement secret (the old token is rejected immediately), "
+        "set-quota edits only the quota flags given — both admin "
+        "actions are journaled to queue/submissions.jsonl",
     )
     te.add_argument("-w", "--workdir", required=True)
-    te.add_argument("action", choices=["add", "list", "show", "remove"])
+    te.add_argument("action", choices=["add", "list", "show", "remove",
+                                       "rotate-token", "set-quota"])
     te.add_argument("name", nargs="?", default="",
-                    help="tenant name (add/show/remove)")
+                    help="tenant name (all actions except list)")
     te.add_argument("--token", default="",
                     help="bearer token (default: minted)")
-    te.add_argument("--max-queued", type=int, default=0,
+    te.add_argument("--max-queued", type=int, default=None,
                     help="max non-terminal jobs (0 = unlimited)")
-    te.add_argument("--max-running", type=int, default=0,
+    te.add_argument("--max-running", type=int, default=None,
                     help="max concurrent running jobs (0 = unlimited)")
-    te.add_argument("--device-seconds", type=float, default=0.0,
+    te.add_argument("--device-seconds", type=float, default=None,
                     help="device-seconds budget per rolling window "
                     "(0 = unlimited)")
-    te.add_argument("--window-s", type=float, default=3600.0,
+    te.add_argument("--window-s", type=float, default=None,
                     help="rolling budget window (default 3600)")
     te.add_argument("--priority-max", type=int, default=None,
                     help="priority ceiling; higher submissions are "
-                    "clamped (default: none)")
-    te.add_argument("--watch-dir", default="",
+                    "clamped (default: none; set-quota: -1 clears "
+                    "the ceiling)")
+    te.add_argument("--watch-dir", default=None,
                     help="folder polled by `ingest-folder`; dropped "
                     ".fil/.fbk files are auto-submitted")
 
@@ -915,11 +920,33 @@ def _cmd_sentinel(args) -> int:
     return 0
 
 
+def _tenant_audit(workdir: str, action: str, tenant: str, **extra) -> None:
+    """Journal a tenant admin action to queue/submissions.jsonl — the
+    same append-only audit trail as submissions, so `who changed what
+    when` reads off one file. Secrets never land in the journal: token
+    rotation records only a correlation suffix."""
+    import time as _time
+
+    from ..campaign.ingest import append_submission
+
+    entry = {
+        "t_unix": round(_time.time(), 3),
+        "via": "cli",
+        "kind": "tenant_admin",
+        "action": action,
+        "tenant": tenant,
+    }
+    entry.update(extra)
+    append_submission(workdir, entry)
+
+
 def _cmd_tenant(args) -> int:
+    import dataclasses
+
     from ..campaign.tenants import Tenant, TenantRegistry, throttle_map
 
     reg = TenantRegistry(args.workdir)
-    if args.action in ("add", "show", "remove") and not args.name:
+    if args.action != "list" and not args.name:
         print(f"tenant {args.action}: a tenant name is required",
               file=sys.stderr)
         return 2
@@ -928,12 +955,14 @@ def _cmd_tenant(args) -> int:
             t = reg.create(Tenant(
                 name=args.name,
                 token=args.token,
-                max_queued=args.max_queued,
-                max_running=args.max_running,
-                device_seconds=args.device_seconds,
-                window_s=args.window_s,
+                max_queued=args.max_queued or 0,
+                max_running=args.max_running or 0,
+                device_seconds=args.device_seconds or 0.0,
+                window_s=(
+                    3600.0 if args.window_s is None else args.window_s
+                ),
                 priority_max=args.priority_max,
-                watch_dir=args.watch_dir,
+                watch_dir=args.watch_dir or "",
             ))
         except FileExistsError:
             print(f"tenant add: {args.name!r} already exists",
@@ -943,6 +972,57 @@ def _cmd_tenant(args) -> int:
             print(f"tenant add: {exc}", file=sys.stderr)
             return 2
         print(f"tenant {t.name} created; token: {t.token}")
+        return 0
+    if args.action == "rotate-token":
+        import uuid
+
+        t = reg.get(args.name)
+        if t is None:
+            print(f"tenant rotate-token: no such tenant {args.name!r}",
+                  file=sys.stderr)
+            return 1
+        new_token = args.token or uuid.uuid4().hex
+        reg.update(dataclasses.replace(t, token=new_token))
+        # the registry record is the single source of truth for
+        # by_token, so the old secret stops authenticating the moment
+        # the atomic rewrite lands
+        _tenant_audit(
+            args.workdir, "rotate-token", t.name,
+            token_suffix=new_token[-6:],
+        )
+        print(f"tenant {t.name} token rotated; new token: {new_token}")
+        print("(the previous token is invalid immediately)")
+        return 0
+    if args.action == "set-quota":
+        t = reg.get(args.name)
+        if t is None:
+            print(f"tenant set-quota: no such tenant {args.name!r}",
+                  file=sys.stderr)
+            return 1
+        changes: dict = {}
+        if args.max_queued is not None:
+            changes["max_queued"] = int(args.max_queued)
+        if args.max_running is not None:
+            changes["max_running"] = int(args.max_running)
+        if args.device_seconds is not None:
+            changes["device_seconds"] = float(args.device_seconds)
+        if args.window_s is not None:
+            changes["window_s"] = float(args.window_s)
+        if args.priority_max is not None:
+            changes["priority_max"] = (
+                None if args.priority_max < 0 else int(args.priority_max)
+            )
+        if args.watch_dir is not None:
+            changes["watch_dir"] = args.watch_dir
+        if not changes:
+            print("tenant set-quota: no quota flags given (nothing to "
+                  "change)", file=sys.stderr)
+            return 2
+        reg.update(dataclasses.replace(t, **changes))
+        _tenant_audit(args.workdir, "set-quota", t.name, changes=changes)
+        print(f"tenant {t.name} quota updated: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(changes.items())
+        ))
         return 0
     if args.action == "remove":
         if reg.remove(args.name):

@@ -2,6 +2,7 @@ from .candidates import (
     Candidate,
     CandidateCollection,
     CANDIDATE_POD_DTYPE,
+    FdasCandidate,
     SinglePulseCandidate,
     SinglePulseCandidateCollection,
 )

@@ -68,6 +68,27 @@ class Candidate:
 
 
 @dataclass
+class FdasCandidate(Candidate):
+    """A periodicity candidate found by the Fourier-domain
+    acceleration search (pipeline/fdas.py), carrying its (f-dot,
+    f-ddot) trial provenance alongside the base fields.
+
+    ``acc`` holds the EQUIVALENT line-of-sight acceleration
+    ``-fdot * c / f`` so every downstream consumer of periodicity
+    candidates (distillers, folding, sift/rank ingest, the campaign
+    DB's ``acc`` column) treats FDAS detections exactly like
+    time-domain resampling ones; fdot/fddot preserve the native
+    Fourier-domain parameters (overview.xml keeps them as extra
+    candidate fields).
+    """
+
+    fdot: float = 0.0  # Hz/s at the detection frequency
+    fddot: float = 0.0  # Hz/s^2 (0 unless the jerk plane is searched)
+    z: float = 0.0  # matched template drift in bins over the obs
+    w: float = 0.0  # matched template curvature in bins
+
+
+@dataclass
 class SinglePulseCandidate:
     """One clustered single-pulse detection in the DM-time plane.
 

@@ -1,0 +1,30 @@
+"""Fourier-domain acceleration search (FDAS).
+
+Template banks over (f-dot, f-ddot) evaluated as batched frequency-
+domain correlations of ONE dereddened spectrum per DM trial — the
+PRESTO-style correlation formulation (arXiv:1912.12807 runs this
+search shape at survey scale) recast as fixed-shape batched array
+programs so the whole (DM block x template batch) tile is a single
+jitted dispatch.
+
+Layout:
+
+- :mod:`peasoup_tpu.fdas.templates` — host-side finite-duration
+  response template-bank generation (f-dot grid from tobs + zmax,
+  optional f-ddot plane for the jerk search) and the shared geometry
+  formulas (template width, overlap-save segment sizing) the driver,
+  the warmup ShapeCtx derivation and the registry hook all use.
+- :mod:`peasoup_tpu.ops.fdas` — the registered jitted correlation
+  program (overlap-save complex multiply + interbin power + harmonic
+  sum + peak compaction, fused in one program).
+- :mod:`peasoup_tpu.pipeline.fdas` — the campaign-dispatchable driver
+  (DMPlan reuse, checkpointing, OOM degradation ladder, telemetry,
+  multihost dealing).
+"""
+
+from .templates import (  # noqa: F401
+    FdasTemplateBank,
+    auto_segment,
+    build_template_bank,
+    template_half_width,
+)

@@ -119,6 +119,27 @@ def write_ffa_candidates(path: str, candidates: Sequence) -> str:
     return path
 
 
+# .fdas column order: periodicity fields plus the Fourier-domain
+# provenance, self-describing like the .singlepulse/.ffa tables
+FDAS_COLUMNS = ("period", "dm", "acc", "fdot", "fddot", "z", "w",
+                "nh", "snr")
+
+
+def write_fdas_candidates(path: str, candidates: Sequence) -> str:
+    """Write FdasCandidates as a whitespace-delimited text table (one
+    row per distilled candidate, sorted as given). ``acc`` is the
+    equivalent line-of-sight acceleration -fdot*c/f."""
+    with open(path, "w", encoding="ascii") as f:
+        f.write("# " + " ".join(FDAS_COLUMNS) + "\n")
+        for c in candidates:
+            f.write(
+                f"{c.period:.12g} {c.dm:.6f} {c.acc:.6f} "
+                f"{c.fdot:.9g} {c.fddot:.9g} {c.z:.3f} {c.w:.3f} "
+                f"{c.nh:d} {c.snr:.4f}\n"
+            )
+    return path
+
+
 class OutputFileWriter:
     def __init__(self):
         self.root = Element("peasoup_search")
@@ -293,6 +314,81 @@ class OutputFileWriter:
             e.append(Element("folded_snr", 0.0))
             e.append(Element("width", int(c.width)))
             e.append(Element("duty_cycle", float(np.float32(c.dc))))
+            cands.append(e)
+
+    def add_fdas_section(self, cfg, zs: Iterable[float],
+                         ws: Iterable[float]) -> None:
+        """The ``<fdas_search>`` element: FDAS search parameters plus
+        the (z, w) template trial ladders. Candidates are written by
+        :meth:`add_candidates` at top level in the periodicity field
+        set (an FdasCandidate's ``acc`` is the equivalent line-of-sight
+        acceleration), extended with per-candidate <fdot>/<fddot> so
+        tools.parsers.OverviewFile and the campaign DB ingest read FDAS
+        jobs through the existing periodicity path while keeping the
+        native Fourier-domain provenance."""
+        sec = self.root.append(Element("fdas_search"))
+        params = sec.append(Element("search_parameters"))
+        params.append(Element("outdir", cfg.outdir))
+        params.append(Element("killfilename", cfg.killfilename))
+        params.append(Element("zapfilename", cfg.zapfilename))
+        params.append(Element("size", cfg.size))
+        params.append(Element("dm_start", float(np.float32(cfg.dm_start))))
+        params.append(Element("dm_end", float(np.float32(cfg.dm_end))))
+        params.append(Element("dm_tol", float(np.float32(cfg.dm_tol))))
+        params.append(
+            Element("dm_pulse_width", float(np.float32(cfg.dm_pulse_width)))
+        )
+        params.append(Element("zmax", float(np.float32(cfg.zmax))))
+        params.append(Element("zstep", float(np.float32(cfg.zstep))))
+        params.append(Element("wmax", float(np.float32(cfg.wmax))))
+        params.append(Element("wstep", float(np.float32(cfg.wstep))))
+        params.append(Element("nharmonics", cfg.nharmonics))
+        params.append(Element("min_snr", float(np.float32(cfg.min_snr))))
+        params.append(Element("min_freq", float(np.float32(cfg.min_freq))))
+        params.append(Element("max_freq", float(np.float32(cfg.max_freq))))
+        params.append(Element("max_harm", cfg.max_harm))
+        params.append(Element("freq_tol", float(np.float32(cfg.freq_tol))))
+        ztr = sec.append(Element("fdot_trials"))
+        zs = [float(z) for z in zs]
+        ztr.add_attribute("count", len(zs))
+        ztr.add_attribute("unit", "bins")
+        for ii, z in enumerate(zs):
+            t = Element("trial", z)
+            t.add_attribute("id", ii)
+            ztr.append(t)
+        wtr = sec.append(Element("fddot_trials"))
+        ws = [float(w) for w in ws]
+        wtr.add_attribute("count", len(ws))
+        wtr.add_attribute("unit", "bins")
+        for ii, w in enumerate(ws):
+            t = Element("trial", w)
+            t.add_attribute("id", ii)
+            wtr.append(t)
+
+    def add_candidates_fdas(
+        self, candidates: Sequence[Candidate], byte_map: dict[int, int]
+    ) -> None:
+        """Top-level <candidates> in the periodicity layout plus the
+        FDAS provenance extras (fdot Hz/s, fddot Hz/s^2, z/w in bins);
+        name-based parsers skip unknown children, so everything that
+        reads add_candidates output reads this too."""
+        cands = self.root.append(Element("candidates"))
+        for ii, c in enumerate(candidates):
+            e = Element("candidate")
+            e.add_attribute("id", ii)
+            e.append(Element("period", 1.0 / c.freq if c.freq else float("inf")))
+            e.append(Element("opt_period", c.opt_period))
+            e.append(Element("dm", float(np.float32(c.dm))))
+            e.append(Element("acc", float(np.float32(c.acc))))
+            e.append(Element("nh", c.nh))
+            e.append(Element("snr", float(np.float32(c.snr))))
+            e.append(Element("folded_snr", float(np.float32(c.folded_snr))))
+            e.append(Element("fdot", float(np.float32(getattr(c, "fdot", 0.0)))))
+            e.append(Element("fddot", float(np.float32(getattr(c, "fddot", 0.0)))))
+            e.append(Element("z", float(np.float32(getattr(c, "z", 0.0)))))
+            e.append(Element("w", float(np.float32(getattr(c, "w", 0.0)))))
+            e.append(Element("nassoc", c.count_assoc()))
+            e.append(Element("byte_offset", byte_map.get(ii, 0)))
             cands.append(e)
 
     def add_single_pulse_section(

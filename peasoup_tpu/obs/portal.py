@@ -245,7 +245,10 @@ def _tenant_page_body(root: str, name: str) -> bytes | None:
     from ..campaign.ingest import read_submissions
 
     subs = [
-        s for s in read_submissions(root) if s.get("tenant") == name
+        s for s in read_submissions(root)
+        # the journal also carries tenant_admin audit entries (token
+        # rotation, quota edits) — not submissions, so not listed here
+        if s.get("tenant") == name and s.get("kind") != "tenant_admin"
     ][-20:]
     sub_lines = "".join(
         f"<li>{html.escape(str(s.get('input', '')))} via "

@@ -51,6 +51,7 @@ _PROGRAM_MODULES = (
     "peasoup_tpu.ops.coincidence",
     "peasoup_tpu.ops.correlate",
     "peasoup_tpu.ops.candidate_features",
+    "peasoup_tpu.ops.fdas",
 )
 
 
@@ -127,6 +128,14 @@ class ShapeCtx:
     fold_nsamps: int = 0
     fold_nbins: int = 64
     fold_nints: int = 16
+    # FDAS correlation-search geometry (pipeline "fdas" buckets,
+    # derived in perf.warmup.shape_ctx_for_bucket from the bucket's
+    # fft_size + the zmax knob): template rows per device dispatch,
+    # the f-dot grid half-extent in bins, and the overlap-save segment
+    # length. 0 templates = not an FDAS ctx, so the fdas hook declines
+    fdas_templates: int = 0
+    fdas_zmax: int = 0
+    fdas_segment: int = 0
 
 
 @dataclass(frozen=True)
@@ -195,6 +204,8 @@ REGISTRY_ALIASES = {
     "ops.candidate_features.make_score_apply_fn": (
         "ops.candidate_features.score_apply"
     ),
+    "ops.fdas.make_fdas_search_fn": "ops.fdas.fdas_correlate_search",
+    "ops.fdas._correlate_bank_jit": "ops.fdas.correlate_bank",
 }
 
 

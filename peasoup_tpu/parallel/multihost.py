@@ -435,6 +435,76 @@ def run_search(fil, config, comm: "GangComm | None" = None):
     return search.finalize(fil, merged, fold_exchange=fold_exchange)
 
 
+def run_fdas_search(fil, config, comm: "GangComm | None" = None):
+    """Multi-host `peasoup-fdas`: DM-trial data parallelism across
+    processes, mirroring :func:`run_search`. Each process dedisperses +
+    correlation-searches its contiguous slice of the global DM list on
+    its LOCAL chips (the template bank is identical everywhere — it
+    depends only on the (zmax, wmax) geometry), the per-DM distilled
+    candidates (GLOBAL dm_idx) are allgathered, and every process runs
+    the identical global distill/score finalize, so the final list is
+    deterministic on every process; the CLI's rank 0 writes it. With
+    ``comm`` (a gang-scheduled campaign job) the same driver runs over
+    the file-backed exchange. No fold exchange: FDAS does not fold.
+
+    Single-process: exactly FdasSearch(config).run(fil).
+    """
+    import pickle
+
+    from ..pipeline.fdas import FdasSearch, PartialFdasResult
+
+    # topology first: jax.distributed.initialize() must run before
+    # the search constructor touches the backend (device discovery)
+    nproc, rank, gather = _comm_topology(comm)
+    search = FdasSearch(config)
+    if nproc == 1:
+        return search.run(fil)
+
+    plan = search.build_dm_plan(fil)
+    lo, hi = dm_slice_for_process(plan.ndm, nproc, rank)
+    log.info(
+        "multi-host FDAS: process %d/%d owns DM trials [%d, %d) of %d",
+        rank, nproc, lo, hi, plan.ndm,
+    )
+    tel = current_telemetry()
+    tel.set_context(
+        process_index=int(rank),
+        process_count=int(nproc),
+        hostname=socket.gethostname(),
+        dm_slice=[int(lo), int(hi)],
+    )
+    tel.event(
+        "multihost_slice", processes=nproc,
+        process=rank, dm_lo=lo, dm_hi=hi,
+        ndm=int(plan.ndm),
+    )
+    part = search.run(fil, dm_slice=(lo, hi), finalize=False)
+
+    blobs = gather(
+        pickle.dumps((part.cands, part.n_trials)),
+        context="fdas:candidates",
+    )
+    merged_cands, n_trials = [], 0
+    # process order == ascending DM slices
+    for cands, n in _unpickle_all(blobs, context="fdas:candidates"):
+        merged_cands.extend(cands)
+        n_trials += n
+    merged = PartialFdasResult(
+        cands=merged_cands,
+        dm_offset=part.dm_offset,
+        dm_list=plan.dm_list,  # global
+        zs=part.zs,
+        ws=part.ws,
+        timers=part.timers,
+        nsamps=part.nsamps,
+        size=part.size,
+        n_templates=part.n_templates,
+        n_trials=n_trials,
+        t_total_start=part.t_total_start,
+    )
+    return search.finalize(fil, merged)
+
+
 def run_single_pulse_search(fil, config, comm: "GangComm | None" = None):
     """Multi-host `spsearch`: DM-trial data parallelism across
     processes, mirroring :func:`run_search`. Each process dedisperses +

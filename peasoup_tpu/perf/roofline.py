@@ -29,6 +29,7 @@ STAGES = (
     "resample",
     "harmonics",
     "peaks",
+    "fdas",
     "fold",
     "other",
 )
@@ -38,6 +39,9 @@ STAGES = (
 _PROGRAM_STAGE_RULES = (
     ("unpack", "unpack"),
     ("dedisperse", "dedisp"),
+    # before "harmonic"/"correlate": the fused FDAS program contains
+    # both fragments but books as its own MXU-correlation stage
+    ("fdas", "fdas"),
     ("harmonics", "harmonic"),
     ("peaks", "peaks"),
     ("resample", "resample"),

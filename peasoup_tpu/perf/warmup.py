@@ -282,6 +282,10 @@ def shape_ctx_for_bucket(bucket, pipeline: str, overrides: dict):
         from ..pipeline.ffa import FFAConfig
 
         base_cls = FFAConfig
+    elif pipeline == "fdas":
+        from ..pipeline.fdas import FdasConfig
+
+        base_cls = FdasConfig
     cfg = _filtered_config(base_cls, overrides)
     plan = DMPlan.create(
         nsamps=int(nsamps), nchans=int(nchans), tsamp=float(tsamp),
@@ -298,6 +302,7 @@ def shape_ctx_for_bucket(bucket, pipeline: str, overrides: dict):
     max_peaks = 128
     select_smax = 0
     pos5 = pos25 = 0
+    fdas_templates = fdas_zmax = fdas_segment = 0
     if pipeline == "spsearch":
         search = SinglePulseSearch(cfg)
         widths = search.widths_for(plan.out_nsamps)
@@ -362,6 +367,34 @@ def shape_ctx_for_bucket(bucket, pipeline: str, overrides: dict):
         else:
             cells = max(8, int(searcher.MEM_BUDGET / (size_spec_b * 16)))
             dm_block = max(1, min(128, cells // max(1, accel_pad)))
+    elif pipeline == "fdas":
+        import numpy as np
+
+        from ..fdas.templates import bank_geometry, effective_zmax
+        from ..pipeline.fdas import FdasSearch
+        from ..plan.fft_plan import choose_fft_size
+
+        fft_size = choose_fft_size(int(nsamps), cfg.size)
+        nharms = int(cfg.nharmonics)
+        max_peaks = int(cfg.max_peaks)
+        # mirror the driver's f32 bin-width rounding exactly — pos5/
+        # pos25 are STATIC args of the whitening program
+        tobs = float(np.float32(fft_size) * np.float32(tsamp))
+        bin_width = float(np.float32(1.0 / tobs))
+        pos5 = int(cfg.boundary_5_freq / bin_width)
+        pos25 = int(cfg.boundary_25_freq / bin_width)
+        nt, width, seg = bank_geometry(
+            cfg.zmax, cfg.wmax, cfg.zstep, cfg.wstep
+        )
+        fdas_segment = cfg.segment or seg
+        fdas_zmax = int(effective_zmax(cfg.zmax, cfg.wmax))
+        searcher = FdasSearch(cfg)
+        db, tb = searcher._auto_blocks(fft_size // 2 + 1, nt)
+        tb = min(tb, nt)
+        dm_block = min(db, int(plan.ndm))
+        # the per-dispatch template BATCH (the bank is padded to a tb
+        # multiple and dispatched tb rows at a time)
+        fdas_templates = tb
     # survey-fold geometry: the sift layer (peasoup_tpu/sift/fold.py)
     # later batch-folds this bucket's candidates over the SAME
     # dedispersed trial length, so the fold bucket is derivable right
@@ -398,6 +431,9 @@ def shape_ctx_for_bucket(bucket, pipeline: str, overrides: dict):
         select_smax=int(select_smax),
         pos5=int(pos5),
         pos25=int(pos25),
+        fdas_templates=int(fdas_templates),
+        fdas_zmax=int(fdas_zmax),
+        fdas_segment=int(fdas_segment),
         fold_batch=(
             int(overrides.get("fold_batch", 64)) if fold_size else 0
         ),
@@ -535,6 +571,14 @@ def _dryrun_pipeline(pipeline: str, overrides: dict, outdir, fil) -> None:
             checkpoint_file="",
         )
         FFASearch(cfg).run(fil)
+    elif pipeline == "fdas":
+        from ..pipeline.fdas import FdasConfig, FdasSearch
+
+        cfg = _filtered_config(
+            FdasConfig, overrides, outdir=str(outdir),
+            checkpoint_file="",
+        )
+        FdasSearch(cfg).run(fil)
     else:  # "search"
         from ..pipeline.search import PeasoupSearch, SearchConfig
 

@@ -31,6 +31,11 @@ CAND_FIELDS = [
     ("ddm_snr_ratio", "f4"),
     ("nassoc", "i4"),
     ("byte_offset", "i8"),
+    # FDAS extras (io/output.py add_fdas_section); absent elements
+    # parse as 0 via vals.get, so plain periodicity overviews are
+    # unaffected
+    ("fdot", "f4"),
+    ("fddot", "f4"),
 ]
 
 

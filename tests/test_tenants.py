@@ -758,3 +758,156 @@ class TestCrossTenantWarmState:
         assert usage[second]["bytes_read"] == os.path.getsize(
             done[1]["input"]
         )
+
+
+# --------------------------------------------------------------------------
+# tenant admin CLI (ISSUE 19 satellite): rotate-token / set-quota
+# --------------------------------------------------------------------------
+
+class TestTenantAdminCLI:
+    def test_rotate_token_invalidates_old_immediately(
+        self, tmp_path, capsys
+    ):
+        """Token rotation takes effect at the next by_token read: the
+        registry record is the single source of truth, no cache."""
+        from peasoup_tpu.cli.campaign import main
+
+        root = str(tmp_path / "camp")
+        reg = TenantRegistry(root)
+        alice = reg.create(Tenant(name="alice"))
+        old = alice.token
+        assert main(["tenant", "rotate-token", "alice", "-w", root]) == 0
+        out = capsys.readouterr().out
+        assert "token rotated" in out and "invalid immediately" in out
+        fresh = reg.get("alice")
+        assert fresh.token != old
+        assert reg.by_token(old) is None
+        assert reg.by_token(fresh.token).name == "alice"
+        # audited, but the secret never lands in the journal
+        [entry] = [
+            s for s in read_submissions(root)
+            if s.get("kind") == "tenant_admin"
+        ]
+        assert entry["action"] == "rotate-token"
+        assert entry["tenant"] == "alice"
+        assert entry["token_suffix"] == fresh.token[-6:]
+        journal = open(submissions_path(root)).read()
+        assert fresh.token not in journal and old not in journal
+
+    def test_rotate_token_rejected_at_portal(self, tmp_path):
+        """End to end through the HTTP front door: a submission with
+        the pre-rotation bearer token gets 401, the new token works."""
+        import socket
+
+        from peasoup_tpu.cli.campaign import main
+        from peasoup_tpu.obs.portal import serve_portal
+
+        root = str(tmp_path / "camp")
+        reg = TenantRegistry(root)
+        alice = reg.create(Tenant(name="alice"))
+        old = alice.token
+        (tmp_path / "stage").mkdir()
+        obs = _obs_file(tmp_path / "stage")
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        srv = threading.Thread(
+            target=serve_portal, args=(root,),
+            kwargs={
+                "port": port, "max_requests": 4,
+                "data_roots": [str(tmp_path / "stage")],
+            },
+            daemon=True,
+        )
+        srv.start()
+        base = f"http://127.0.0.1:{port}"
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                urllib.request.urlopen(base + "/usage", timeout=2)
+                break
+            except OSError:
+                time.sleep(0.05)
+
+        assert main(["tenant", "rotate-token", "alice", "-w", root]) == 0
+        new = TenantRegistry(root).get("alice").token
+
+        def post(token):
+            req = urllib.request.Request(
+                base + "/submit",
+                data=json.dumps({"input": obs}).encode(),
+                headers={"Authorization": f"Bearer {token}"},
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=5) as resp:
+                    return resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as exc:
+                return exc.code, json.loads(exc.read() or b"{}")
+
+        code, body = post(old)
+        assert code == 401 and "token" in body.get("error", "")
+        code, body = post(new)
+        assert code == 200 and body["accepted"]
+        srv.join(timeout=5)
+
+    def test_set_quota_edits_only_given_flags(self, tmp_path, capsys):
+        from peasoup_tpu.cli.campaign import main
+
+        root = str(tmp_path / "camp")
+        reg = TenantRegistry(root)
+        reg.create(Tenant(
+            name="bob", max_queued=5, max_running=2,
+            device_seconds=100.0, window_s=600.0, priority_max=1,
+        ))
+        assert main([
+            "tenant", "set-quota", "bob", "-w", root,
+            "--max-running", "4",
+        ]) == 0
+        t = reg.get("bob")
+        assert t.max_running == 4
+        # every other quota untouched
+        assert t.max_queued == 5 and t.device_seconds == 100.0
+        assert t.window_s == 600.0 and t.priority_max == 1
+        # -1 clears the priority ceiling
+        assert main([
+            "tenant", "set-quota", "bob", "-w", root,
+            "--priority-max", "-1",
+        ]) == 0
+        assert reg.get("bob").priority_max is None
+        # no flags -> usage error, nothing changed, nothing journaled
+        capsys.readouterr()
+        assert main(["tenant", "set-quota", "bob", "-w", root]) == 2
+        assert "no quota flags" in capsys.readouterr().err
+        audits = [
+            s for s in read_submissions(root)
+            if s.get("kind") == "tenant_admin"
+        ]
+        assert len(audits) == 2
+        assert audits[0]["changes"] == {"max_running": 4}
+
+    def test_admin_actions_require_a_name(self, tmp_path, capsys):
+        from peasoup_tpu.cli.campaign import main
+
+        root = str(tmp_path / "camp")
+        TenantRegistry(root)
+        for action in ("rotate-token", "set-quota", "show", "remove"):
+            assert main(["tenant", action, "-w", root]) == 2
+            assert "name is required" in capsys.readouterr().err
+
+    def test_portal_tenant_page_hides_admin_entries(self, tmp_path):
+        """The tenant page's recent-submissions listing shows real
+        submissions, not the admin audit rows (those carry no job)."""
+        from peasoup_tpu.cli.campaign import main
+        from peasoup_tpu.obs.portal import _tenant_page_body
+
+        root = str(tmp_path / "camp")
+        reg = TenantRegistry(root)
+        reg.create(Tenant(name="alice"))
+        obs = _obs_file(tmp_path)
+        submit_observation(root, "alice", obs)
+        assert main(["tenant", "rotate-token", "alice", "-w", root]) == 0
+        page = _tenant_page_body(root, "alice").decode()
+        assert os.path.basename(obs) in page
+        assert "tenant_admin" not in page and "rotate-token" not in page
