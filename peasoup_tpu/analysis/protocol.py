@@ -1,4 +1,4 @@
-"""Engine 3: concurrency / file-protocol rules (PSP101-PSP106).
+"""Engine 3: concurrency / file-protocol rules (PSP101-PSP107).
 
 The fleet's exactly-once and torn-read guarantees rest on a small set
 of filesystem and threading protocols (campaign/queue.py's module
@@ -39,7 +39,12 @@ _SHARED_MARKERS = (
 )
 # substrings marking a path literal as a private scratch target: the
 # tmp half of the tmp+rename idiom, quarantine/tombstone renames
-_TMP_MARKERS = (".tmp", ".part", ".reap", ".corrupt", ".ckpt.tmp")
+_TMP_MARKERS = (
+    ".tmp", ".part", ".reap", ".corrupt", ".ckpt.tmp",
+    # ownership-dance tombstones: renamed-aside artifacts a single
+    # holder consumes, no longer the shared rendezvous name
+    ".release", ".preempt",
+)
 
 # functions whose RESULT is a private scratch path
 _TMP_SOURCES = ("tempfile.mkstemp", "mkstemp", "tempfile.mktemp")
@@ -687,6 +692,60 @@ class AmbientTelemetryAcrossThread(Rule):
                     )
 
 
+@register_rule
+class SharedArtifactDirectDelete(Rule):
+    """``os.remove``/``os.unlink`` of a live shared protocol artifact.
+
+    The fleet's ownership transfers never delete a shared rendezvous
+    file in place: a holder RENAMES it to a uuid-suffixed tombstone
+    (``.reap.<id>`` / ``.release.<id>``), re-verifies the renamed
+    document, and only then consumes the tombstone — and damaged
+    artifacts are renamed to ``*.corrupt`` for forensics. A direct
+    unlink of the shared path is a blind write: between any read that
+    justified it and the unlink itself, a reaper, renewer, or new
+    claimant may have replaced the file, and the unlink destroys
+    *their* artifact — the read-check-delete race class the mc
+    scenarios (renew_vs_reap, release_vs_reap) exhibit concretely.
+    Classification is the same literal-dataflow walk as PSP101:
+    tombstone/tmp-marked names are sanctioned, shared-marked names
+    (queue/, jobs/, ``*.json``...) are not.
+    """
+
+    id = "PSP107"
+    severity = SEV_ERROR
+    title = "direct delete of a shared artifact path"
+    fix_hint = (
+        "rename the artifact to a uuid-suffixed tombstone "
+        "(*.reap.<id>/*.release.<id>), re-verify the renamed document, "
+        "then consume the tombstone (campaign/queue._take_claim); "
+        "quarantine damaged files to *.corrupt instead of deleting"
+    )
+    paths = ("peasoup_tpu/",)
+    exclude = ("peasoup_tpu/tools/", "peasoup_tpu/cli/")
+
+    _UNLINKERS = ("os.remove", "os.unlink")
+
+    def check(self, ctx: ModuleContext):
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            taint = _PathTaint(fn)
+            for node in ast.walk(fn):
+                if not (
+                    isinstance(node, ast.Call)
+                    and dotted_name(node.func) in self._UNLINKERS
+                    and node.args
+                ):
+                    continue
+                if taint.classify(node.args[0]) == "shared":
+                    yield self.finding(
+                        ctx, node,
+                        "os.unlink of a shared artifact path: transfer "
+                        "ownership by tombstone-rename (and re-verify) "
+                        "instead of deleting in place",
+                    )
+
+
 def protocol_rules() -> tuple[str, ...]:
     """The PSP rule IDs (the runner's engine-3 filter)."""
     return tuple(
@@ -698,5 +757,6 @@ def protocol_rules() -> tuple[str, ...]:
             UnguardedThreadTarget,
             MutationOutsideOwningLock,
             AmbientTelemetryAcrossThread,
+            SharedArtifactDirectDelete,
         )
     )

@@ -1,4 +1,4 @@
-"""Audit orchestration: all four engines, the baseline ratchet, and
+"""Audit orchestration: all five engines, the baseline ratchet, and
 the versioned ``audit.json`` report.
 
 The engines:
@@ -16,6 +16,12 @@ The engines:
    rules over ``ops/pallas`` plus the dynamic registry checks
    (twin/probe cross-reference, interpret-mode lowering, Mosaic where
    the toolchain allows).
+5. **Protocol model checking** (:mod:`.mc`) — the PSM rules: the
+   real queue/registry/tenants/alerts code run against a virtual
+   filesystem under exhaustive interleaving + crash-point
+   exploration, scenario invariants asserted after every complete
+   schedule. Off by default in the Python API (it executes module
+   code, not just reads it); the CLI runs it unless ``--no-mc``.
 
 The report is a machine-readable manifest like the telemetry one:
 versioned, schema-pinned by a checked-in JSON Schema
@@ -35,7 +41,7 @@ from .astlint import lint_path, rule_classes
 from .findings import Baseline, Finding
 
 AUDIT_SCHEMA = "peasoup_tpu.audit"
-AUDIT_VERSION = 2  # v2: kernel engine + bucket-ladder contract sections
+AUDIT_VERSION = 3  # v3: mc engine (interleaving/crash model checking)
 
 AUDIT_SCHEMA_PATH = os.path.join(
     os.path.dirname(__file__), "audit.schema.json"
@@ -73,6 +79,8 @@ class AuditResult:
     ladder_rungs: list[int] = field(default_factory=list)
     ladder_coverage: dict[str, list[int]] = field(default_factory=dict)
     rules: list[str] = field(default_factory=list)
+    mc_scenarios: list[str] = field(default_factory=list)
+    mc: dict = field(default_factory=dict)  # MCReport.to_doc()
 
     @property
     def clean(self) -> bool:
@@ -91,6 +99,7 @@ class AuditResult:
                 "programs_checked": len(self.programs_checked),
                 "kernels_checked": len(self.kernels_checked),
                 "ladder_rungs": len(self.ladder_rungs),
+                "mc_scenarios": len(self.mc_scenarios),
             },
             "rules": sorted(self.rules),
             "programs": sorted(self.programs_checked),
@@ -102,6 +111,7 @@ class AuditResult:
                     for k, v in sorted(self.ladder_coverage.items())
                 },
             },
+            "mc": dict(self.mc),
             "findings": [f.to_json() for f in self.findings],
             "resolved_fingerprints": sorted(self.resolved),
         }
@@ -138,12 +148,19 @@ def run_audit(
     max_const_bytes: int | None = None,
     kernel_specs=None,
     program_specs=None,
+    mc: bool = False,
+    mc_scenarios: list[str] | None = None,
+    mc_budget: int | None = None,
 ) -> AuditResult:
-    """Run the four engines over the repo at ``root`` and apply the
+    """Run the five engines over the repo at ``root`` and apply the
     baseline ratchet. Engine/internal errors propagate (the CLI maps
     them to exit 2); per-file, per-program and per-kernel problems
     become findings. ``kernel_specs``/``program_specs`` override the
-    real registries (tests inject doctored specs)."""
+    real registries (tests inject doctored specs). Engine 5 (``mc``)
+    defaults OFF here — it executes the protocol modules under a
+    scheduler rather than reading source — and ON in the CLI;
+    ``mc_scenarios`` selects a subset by name, ``mc_budget`` caps
+    schedules explored per scenario."""
     result = AuditResult()
     findings: list[Finding] = []
 
@@ -193,6 +210,16 @@ def run_audit(
         krep = audit_kernels(specs=kernel_specs)
         findings.extend(krep.findings)
         result.kernels_checked = krep.kernels
+
+    if mc:
+        from .mc.scenarios import run_mc
+
+        mrep = run_mc(names=mc_scenarios, budget=mc_budget)
+        findings.extend(mrep.findings)
+        result.mc = mrep.to_doc()
+        result.mc_scenarios = [
+            p["name"] for p in mrep.per_scenario
+        ]
 
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     result.findings = findings
@@ -253,5 +280,12 @@ def render_text(result: AuditResult, verbose: bool = False) -> str:
             else ""
         )
         + f", {len(result.kernels_checked)} kernels"
+        + (
+            f", {len(result.mc_scenarios)} mc scenarios "
+            f"({result.mc.get('schedules', 0)} schedules, "
+            f"{result.mc.get('crash_points', 0)} crash points)"
+            if result.mc_scenarios
+            else ""
+        )
     )
     return "\n".join(lines)

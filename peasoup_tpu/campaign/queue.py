@@ -9,8 +9,10 @@ filesystems). Every state transition is an atomic filesystem operation:
   workers enqueueing the same manifest collide harmlessly (first wins).
 - **claim** — ``O_CREAT|O_EXCL`` of ``queue/claims/<id>.json`` carrying
   the worker identity and a lease expiry. Exactly one claimant can win.
-- **renew** — the owner atomically rewrites its claim with a fresh
-  expiry (tmp + ``os.replace``); a live worker never loses its lease.
+- **renew** — the owner republishes its claim with a fresh expiry via
+  the ownership dance (take-verify-recreate, below); a *deposed*
+  owner (reaped, job re-claimed) learns it lost the lease instead of
+  stomping the new owner's claim.
 - **reap** — anyone may reap an EXPIRED claim (a SIGKILLed worker never
   releases). The reaper wins an ``os.rename`` race to a private
   tombstone; the loser gets ``FileNotFoundError`` and walks away. A
@@ -26,6 +28,21 @@ Job records are only ever mutated by the current claim holder (or the
 reap winner), so a tmp + ``os.replace`` rewrite needs no further
 locking. States are derived, not stored: a job is *pending* when it has
 no claim/done/quarantine marker and its backoff has elapsed.
+
+**The ownership dance.** Every holder-side transition (renew,
+complete, fail, release, preempted release, carried-resilience
+rewrite) must first prove it still holds the lease — a worker that
+was reaped while wedged is a *zombie*, and a zombie acting on its
+stale :class:`Claim` used to delete the new owner's claim, overwrite
+its renewed lease, double-charge attempts or double-publish done
+records (all found by the protocol model checker,
+``analysis/mc/``). :meth:`JobQueue._take_claim` serializes this
+against the reaper with the same primitive the reaper uses: rename
+the claim to a private tombstone, re-read, and verify the document
+still names us; on mismatch the rename is undone and the caller
+learns the lease is lost. Done records publish via tmp +
+``os.link`` — all-or-nothing, and a duplicate publication surfaces
+as ``FileExistsError`` instead of a silent overwrite.
 """
 
 from __future__ import annotations
@@ -49,6 +66,8 @@ _JOBS = "jobs"
 _CLAIMS = "claims"
 _DONE = "done"
 _QUARANTINE = "quarantine"
+# per-worker append-only spools for LOST attempts' resilience marks
+_RESILIENCE = "resilience"
 
 
 def _atomic_write_json(path: str, doc: dict) -> None:
@@ -72,6 +91,17 @@ def _read_json(path: str) -> dict | None:
             return json.load(f)
     except (OSError, json.JSONDecodeError):
         return None  # gone, mid-replace, or torn: treat as absent
+
+
+def _discard(path: str) -> None:
+    """Consume a dance artifact (tombstone/tmp) that may already be
+    gone: the orphan sweep ages tombstones out by st_ctime, so a
+    holder stalled long enough mid-dance finds its tombstone swept by
+    a peer — the unlink's outcome is the same either way."""
+    try:
+        os.unlink(path)
+    except FileNotFoundError:
+        pass
 
 
 def job_id_for(input_path: str) -> str:
@@ -204,7 +234,7 @@ class JobQueue:
         self.lease_s = float(lease_s)
         self.max_attempts = int(max_attempts)
         self.backoff_base_s = float(backoff_base_s)
-        for sub in (_JOBS, _CLAIMS, _DONE, _QUARANTINE):
+        for sub in (_JOBS, _CLAIMS, _DONE, _QUARANTINE, _RESILIENCE):
             os.makedirs(os.path.join(self.qdir, sub), exist_ok=True)
         # tenant throttle-map cache: (valid_until_unix, map). The map
         # is an O(jobs + claims + done) artifact scan; state() asks per
@@ -488,10 +518,55 @@ class JobQueue:
                 return claim
         return None
 
-    def renew(self, claim: Claim) -> None:
-        """Extend the holder's lease (atomic rewrite of the claim)."""
+    def _take_claim(self, claim: Claim) -> str | None:
+        """Atomically take our claim file off the namespace iff we
+        still hold the lease. Returns the private tombstone path
+        (caller must consume or restore it), or None when the lease
+        has been lost — the claim was reaped (and possibly re-claimed
+        by a new owner, whose claim must not be touched).
+
+        The verify step re-reads the TOMBSTONE, not the original
+        path: the rename is the serialization point, so whatever
+        document the tombstone holds is exactly what we took. Between
+        the rename and the caller's follow-up the claim path is
+        briefly absent; a racing claimant may win the job in that
+        window (renew's O_EXCL republish then fails and the caller
+        reports the lease lost — safety over liveness)."""
+        doc = _read_json(claim.path)
+        if doc is None or doc.get("worker_id") != claim.worker_id:
+            return None
+        tomb = f"{claim.path}.release.{uuid.uuid4().hex[:8]}"
+        try:
+            os.rename(claim.path, tomb)
+        except OSError:
+            return None  # reaped from under us mid-check
+        fresh = _read_json(tomb)
+        if fresh is None or fresh.get("worker_id") != claim.worker_id:
+            # the document changed between read and rename: a reaper
+            # took the lease and a new owner re-claimed — undo
+            try:
+                os.rename(tomb, claim.path)
+            except OSError:
+                try:
+                    os.unlink(tomb)
+                except FileNotFoundError:
+                    pass
+            return None
+        return tomb
+
+    def renew(self, claim: Claim) -> bool:
+        """Extend the holder's lease. The rewrite is an ownership
+        dance, not a blind replace: take our claim (verified rename
+        to a tombstone), then republish with the fresh expiry via
+        ``O_CREAT|O_EXCL``. Returns False when the lease has been
+        lost — the caller must stop working on the job (a blind
+        ``os.replace`` here used to let a reaped-and-replaced zombie
+        stomp the new owner's claim)."""
+        tomb = self._take_claim(claim)
+        if tomb is None:
+            return False
         claim.expires_unix = time.time() + self.lease_s
-        doc = _read_json(claim.path) or {}
+        doc = _read_json(tomb) or {}
         doc.update(
             {
                 "job_id": claim.job.job_id,
@@ -501,50 +576,102 @@ class JobQueue:
                 "expires_unix": claim.expires_unix,
             }
         )
-        _atomic_write_json(claim.path, doc)
+        try:
+            fd = os.open(
+                claim.path, os.O_CREAT | os.O_EXCL | os.O_WRONLY
+            )
+        except FileExistsError:
+            # a claimant won the job during the absence window: it
+            # owns the lease now; our tombstone is all that is ours
+            _discard(tomb)
+            return False
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+        _discard(tomb)
+        return True
 
     # --- terminal transitions ----------------------------------------
-    def complete(self, claim: Claim, **info) -> None:
-        """Success: write the done record, release the claim."""
-        _atomic_write_json(
-            self._p(_DONE, claim.job.job_id),
-            {
-                "job_id": claim.job.job_id,
-                "input": claim.job.input,
-                "worker_id": claim.worker_id,
-                "finished_unix": time.time(),
-                "attempts": claim.job.attempts + 1,
-                **info,
-            },
+    def complete(self, claim: Claim, **info) -> bool:
+        """Success: publish the done record exactly once, release the
+        claim. Only the LIVE holder may publish — a zombie completer
+        (reaped while wedged, job re-claimed) gets False and must not
+        account the job as done. The record publishes via tmp +
+        ``os.link``: all-or-nothing, never torn, and a duplicate
+        publication surfaces as ``FileExistsError`` (swallowed — the
+        record is there) instead of silently overwriting the first
+        winner's document."""
+        tomb = self._take_claim(claim)
+        if tomb is None:
+            log.warning(
+                "complete of %s by %s ignored: lease lost (reaped)",
+                claim.job.job_id, claim.worker_id,
+            )
+            return False
+        done = self._p(_DONE, claim.job.job_id)
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(done), suffix=".tmp"
         )
-        self._release(claim)
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(
+                    {
+                        "job_id": claim.job.job_id,
+                        "input": claim.job.input,
+                        "worker_id": claim.worker_id,
+                        "finished_unix": time.time(),
+                        "attempts": claim.job.attempts + 1,
+                        **info,
+                    },
+                    f,
+                    indent=2,
+                )
+                f.write("\n")
+            try:
+                os.link(tmp, done)
+            except FileExistsError:
+                pass  # already published — exactly-once holds
+        finally:
+            try:
+                os.unlink(tmp)
+            except FileNotFoundError:
+                pass
+        # a revoke answered by completion is answered
+        self.clear_preempt(claim.job.job_id)
+        _discard(tomb)
+        return True
 
     def fail(self, claim: Claim, error: str) -> str:
         """Failure by the claim holder: one attempt consumed. Returns
-        the resulting state: 'backoff' (will retry) or 'quarantined'."""
+        the resulting state: 'backoff' (will retry), 'quarantined',
+        or 'lost' — the lease was reaped from under us, the reaper
+        already charged the attempt, and charging a second one here
+        (the old behaviour) double-counted the failure."""
+        tomb = self._take_claim(claim)
+        if tomb is None:
+            return "lost"
         state = self._record_failure(claim.job.job_id, error)
-        self._release(claim)
+        self.clear_preempt(claim.job.job_id)
+        _discard(tomb)
         return state
 
     def release(self, claim: Claim) -> None:
         """Voluntary release by the claim holder — a worker leaving the
         fleet cleanly hands its unstarted job back with ZERO attempts
         consumed (a clean leave is elasticity, not a failure; the job
-        is immediately claimable by anyone)."""
-        self._release(claim)
+        is immediately claimable by anyone). Idempotent, and a no-op
+        for a lost lease: a deposed holder must not unlink the new
+        owner's claim or clear its preempt marker (the old blind
+        unlink did both)."""
+        tomb = self._take_claim(claim)
+        if tomb is None:
+            return
+        self.clear_preempt(claim.job.job_id)
+        _discard(tomb)
         log.info(
             "claim on %s released cleanly by %s (no attempt consumed)",
             claim.job.job_id, claim.worker_id,
         )
-
-    def _release(self, claim: Claim) -> None:
-        # any terminal transition clears a pending preempt request too:
-        # a revoke answered by completion (or failure) is answered
-        self.clear_preempt(claim.job.job_id)
-        try:
-            os.unlink(claim.path)
-        except FileNotFoundError:
-            pass  # reaped from under us (lease must have expired)
 
     # --- priority preemption -----------------------------------------
     def _preempt_path(self, job_id: str) -> str:
@@ -612,8 +739,13 @@ class JobQueue:
         tally + the request->release latency (flows into the resumed
         run's done record and the rollup) and keeps its
         ``created_unix`` so :meth:`claim_next` re-claims it at its
-        ORIGINAL queue position. Returns the recorded latency."""
+        ORIGINAL queue position. Returns the recorded latency, or 0.0
+        when the lease was already lost (the grace-deadline reaper
+        beat us to the hand-back and owns the accounting)."""
         now = time.time()
+        tomb = self._take_claim(claim)
+        if tomb is None:
+            return 0.0
         req = self.preempt_request(claim.job.job_id) or {}
         requested = float(
             req.get("requested_unix") or observed_unix or now
@@ -625,7 +757,8 @@ class JobQueue:
             job.preempt_latency_s.append(round(latency, 4))
             _atomic_write_json(self._p(_JOBS, job.job_id), job.to_doc())
             claim.job = job  # the caller sees the updated tallies
-        self._release(claim)  # also clears the preempt request
+        self.clear_preempt(claim.job.job_id)
+        _discard(tomb)
         from ..resilience import STATS
 
         STATS.preemption("released")
@@ -638,26 +771,108 @@ class JobQueue:
 
     def record_carried_resilience(
         self, claim: Claim, delta: dict
-    ) -> None:
+    ) -> bool:
         """Fold a to-be-released attempt's resilience counter deltas
         (resilience/stats.py ``delta_since`` shape: table -> key ->
         count) into the job record, so the resumed run's done record
-        still accounts for every fault this attempt survived. Caller
-        must hold the claim (job records have a single writer); call
-        BEFORE :meth:`release` / :meth:`release_preempted`."""
+        still accounts for every fault this attempt survived. Call
+        BEFORE :meth:`release` / :meth:`release_preempted`. The claim
+        is taken for the duration of the rewrite (and restored after)
+        so the fold cannot race the reaper's own job-record write —
+        the lost-update that used to drop carried counters when a
+        grace-deadline reap overlapped the hand-back. Returns True
+        when the fold landed on the record, False when the lease was
+        lost (the reaper charged the attempt and owns the record)."""
+        if not delta:
+            return True
+        tomb = self._take_claim(claim)
+        if tomb is None:
+            log.warning(
+                "carried-resilience fold for %s dropped: lease lost "
+                "(the reaper owns the job record now)",
+                claim.job.job_id,
+            )
+            return False
+        try:
+            job = self.get_job(claim.job.job_id)
+            if job is not None:
+                for table, kv in delta.items():
+                    if not isinstance(kv, dict):
+                        continue
+                    tgt = job.carried_resilience.setdefault(table, {})
+                    for k, v in kv.items():
+                        tgt[k] = tgt.get(k, 0) + int(v)
+                _atomic_write_json(
+                    self._p(_JOBS, job.job_id), job.to_doc()
+                )
+                claim.job = job  # the caller sees the carried tallies
+        finally:
+            # restore our claim: the dance only serialized the rewrite.
+            # link (not rename) so a claimant that won the job during
+            # the absence window is never overwritten — they keep the
+            # lease and our next holder-side call reports it lost.
+            # OSError also covers the tombstone itself aging out under
+            # a peer's orphan sweep: the lease is simply lost
+            try:
+                os.link(tomb, claim.path)
+            except OSError:
+                pass
+            _discard(tomb)
+        return True
+
+    def record_orphaned_resilience(
+        self, worker_id: str, job_id: str, delta: dict
+    ) -> None:
+        """Spool a LOST attempt's survived-fault counters. A lease
+        reaped from under a live run publishes no done record, and the
+        deposed holder may not touch the job record either (the reaper
+        or a new claimant owns it) — so without this spool every
+        retry/recovery that attempt performed would vanish from the
+        campaign rollup. Each worker appends to its OWN
+        ``queue/resilience/<worker_id>.jsonl`` (single writer, append
+        mode — no shared-state race to lose), and the rollup folds the
+        spooled deltas in beside the done-record ones."""
         if not delta:
             return
-        job = self.get_job(claim.job.job_id)
-        if job is None:
-            return
-        for table, kv in delta.items():
-            if not isinstance(kv, dict):
+        path = os.path.join(
+            self.qdir, _RESILIENCE, f"{worker_id}.jsonl"
+        )
+        rec = {
+            "job_id": job_id,
+            "worker_id": worker_id,
+            "recorded_unix": time.time(),
+            "resilience": delta,
+        }
+        with open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    def orphaned_resilience(self) -> list[dict]:
+        """Every spooled lost-attempt record (see
+        :meth:`record_orphaned_resilience`), campaign-wide. A torn
+        tail line — a worker killed mid-append — is skipped, not
+        fatal."""
+        rdir = os.path.join(self.qdir, _RESILIENCE)
+        out: list[dict] = []
+        try:
+            names = sorted(os.listdir(rdir))
+        except FileNotFoundError:
+            return out
+        for name in names:
+            if not name.endswith(".jsonl"):
                 continue
-            tgt = job.carried_resilience.setdefault(table, {})
-            for k, v in kv.items():
-                tgt[k] = tgt.get(k, 0) + int(v)
-        _atomic_write_json(self._p(_JOBS, job.job_id), job.to_doc())
-        claim.job = job  # the caller sees the carried tallies
+            try:
+                with open(os.path.join(rdir, name)) as f:
+                    lines = f.readlines()
+            except OSError:
+                continue
+            for line in lines:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    out.append(rec)
+        return out
 
     def preemption_wanted(
         self, claim: Claim, now: float | None = None
@@ -797,9 +1012,44 @@ class JobQueue:
                 continue
             path = os.path.join(cdir, name)
             doc = _read_json(path)
-            if doc is None:
-                continue
             job_id = os.path.splitext(name)[0]
+            if doc is None:
+                # TORN claim: its creator was SIGKILLed between the
+                # O_EXCL create and the document publish. It carries
+                # no expiry, so it can never go stale — yet it blocks
+                # every future O_EXCL claim: the job was stuck
+                # forever (found by the mc claim_crash_reap
+                # scenario). Age-gate on st_ctime (rename-proof, and
+                # bumped by the publish) so a mid-write claimant gets
+                # a full lease to finish, then reap with ZERO
+                # attempts charged — the job never ran
+                try:
+                    age = now - os.stat(path).st_ctime
+                except OSError:
+                    continue  # vanished (publish or release race)
+                if age <= self.lease_s:
+                    continue
+                tomb = f"{path}.reap.{uuid.uuid4().hex[:8]}"
+                try:
+                    os.rename(path, tomb)
+                except OSError:
+                    continue  # lost the reap race
+                if _read_json(tomb) is not None:
+                    # published after all (very slow writer): put the
+                    # live claim back, re-judge next sweep
+                    try:
+                        os.rename(tomb, path)
+                    except OSError:
+                        _discard(tomb)
+                    continue
+                _discard(tomb)
+                self.clear_preempt(job_id)
+                reaped.append(job_id)
+                log.warning(
+                    "reaped torn claim on %s (creator died mid-"
+                    "publish; zero attempts charged)", job_id,
+                )
+                continue
             expired = float(doc.get("expires_unix", 0)) < now
             req = self.preempt_request(job_id)
             overdue = req is not None and (
@@ -813,20 +1063,27 @@ class JobQueue:
             except OSError:
                 continue  # lost the reap race
             fresh = _read_json(tomb)
-            if (
+            if fresh is None or (
                 not overdue
-                and fresh
                 and float(fresh.get("expires_unix", 0)) >= now
             ):
-                # the owner renewed between our read and the rename:
-                # restore its claim (if a third party claimed in the
-                # gap the owner has genuinely lost the lease)
+                # our rename caught a renewal, not the expired claim
+                # we read: either the republished document (fresh
+                # lease) or the renewer's O_EXCL file still awaiting
+                # its publish — torn, which is why an unreadable
+                # tombstone here means a LIVE owner, never the dead
+                # one we diagnosed (found by the mc renew_vs_reap
+                # scenario: charging this torn file re-queued a job
+                # whose renewer kept running it). Put the claim back
+                # via link so a claimant that won the job in the gap
+                # is never clobbered, then drop the tombstone name
                 try:
-                    os.rename(tomb, path)
+                    os.link(tomb, path)
                 except OSError:
-                    os.unlink(tomb)
+                    pass  # a new claimant owns the job: they win
+                _discard(tomb)
                 continue
-            worker = (fresh or {}).get("worker_id", "?")
+            worker = fresh.get("worker_id", "?")
             if overdue and not expired:
                 self._record_failure(
                     job_id,
@@ -850,6 +1107,31 @@ class JobQueue:
                 else "stale",
                 job_id, worker,
             )
+        # orphan sweep: artifacts of dances whose worker died mid-step.
+        # Tombstones (".reap."/".release.") age out by st_ctime — a
+        # LIVE dance is at most a few ops long, so a full lease of age
+        # means its owner is gone. Orphaned preempt requests (their
+        # claim is gone) wait out deadline + lease before removal: the
+        # ownership dance makes a live claim briefly absent, and a
+        # revoke must survive that window
+        for name in sorted(os.listdir(cdir)):
+            p = os.path.join(cdir, name)
+            if ".reap." in name or ".release." in name:
+                try:
+                    if now - os.stat(p).st_ctime > self.lease_s:
+                        os.unlink(p)
+                except OSError:
+                    pass  # consumed by its dance, or swept by a peer
+            elif name.endswith(".preempt"):
+                if os.path.exists(p[: -len(".preempt")]):
+                    continue  # claim lives: the request is active
+                req = _read_json(p)
+                deadline = float((req or {}).get("deadline_unix", 0.0))
+                if now > deadline + self.lease_s:
+                    try:
+                        os.unlink(p)
+                    except FileNotFoundError:
+                        pass
         return reaped
 
     # --- operator controls -------------------------------------------

@@ -280,7 +280,27 @@ class WorkerRegistry:
                 continue
             path = os.path.join(self.wdir, name)
             doc = _read_json(path)
-            if doc is None or float(doc.get("expires_unix", 0)) >= now:
+            if doc is None:
+                # TORN entry: the joiner was SIGKILLed between the
+                # O_EXCL create and the document publish. It has no
+                # expiry so it could never be reaped — it leaked
+                # forever, and (worse) a restart reusing the id would
+                # take it over and inherit garbage (found by the mc
+                # registry_torn_entry scenario). Age-gate on st_ctime
+                # so a mid-write joiner gets a full lease to finish
+                try:
+                    if now - os.stat(path).st_ctime <= self.lease_s:
+                        continue
+                    os.unlink(path)
+                except OSError:
+                    continue  # published or reaped in the gap
+                reaped.append(os.path.splitext(name)[0])
+                log.warning(
+                    "reaped torn registry entry %s (joiner died "
+                    "mid-publish)", name,
+                )
+                continue
+            if float(doc.get("expires_unix", 0)) >= now:
                 continue
             try:
                 os.unlink(path)

@@ -115,6 +115,23 @@ def build_status(root: str, queue: JobQueue | None = None) -> dict:
             tgt = resilience.setdefault(table, {})
             for k, v in kv.items():
                 tgt[k] = tgt.get(k, 0) + int(v)
+    # lost-lease attempts (reaped from under a live run) publish no
+    # done record — their survived-fault counters arrive through the
+    # queue's per-worker orphaned-resilience spool instead
+    # (queue.record_orphaned_resilience), so a recovery the fleet
+    # genuinely performed never vanishes from the rollup
+    orphaned = queue.orphaned_resilience()
+    for rec in orphaned:
+        for table, kv in (rec.get("resilience") or {}).items():
+            if not isinstance(kv, dict):
+                continue
+            tgt = resilience.setdefault(table, {})
+            for k, v in kv.items():
+                tgt[k] = tgt.get(k, 0) + int(v)
+    if orphaned:
+        resilience["orphaned_attempts"] = {
+            "total": len(orphaned),
+        }
     quarantined = [
         {
             "job_id": q.get("job_id"),

@@ -784,7 +784,23 @@ class _LeaseRenewer(threading.Thread):
         period = max(0.05, self._queue.lease_s / 3.0)
         while not self._halt.wait(period):
             try:
-                self._queue.renew(self._claim)
+                ok = self._queue.renew(self._claim)
+                if (
+                    ok is False
+                    and self._token is not None
+                    and not self._token.is_set()
+                ):
+                    # the lease is GONE — reaped, or a racing claimant
+                    # won the renewal window. This worker is a zombie
+                    # on the job: revoke so the driver stops at its
+                    # next checkpoint boundary. It must then touch
+                    # NOTHING in the queue (the new owner's state is
+                    # authoritative)
+                    self._token.revoke(
+                        kind="lost",
+                        reason="claim lease lost (reaped or "
+                        "re-claimed by a peer)",
+                    )
                 if self._registry is not None:
                     self._registry.beat(
                         self._claim.worker_id,
@@ -938,8 +954,10 @@ class CampaignRunner:
     ) -> str:
         """Run one claimed job under its own observability stack.
         Returns the job's resulting state (done|backoff|quarantined),
-        or "released" when a revoke (preempt/retire) handed the job
-        back mid-run with zero attempts consumed. ``claim_wait_s`` is
+        "released" when a revoke (preempt/retire) handed the job back
+        mid-run with zero attempts consumed, or "lost" when the claim
+        lease was reaped from under a live run (the reaper charged
+        the attempt; this worker mutates no further queue state). ``claim_wait_s`` is
         how long this worker idled before winning the claim (a
         scheduling span in the job's trace and a fleet latency
         histogram)."""
@@ -1168,6 +1186,36 @@ class CampaignRunner:
                     )
                     if comm is not None:
                         comm.abort(f"leader revoked ({exc.kind})")
+                    if exc.kind == "lost":
+                        # the lease was reaped (or re-claimed) from
+                        # under a live run: the reaper already charged
+                        # the attempt and a new owner may hold the
+                        # claim — this zombie must not mutate ANY
+                        # shared queue state (no release, no carried
+                        # fold, no preempt accounting). The checkpoint
+                        # on disk still serves the re-run
+                        from ..resilience import STATS
+
+                        STATS.preemption("lost")
+                        self.metrics.counter(
+                            "preemptions_total", event=exc.kind
+                        )
+                        log.warning(
+                            "job %s lease lost mid-run; abandoning "
+                            "attempt without queue mutations",
+                            job.job_id,
+                        )
+                        # ...except the worker's OWN spool: the faults
+                        # this attempt survived must still reach the
+                        # campaign rollup, and the append-only sidecar
+                        # races nobody (the job record is off-limits —
+                        # we hold no lease)
+                        lost_delta = _RES_STATS.delta_since(res_base)
+                        if lost_delta:
+                            self.queue.record_orphaned_resilience(
+                                self.worker_id, job.job_id, lost_delta
+                            )
+                        return "lost"
                     # whatever this attempt survived must not vanish
                     # with the zero-attempt release: carry it on the
                     # job record into the resumed run's done record
@@ -1251,7 +1299,27 @@ class CampaignRunner:
         from ..resilience import faults as _faults
 
         _faults.fire("worker.kill", context=f"{job.job_id}:pre-complete")
-        self.queue.complete(claim, worker_id=self.worker_id, **info)
+        if not self.queue.complete(
+            claim, worker_id=self.worker_id, **info
+        ):
+            # the lease was lost between the last renewal and this
+            # publish: the reaper charged the attempt and the done
+            # record is the next owner's to write — claiming "done"
+            # here would double-count the job
+            log.warning(
+                "job %s finished but its lease was lost; done record "
+                "not published (the job will re-run)", job.job_id,
+            )
+            # the attempt's survived faults still count: spool the
+            # delta (NOT info["resilience"] — that folds in carried
+            # marks, which stay on the job record for the re-run's
+            # done record; spooling them too would double-count)
+            lost_delta = _RES_STATS.delta_since(res_base)
+            if lost_delta:
+                self.queue.record_orphaned_resilience(
+                    self.worker_id, job.job_id, lost_delta
+                )
+            return "lost"
         self._record_job_metrics(tel, info)
         if job.bucket:
             self._last_bucket = job.bucket
@@ -1561,6 +1629,7 @@ class CampaignRunner:
 
         tally = {
             "done": 0, "failed": 0, "quarantined": 0, "released": 0,
+            "lost": 0,
         }
         processed = 0
         self.registry.register(self.worker_id, group=self.group)
@@ -1630,6 +1699,12 @@ class CampaignRunner:
                     # handed the job back: nothing was consumed and
                     # nothing was processed
                     tally["released"] += 1
+                    continue
+                if state == "lost":
+                    # the lease was reaped from under a live run: the
+                    # reaper charged the attempt and a peer owns the
+                    # job now — this worker has nothing to account for
+                    tally["lost"] += 1
                     continue
                 processed += 1
                 if state == "done":

@@ -471,6 +471,7 @@ class AlertEngine:
         self.log_path = os.path.join(qdir, "alerts.jsonl")
         self.lock_path = os.path.join(qdir, "alerts.lock")
         self.lock_stale_s = float(lock_stale_s)
+        self._lock_token: str | None = None
 
     # --- persistence --------------------------------------------------
     def load_snapshot(self) -> dict:
@@ -494,11 +495,26 @@ class AlertEngine:
                 )
             except FileExistsError:
                 doc = _read_json(self.lock_path)
-                held_unix = float((doc or {}).get("t_unix", 0.0))
-                if doc is not None and now - held_unix <= self.lock_stale_s:
-                    return False  # live evaluator owns the round
-                # stale (or torn) lock: win the takeover via a rename
-                # race, then retry the exclusive create
+                if doc is not None:
+                    held_unix = float(doc.get("t_unix", 0.0))
+                    if now - held_unix <= self.lock_stale_s:
+                        return False  # live evaluator owns the round
+                else:
+                    # TORN lock: unreadable is either a holder that
+                    # died between the O_CREAT|O_EXCL and the document
+                    # publish, or a LIVE acquirer still inside that
+                    # window. Age-gate on st_ctime before taking over
+                    # — an immediate takeover here stole the round
+                    # from a perfectly live evaluator (found by the mc
+                    # alerts_lock scenario)
+                    try:
+                        age = now - os.stat(self.lock_path).st_ctime
+                    except OSError:
+                        continue  # released in the gap: retry create
+                    if age <= self.lock_stale_s:
+                        return False
+                # stale (or aged-out torn) lock: win the takeover via
+                # a rename race, then retry the exclusive create
                 reaped = self.lock_path + f".{uuid.uuid4().hex[:8]}.reap"
                 try:
                     os.rename(self.lock_path, reaped)
@@ -506,14 +522,38 @@ class AlertEngine:
                 except OSError:
                     pass  # another evaluator won the takeover
                 continue
+            token = uuid.uuid4().hex
             with os.fdopen(fd, "w") as f:
-                json.dump({"pid": os.getpid(), "t_unix": now}, f)
+                json.dump(
+                    {"pid": os.getpid(), "t_unix": now, "token": token},
+                    f,
+                )
+            self._lock_token = token
             return True
         return False
 
     def _release_lock(self) -> None:
+        """Token-verified release. A blind unlink here deleted a lock
+        another evaluator had legitimately taken over after deciding
+        ours was stale — mutual exclusion silently lapsed for a round
+        (found by the mc alerts_release_race scenario). Rename the
+        lock aside, confirm the tombstone still carries OUR token,
+        and restore a mismatch via link so a new holder's lock (or
+        its own re-acquire in the gap) is never clobbered."""
+        token, self._lock_token = self._lock_token, None
+        tomb = self.lock_path + f".{uuid.uuid4().hex[:8]}.reap"
         try:
-            os.unlink(self.lock_path)
+            os.rename(self.lock_path, tomb)
+        except OSError:
+            return  # taken over and released already — same outcome
+        doc = _read_json(tomb)
+        if doc is None or doc.get("token") != token:
+            try:
+                os.link(tomb, self.lock_path)
+            except OSError:
+                pass  # the new holder re-created it first: they win
+        try:
+            os.unlink(tomb)
         except OSError:
             pass
 

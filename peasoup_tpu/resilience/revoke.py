@@ -53,7 +53,7 @@ class RevokeToken:
     def __init__(self) -> None:
         self._event = threading.Event()
         self._lock = threading.Lock()
-        self.kind: str | None = None  # "preempt" | "retire"
+        self.kind: str | None = None  # "preempt" | "retire" | "lost"
         self.reason: str = ""
         self.requested_unix: float | None = None
         self.observed_unix: float | None = None

@@ -1,10 +1,13 @@
 """``peasoup-audit`` — the static-analysis gate.
 
-Runs the four engines over the repo — AST JAX-hazard lints (PSA),
+Runs the five engines over the repo — AST JAX-hazard lints (PSA),
 jitted-program contracts at representative AND campaign-bucket-ladder
-shapes (PSC), concurrency/file-protocol lints (PSP), and Pallas
-kernel contracts (PSK) — applies the baseline ratchet, prints a human
-report and optionally writes the versioned ``audit.json``.
+shapes (PSC), concurrency/file-protocol lints (PSP), Pallas kernel
+contracts (PSK), and protocol model checking (PSM: the real
+queue/registry/tenants/alerts code explored under exhaustive
+interleavings and crash points against a virtual filesystem) —
+applies the baseline ratchet, prints a human report and optionally
+writes the versioned ``audit.json``.
 
 Exit codes (scripts/check.sh relies on these):
 
@@ -93,6 +96,27 @@ def build_parser() -> argparse.ArgumentParser:
         "contract checks)",
     )
     p.add_argument(
+        "--no-mc",
+        action="store_true",
+        help="skip engine 5 (PSM protocol model checking: exhaustive "
+        "interleaving + crash-point exploration of the file-backed "
+        "protocols)",
+    )
+    p.add_argument(
+        "--mc-scenarios",
+        default=None,
+        metavar="NAMES",
+        help="comma-separated mc scenario names to run "
+        "(default: the whole library)",
+    )
+    p.add_argument(
+        "--mc-budget",
+        type=int,
+        default=None,
+        metavar="N",
+        help="max schedules explored per mc scenario (default 400)",
+    )
+    p.add_argument(
         "--no-ladder",
         action="store_true",
         help="skip the bucket-ladder contract pass (representative "
@@ -148,6 +172,19 @@ def _list_rules() -> int:
         "drift (deleted probe / unreferenced twin), interpret-mode "
         "lowering failure, Mosaic lowering failure (TPU toolchains)"
     )
+    print(
+        "PSM300-PSM308 (mc engine, dynamic): protocol model checking "
+        "— scenario invariant violations found by exhaustive "
+        "interleaving + crash-point exploration of the real "
+        "queue/registry/tenants/alerts code over a virtual "
+        "filesystem. PSM300 internal (task crash/deadlock), PSM301 "
+        "exactly-once claim/complete, PSM302 crash-recovery reap, "
+        "PSM303 renew/release-vs-reap ownership, PSM304 preemption "
+        "handoff, PSM305 gang assembly, PSM306 registry liveness, "
+        "PSM307 tenant throttling, PSM308 alerts lock/journal. Each "
+        "finding embeds its minimized schedule; replay with "
+        "peasoup_tpu.analysis.mc.replay for a bit-identical trace"
+    )
     return 0
 
 
@@ -166,6 +203,13 @@ def main(argv=None) -> int:
         rule_ids = None
         if args.rules:
             rule_ids = [r.strip() for r in args.rules.split(",") if r.strip()]
+        mc_names = None
+        if args.mc_scenarios:
+            mc_names = [
+                n.strip()
+                for n in args.mc_scenarios.split(",")
+                if n.strip()
+            ]
         result = run_audit(
             args.root,
             rule_ids=rule_ids,
@@ -177,6 +221,9 @@ def main(argv=None) -> int:
             ladder_rung_count=args.ladder_rungs,
             baseline_path=args.baseline,
             max_const_bytes=args.max_const_bytes,
+            mc=not args.no_mc,
+            mc_scenarios=mc_names,
+            mc_budget=args.mc_budget,
         )
         if args.write_baseline:
             if not args.baseline:

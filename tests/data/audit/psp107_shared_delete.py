@@ -1,0 +1,36 @@
+# audit-path: peasoup_tpu/campaign/psp107.py
+"""Fixture: PSP107 — direct delete of a shared artifact path."""
+import os
+import uuid
+
+
+def bad_delete_claim(root, job_id):
+    # read-check-delete: between the exists() and the unlink a renewer
+    # may have republished the claim — the unlink destroys theirs
+    path = os.path.join(root, "queue", "claims", job_id + ".json")
+    if os.path.exists(path):
+        os.unlink(path)  # expect[PSP107]
+
+
+def bad_remove_job(root, job_id):
+    jpath = os.path.join(root, "jobs", job_id + ".json")
+    os.remove(jpath)  # expect[PSP107]
+
+
+def good_tombstone_dance(root, job_id):
+    path = os.path.join(root, "queue", "claims", job_id + ".json")
+    tomb = path + ".reap." + uuid.uuid4().hex[:8]
+    os.rename(path, tomb)  # ok: rename transfers ownership first
+    os.unlink(tomb)  # ok: tombstone is ours alone to consume
+
+
+def good_release_tombstone(root, job_id):
+    path = os.path.join(root, "queue", "claims", job_id + ".json")
+    tomb = path + ".release." + uuid.uuid4().hex[:8]
+    os.rename(path, tomb)  # ok: release dance, same idiom
+    os.unlink(tomb)  # ok: verified tombstone consumption
+
+
+def good_quarantine(root, name):
+    path = os.path.join(root, "queue", "jobs", name)
+    os.rename(path, path + ".corrupt")  # ok: forensics survive

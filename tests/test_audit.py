@@ -393,7 +393,7 @@ class TestRunnerAndCLI:
     def test_exit_0_on_clean_tree(self, tmp_path, capsys):
         root = self._mini_repo(tmp_path, violate=False)
         rc = audit_main(
-            ["--root", str(root), "--no-contracts", "--no-kernels"]
+            ["--root", str(root), "--no-contracts", "--no-kernels", "--no-mc"]
         )
         assert rc == 0
         assert "0 new" in capsys.readouterr().out
@@ -401,7 +401,7 @@ class TestRunnerAndCLI:
     def test_exit_1_on_new_finding(self, tmp_path, capsys):
         root = self._mini_repo(tmp_path)
         rc = audit_main(
-            ["--root", str(root), "--no-contracts", "--no-kernels"]
+            ["--root", str(root), "--no-contracts", "--no-kernels", "--no-mc"]
         )
         assert rc == 1
         assert "PSA007" in capsys.readouterr().out
@@ -412,7 +412,7 @@ class TestRunnerAndCLI:
         bad.write_text("{not json")
         rc = audit_main(
             [
-                "--root", str(root), "--no-contracts", "--no-kernels",
+                "--root", str(root), "--no-contracts", "--no-kernels", "--no-mc",
                 "--baseline", str(bad),
             ]
         )
@@ -422,7 +422,7 @@ class TestRunnerAndCLI:
         root = self._mini_repo(tmp_path)
         baseline = tmp_path / "baseline.json"
         args = [
-            "--root", str(root), "--no-contracts", "--no-kernels",
+            "--root", str(root), "--no-contracts", "--no-kernels", "--no-mc",
             "--baseline", str(baseline),
         ]
         assert audit_main(args) == 1  # new finding
@@ -509,7 +509,7 @@ class TestRepoIsClean:
                 sys.executable, "-m", "peasoup_tpu.tools.audit",
                 "--root", str(REPO_ROOT),
                 "--baseline", str(REPO_ROOT / "audit_baseline.json"),
-                "--no-contracts", "--no-kernels",
+                "--no-contracts", "--no-kernels", "--no-mc",
             ],
             capture_output=True,
             text=True,
@@ -765,7 +765,7 @@ class TestFourEngineAcceptance:
             "        f.write(doc)\n",
         )
         rc = audit_main(
-            ["--root", str(root), "--no-contracts", "--no-kernels"]
+            ["--root", str(root), "--no-contracts", "--no-kernels", "--no-mc"]
         )
         assert rc == 1
 
@@ -779,7 +779,7 @@ class TestFourEngineAcceptance:
             "    threading.Thread(target=tick, daemon=True).start()\n",
         )
         rc = audit_main(
-            ["--root", str(root), "--no-contracts", "--no-kernels"]
+            ["--root", str(root), "--no-contracts", "--no-kernels", "--no-mc"]
         )
         assert rc == 1
 
@@ -794,7 +794,7 @@ class TestFourEngineAcceptance:
         )
         rc = audit_main(
             [
-                "--root", str(root), "--no-contracts", "--no-kernels",
+                "--root", str(root), "--no-contracts", "--no-kernels", "--no-mc",
                 "--no-protocol",
             ]
         )

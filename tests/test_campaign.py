@@ -576,6 +576,7 @@ class TestFFACampaignPipeline:
         tally = run_worker(root, worker_id="w1", poll_s=0.05)
         assert tally == {
             "done": 1, "failed": 0, "quarantined": 0, "released": 0,
+            "lost": 0,
         }
         jid = q.job_ids()[0]
         [done] = q.done_records()

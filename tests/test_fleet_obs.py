@@ -557,6 +557,49 @@ class TestCarriedResilience:
         assert Job.from_doc(doc).carried_resilience == {}
 
 
+class TestOrphanedResilience:
+    """A LOST attempt (lease reaped from under a live run) may not
+    touch the job record or publish a done record — so its survived
+    faults spool to the worker's own append-only sidecar and the
+    rollup folds them in. Found by the fleet chaos gate after the
+    exactly-once hardening: the flaky-reader faults fired, the
+    attempt was reaped, and the rollup showed no recovery marks."""
+
+    def test_spool_and_rollup_fold(self, tmp_path):
+        from peasoup_tpu.campaign.queue import JobQueue
+        from peasoup_tpu.campaign.rollup import build_status
+
+        q = JobQueue(str(tmp_path))
+        q.record_orphaned_resilience(
+            "w0", "j1",
+            {"retries": {"fil.read:/x": 2},
+             "recoveries": {"fil.read:/x": 1}},
+        )
+        q.record_orphaned_resilience(
+            "w0", "j2", {"retries": {"db.tx": 1}}
+        )
+        q.record_orphaned_resilience("w1", "j1", {})  # no-op
+        recs = q.orphaned_resilience()
+        assert [r["job_id"] for r in recs] == ["j1", "j2"]
+        res = build_status(str(tmp_path), q)["resilience"]
+        assert res["retries"] == {"fil.read:/x": 2, "db.tx": 1}
+        assert res["recoveries"] == {"fil.read:/x": 1}
+        assert res["orphaned_attempts"]["total"] == 2
+
+    def test_torn_tail_line_skipped(self, tmp_path):
+        from peasoup_tpu.campaign.queue import JobQueue
+
+        q = JobQueue(str(tmp_path))
+        q.record_orphaned_resilience(
+            "w0", "j1", {"retries": {"fil.read": 1}}
+        )
+        # a worker killed mid-append leaves a torn final line
+        spool = os.path.join(q.qdir, "resilience", "w0.jsonl")
+        with open(spool, "a") as f:
+            f.write('{"job_id": "j2", "resil')
+        assert len(q.orphaned_resilience()) == 1
+
+
 # --------------------------------------------------------------------------
 # rollup: throughput decay + clamped ages (ISSUE satellite)
 # --------------------------------------------------------------------------

@@ -1,0 +1,383 @@
+"""Audit engine 5: protocol model checking.
+
+Covers, bottom-up:
+
+* the virtual filesystem's load-bearing op semantics (O_EXCL
+  exclusivity, buffer-until-close torn files, rename atomicity and
+  POSIX ctime/mtime, exactly-once ``os.link``, fsync-vs-host-crash
+  durability),
+* scheduler determinism (one schedule -> one bit-identical trace),
+* crash injection (a SIGKILLed task's cleanup cannot mutate shared
+  state; crash points enumerate the killable op surface),
+* the explorer on a seeded lost-update race: found with POR on and
+  off (the soundness spot-check), minimized, replayed, deduped,
+* the scenario library: green end-to-end against the real protocol
+  modules, and — with the queue's *old* non-atomic ``complete``
+  monkeypatched back in — a deliberately re-seeded exactly-once race
+  caught as a PSM finding whose embedded schedule replays
+  bit-identically.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from peasoup_tpu.analysis.mc.crash import enumerate_crash_points
+from peasoup_tpu.analysis.mc.explorer import (
+    Scenario,
+    explore,
+    minimize,
+    replay,
+    run_schedule,
+    schedule_to_str,
+    str_to_schedule,
+)
+from peasoup_tpu.analysis.mc.invariants import MCContext, require
+from peasoup_tpu.analysis.mc.scenarios import (
+    run_mc,
+    scenario_names,
+    scenarios,
+)
+from peasoup_tpu.analysis.mc.vfs import MCEnv, OpDesc, conflicts
+from peasoup_tpu.campaign import queue as qmod
+
+# ---------------------------------------------------------------------------
+# virtual filesystem semantics
+# ---------------------------------------------------------------------------
+
+
+class TestVfsSemantics:
+    def test_o_excl_create_admits_exactly_one(self):
+        env = MCEnv()
+        flags = env.os.O_CREAT | env.os.O_EXCL | env.os.O_WRONLY
+        env.os.open("/camp/queue/claims/j1.json", flags)
+        with pytest.raises(FileExistsError):
+            env.os.open("/camp/queue/claims/j1.json", flags)
+
+    def test_write_buffers_until_close_publishes(self):
+        env = MCEnv()
+        f = env.open("/camp/doc.json", "w")
+        f.write('{"k": 1}')
+        # the torn-file window: created (truncated), nothing published
+        assert env.fs.read("/camp/doc.json") == ""
+        f.close()
+        assert json.loads(env.fs.read("/camp/doc.json")) == {"k": 1}
+
+    def test_abandoned_fd_is_a_torn_file(self):
+        # os.close without fdopen().close() publishes nothing — the
+        # SIGKILL-mid-write model every crash scenario leans on
+        env = MCEnv()
+        fd, tmp = env.tempfile.mkstemp(dir="/camp", suffix=".tmp")
+        f = env.os.fdopen(fd, "w")
+        f.write("data")
+        env.os.close(fd)
+        assert env.fs.read(tmp) == ""
+
+    def test_rename_is_atomic_and_bumps_ctime_not_mtime(self):
+        env = MCEnv()
+        vf = env.fs.create("/camp/a.json", env.clock, excl=True)
+        env.fs.publish(vf, "one", env.clock)
+        t0 = env.clock
+        env.clock += 50.0
+        env.os.replace("/camp/a.json", "/camp/b.json")
+        assert not env.fs.exists("/camp/a.json")
+        assert env.fs.read("/camp/b.json") == "one"
+        st = env.fs.stat("/camp/b.json")
+        assert st.st_ctime == t0 + 50.0  # rename bumps ctime...
+        assert st.st_mtime == t0  # ...but never mtime
+
+    def test_link_is_exactly_once(self):
+        env = MCEnv()
+        vf = env.fs.create("/camp/tmp0", env.clock, excl=True)
+        env.fs.publish(vf, "rec", env.clock)
+        env.os.link("/camp/tmp0", "/camp/done.json")
+        with pytest.raises(FileExistsError):
+            env.os.link("/camp/tmp0", "/camp/done.json")
+        assert env.fs.read("/camp/done.json") == "rec"
+
+    def test_host_crash_drops_unsynced_keeps_synced(self):
+        env = MCEnv()
+        fd1, t1 = env.tempfile.mkstemp(dir="/camp", suffix=".tmp")
+        f1 = env.os.fdopen(fd1, "w")
+        f1.write("gone")
+        f1.close()  # published but never fsynced
+        fd2, t2 = env.tempfile.mkstemp(dir="/camp", suffix=".tmp")
+        f2 = env.os.fdopen(fd2, "w")
+        f2.write("kept")
+        f2.flush()
+        env.os.fsync(fd2)
+        f2.close()
+        env.fs.host_crash()
+        assert not env.fs.exists(t1)
+        assert env.fs.read(t2) == "kept"
+
+    def test_fd_binds_inode_across_rename(self):
+        # a write in flight lands in the inode wherever its NAME went —
+        # exactly the hazard reap_stale's torn-tombstone putback covers
+        env = MCEnv()
+        flags = env.os.O_CREAT | env.os.O_EXCL | env.os.O_WRONLY
+        fd = env.os.open("/camp/claim.json", flags)
+        f = env.os.fdopen(fd, "w")
+        f.write('{"worker_id": "w1"}')
+        env.os.rename("/camp/claim.json", "/camp/claim.json.reap.0")
+        f.close()
+        doc = json.loads(env.fs.read("/camp/claim.json.reap.0"))
+        assert doc == {"worker_id": "w1"}
+
+    def test_conflicts_are_symmetric_on_shared_paths(self):
+        r = OpDesc("read", "/a", reads=frozenset({"/a"}))
+        w = OpDesc("rename", "/a", writes=frozenset({"/a", "/b"}))
+        other = OpDesc("read", "/c", reads=frozenset({"/c"}))
+        assert conflicts(r, w) and conflicts(w, r)
+        assert not conflicts(r, other)
+
+
+# ---------------------------------------------------------------------------
+# a seeded lost-update race (read-modify-write without exclusion)
+# ---------------------------------------------------------------------------
+
+_COUNTER = "/camp/queue/counter.json"
+
+
+def _counter_scenario() -> Scenario:
+    def setup(ctx: MCContext) -> None:
+        env = ctx.env
+        vf = env.fs.create(_COUNTER, env.clock, excl=True)
+        env.fs.publish(vf, json.dumps({"n": 0}), env.clock)
+
+    def bump(name: str):
+        def body(ctx: MCContext) -> None:
+            env = ctx.env
+            doc = json.loads(env.open(_COUNTER).read())
+            tmp = f"{_COUNTER}.tmp.{name}"
+            f = env.open(tmp, "w")
+            f.write(json.dumps({"n": doc["n"] + 1}))
+            f.close()
+            env.os.replace(tmp, _COUNTER)
+
+        return body
+
+    def invariant(ctx: MCContext) -> None:
+        n = (ctx.read_json(_COUNTER) or {}).get("n")
+        require(n == 2, f"lost update: n={n} after two increments")
+
+    return Scenario(
+        name="seeded_lost_update",
+        rule="PSM301",
+        module="tests/test_mc.py",
+        description="unsynchronized read-modify-write of one doc",
+        setup=setup,
+        tasks=(("w1", bump("w1"), False), ("w2", bump("w2"), False)),
+        invariant=invariant,
+        max_kills=0,
+    )
+
+
+class TestExplorer:
+    def test_seeded_race_found_with_and_without_por(self):
+        # the POR soundness spot-check: pruning must not lose the
+        # interleaving where both workers read the same snapshot
+        s = _counter_scenario()
+        full = explore(s, budget=200, por=False, stop_on_first=False)
+        por = explore(s, budget=200, por=True, stop_on_first=False)
+        assert full.violations, "seeded race not found without POR"
+        assert {m for m, _ in full.violations} == {
+            m for m, _ in por.violations
+        }
+        assert por.schedules <= full.schedules
+
+    def test_violating_schedule_replays_deterministically(self):
+        s = _counter_scenario()
+        res = explore(s, budget=200, stop_on_first=True)
+        msg, chosen = res.violations[0]
+        r1 = run_schedule(s, chosen)
+        r2 = run_schedule(s, chosen)
+        assert r1.violation == r2.violation == msg
+        assert r1.trace == r2.trace  # bit-identical replay
+
+    def test_minimize_yields_shortest_reproducing_prefix(self):
+        s = _counter_scenario()
+        res = explore(s, budget=200, stop_on_first=True)
+        msg, chosen = res.violations[0]
+        mini = minimize(s, chosen, msg)
+        assert len(mini) <= len(chosen)
+        assert run_schedule(s, mini).violation == msg
+        if mini:  # any shorter prefix must NOT reproduce
+            assert run_schedule(s, mini[:-1]).violation != msg
+
+    def test_schedule_string_round_trip(self):
+        assert str_to_schedule("-") == ()
+        assert schedule_to_str(()) == "-"
+        sched = ("1", "K0", "0")
+        assert str_to_schedule(schedule_to_str(sched)) == sched
+
+    def test_default_schedule_is_sequential_and_green(self):
+        run = run_schedule(_counter_scenario())
+        assert run.violation is None
+        assert run.tasks == {"w1": "done", "w2": "done"}
+
+
+# ---------------------------------------------------------------------------
+# crash injection
+# ---------------------------------------------------------------------------
+
+_ARTIFACT = "/camp/queue/a.json"
+
+
+def _kill_cleanup_scenario() -> Scenario:
+    def setup(ctx: MCContext) -> None:
+        env = ctx.env
+        vf = env.fs.create(_ARTIFACT, env.clock, excl=True)
+        env.fs.publish(vf, "{}", env.clock)
+
+    def w(ctx: MCContext) -> None:
+        env = ctx.env
+        try:
+            env.open(_ARTIFACT).read()
+        finally:
+            # a real worker's except/finally cleanup: under SIGKILL
+            # this must never run
+            env.os.unlink(_ARTIFACT)
+
+    def invariant(ctx: MCContext) -> None:
+        killed = any(":KILLED:" in e for e in ctx.env.trace)
+        if killed:
+            require(
+                ctx.exists(_ARTIFACT),
+                "a killed task's cleanup mutated shared state",
+            )
+        else:
+            require(not ctx.exists(_ARTIFACT), "cleanup did not run")
+
+    return Scenario(
+        name="kill_cleanup",
+        rule="PSM302",
+        module="tests/test_mc.py",
+        description="SIGKILL model: cleanup handlers cannot run",
+        setup=setup,
+        tasks=(("w", w, True),),
+        invariant=invariant,
+        max_kills=1,
+    )
+
+
+class TestCrashInjection:
+    def test_killed_cleanup_cannot_mutate(self):
+        s = _kill_cleanup_scenario()
+        run = run_schedule(s, ("K0",))  # kill parked at the first op
+        assert run.violation is None
+        assert run.tasks["w"] == "killed"
+        assert any(e.startswith("w:KILLED:") for e in run.trace)
+        # the finally-block unlink never executed
+        assert not any(e.startswith("w:unlink") for e in run.trace)
+
+    def test_crash_points_enumerate_the_killable_op_surface(self):
+        s = _kill_cleanup_scenario()
+        # crash-free run: read + cleanup unlink = two killable ops
+        assert enumerate_crash_points(s) == 2
+
+    def test_exploration_covers_every_crash_point_green(self):
+        res = explore(
+            _kill_cleanup_scenario(), budget=100, stop_on_first=False
+        )
+        assert res.exhausted
+        assert not res.violations
+
+    def test_unkillable_scenarios_have_no_crash_points(self):
+        assert enumerate_crash_points(_counter_scenario()) == 0
+
+
+# ---------------------------------------------------------------------------
+# the scenario library against the real protocol modules
+# ---------------------------------------------------------------------------
+
+
+class TestScenarioLibrary:
+    def test_library_covers_the_protocol_surface(self):
+        names = scenario_names()
+        assert len(names) >= 10
+        blob = "|".join(names)
+        for protocol in (
+            "claim", "reap", "preempt", "gang", "registry", "tenant",
+            "alerts",
+        ):
+            assert protocol in blob, f"no scenario covers {protocol}"
+
+    def test_full_library_is_green(self):
+        rep = run_mc(budget=60)
+        assert rep.violations == 0, [
+            f.message for f in rep.findings
+        ]
+        assert not rep.findings
+        assert rep.scenarios == len(scenario_names())
+        assert rep.schedules > 0
+        assert rep.crash_points > 0  # kills were actually injected
+
+    def test_subset_selection_and_unknown_name(self):
+        rep = run_mc(names=["claim_race"], budget=30)
+        assert rep.scenarios == 1
+        assert rep.per_scenario[0]["name"] == "claim_race"
+        with pytest.raises(ValueError, match="unknown mc scenario"):
+            run_mc(names=["no_such_scenario"])
+
+
+# ---------------------------------------------------------------------------
+# the acceptance drill: re-seed the queue's pre-dance complete() and
+# catch the exactly-once violation with a replayable schedule
+# ---------------------------------------------------------------------------
+
+
+def _old_complete(self, claim, **info):
+    """The pre-tombstone-dance implementation: publish the done record
+    unconditionally (tmp + os.replace, so the second publication
+    silently overwrites the first) and blindly unlink the claim."""
+    done = self._p(qmod._DONE, claim.job.job_id)
+    qmod._atomic_write_json(
+        done,
+        {
+            "job_id": claim.job.job_id,
+            "worker_id": claim.worker_id,
+            **info,
+        },
+    )
+    try:
+        qmod.os.unlink(claim.path)
+    except FileNotFoundError:
+        pass
+    self.clear_preempt(claim.job.job_id)
+    return True
+
+
+class TestSeededQueueRace:
+    @pytest.fixture()
+    def doctored_queue(self, monkeypatch):
+        monkeypatch.setattr(qmod.JobQueue, "complete", _old_complete)
+
+    def _scenario(self):
+        return {s.name: s for s in scenarios()}["zombie_complete"]
+
+    def test_seeded_race_is_caught_and_replays_bit_identically(
+        self, doctored_queue
+    ):
+        rep = run_mc(names=["zombie_complete"], budget=400)
+        assert rep.violations >= 1
+        f = rep.findings[0]
+        assert f.rule == "PSM301"
+        assert f.severity == "error"
+        assert f.path == "peasoup_tpu/campaign/queue.py"
+        assert "schedule=" in f.source_line
+        # replay straight from the finding, twice: bit-identical
+        sched = f.source_line.split("schedule=", 1)[1].strip()
+        s = self._scenario()
+        r1 = replay(s, sched)
+        r2 = replay(s, sched)
+        assert r1.violation is not None
+        assert r1.violation in f.message
+        assert r1.trace == r2.trace
+        assert r1.violation == r2.violation
+
+    def test_fixed_queue_passes_the_same_scenario(self):
+        rep = run_mc(names=["zombie_complete"], budget=400)
+        assert rep.violations == 0
